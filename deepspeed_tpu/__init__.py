@@ -1,0 +1,111 @@
+"""deepspeed_tpu — a TPU-native framework with DeepSpeed's capabilities.
+
+Brand-new design (not a port): JAX/XLA/pjit/Pallas compute path over a global
+``jax.sharding.Mesh``; ZeRO = sharding policies; comm = mesh collectives.
+Public API mirrors the reference's ``deepspeed/__init__.py`` surface
+(``initialize`` at reference ``deepspeed/__init__.py:69``, ``init_inference``
+at ``:291``, ``add_config_arguments`` at ``:268``).
+"""
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from . import comm
+from . import utils
+from .accelerator import get_accelerator
+from .utils.logging import logger, log_dist
+
+dist = comm
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mesh_param=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mpu=None,
+               config_params=None):
+    """Build the training engine.
+
+    Reference ``deepspeed/__init__.py:69``.  Returns
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    TPU-native signature differences:
+      * ``model`` is a flax ``nn.Module``, haiku transform, or a plain apply
+        callable ``f(params, batch, rngs) -> output``;
+      * ``model_parameters`` is the parameter pytree (or ``None`` to let the
+        engine initialize from ``model.init``);
+      * ``mpu``/``mesh_param`` configure the (pp, dp, sp, tp) mesh factoring.
+    """
+    from .runtime.engine import DeepSpeedEngine
+    from .runtime.config import DeepSpeedConfig
+    from .runtime.pipe.module import PipelineModule
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+
+    ds_config = DeepSpeedConfig(config, mesh_param=mesh_param)
+
+    if isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                collate_fn=collate_fn,
+                                config=ds_config,
+                                mpu=mpu)
+    else:
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 collate_fn=collate_fn,
+                                 config=ds_config,
+                                 mpu=mpu)
+
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model, config=None, **kwargs):
+    """Reference ``deepspeed/__init__.py:291``."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+    if config is None:
+        config = {}
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_inference_config = config
+    else:
+        config.update(kwargs)
+        ds_inference_config = DeepSpeedInferenceConfig(**config)
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+def add_config_arguments(parser):
+    """Reference ``deepspeed/__init__.py:268`` — argparse plumbing."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for config)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+    return argparse.SUPPRESS

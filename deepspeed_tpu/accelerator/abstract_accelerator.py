@@ -1,0 +1,162 @@
+"""Accelerator abstraction (L0).
+
+TPU-first re-design of the reference's ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC).  Every other layer acquires hardware services
+through :func:`deepspeed_tpu.accelerator.get_accelerator` — device handles, RNG,
+memory statistics, dtype support, communication backend name, and kernel
+("op builder") availability.
+
+Differences from the reference, by design:
+  * no streams/events API — XLA owns scheduling; we expose ``synchronize()``
+    (block_until_ready) and async semantics come from jax dispatch;
+  * tensor-factory helpers return jax arrays, and ``device()`` returns
+    ``jax.Device`` objects;
+  * ``communication_backend_name()`` is "ici" on TPU, "gloo" on CPU — the comm
+    layer maps both onto mesh collectives.
+"""
+
+import abc
+from abc import ABC
+
+
+class DeepSpeedAccelerator(ABC):
+    """Surface mirroring reference ``accelerator/abstract_accelerator.py``."""
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+        self._compile_backend = None
+
+    # ------------------------------------------------------------------ device
+    @abc.abstractmethod
+    def is_synchronized_device(self):
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def global_device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # --------------------------------------------------------------------- RNG
+    @abc.abstractmethod
+    def random_key(self, seed):
+        """Return a jax PRNG key for ``seed`` (replaces torch RNG state APIs)."""
+        ...
+
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self):
+        ...
+
+    # ------------------------------------------------------------------ memory
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def empty_cache(self):
+        ...
+
+    # ---------------------------------------------------------------- dtypes
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    @abc.abstractmethod
+    def preferred_dtype(self):
+        ...
+
+    # ------------------------------------------------------------------- comm
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        ...
+
+    # -------------------------------------------------------------- op builder
+    @abc.abstractmethod
+    def create_op_builder(self, op_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name):
+        ...
+
+    # ------------------------------------------------------------------- misc
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    @abc.abstractmethod
+    def range_push(self, msg):
+        ...
+
+    @abc.abstractmethod
+    def range_pop(self):
+        ...
+
+    @abc.abstractmethod
+    def visible_devices_envs(self):
+        ...
+
+    def set_visible_devices_envs(self, current_env, local_accelerator_ids):
+        """Reference ``abstract_accelerator.py:297`` — used by the launcher to
+        pin each spawned process to its chips."""
+        for env in self.visible_devices_envs():
+            current_env[env] = ",".join(map(str, local_accelerator_ids))

@@ -1,0 +1,137 @@
+"""CPU accelerator (JAX CPU backend).
+
+Analog of reference ``accelerator/cpu_accelerator.py:19``.  Used for unit tests
+(virtual 8-device CPU mesh via ``--xla_force_host_platform_device_count``) and
+for BASELINE config 1 (BERT-base ZeRO-0 fp32 CPU).
+"""
+
+import os
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+        self._compile_backend = "xla"
+        self._current_device_index = 0
+        self._initial_seed = 42
+
+    def _jax(self):
+        import jax
+        return jax
+
+    def _local_devices(self):
+        jax = self._jax()
+        return [d for d in jax.local_devices() if d.platform == "cpu"] or jax.local_devices()
+
+    # ------------------------------------------------------------------ device
+    def is_synchronized_device(self):
+        return False
+
+    def device_name(self, device_index=None):
+        return "cpu" if device_index is None else f"cpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._local_devices()
+        return devs[self._current_device_index if device_index is None else device_index]
+
+    def set_device(self, device_index):
+        self._current_device_index = device_index
+
+    def current_device(self):
+        return self._current_device_index
+
+    def current_device_name(self):
+        return f"cpu:{self._current_device_index}"
+
+    def device_count(self):
+        return len(self._local_devices())
+
+    def global_device_count(self):
+        return self._jax().device_count()
+
+    def synchronize(self, device_index=None):
+        (self._jax().device_put(0.0) + 0).block_until_ready()
+
+    # --------------------------------------------------------------------- RNG
+    def random_key(self, seed):
+        return self._jax().random.PRNGKey(seed)
+
+    def manual_seed(self, seed):
+        self._initial_seed = seed
+
+    def initial_seed(self):
+        return self._initial_seed
+
+    # ------------------------------------------------------------------ memory
+    def memory_stats(self, device_index=None):
+        try:
+            import psutil
+            vm = psutil.virtual_memory()
+            return {"bytes_in_use": vm.used, "bytes_limit": vm.total}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        return None
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def empty_cache(self):
+        return None
+
+    # ---------------------------------------------------------------- dtypes
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16]
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+        return jnp.float32
+
+    # ------------------------------------------------------------------- comm
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # -------------------------------------------------------------- op builder
+    def create_op_builder(self, op_name):
+        builder = self.get_op_builder(op_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, op_name):
+        from ..ops.op_builder import get_op_builder_class
+        return get_op_builder_class(op_name, accelerator_name=self._name)
+
+    # ------------------------------------------------------------------- misc
+    def is_available(self):
+        return True
+
+    def range_push(self, msg):
+        return None
+
+    def range_pop(self):
+        return None
+
+    def visible_devices_envs(self):
+        return []

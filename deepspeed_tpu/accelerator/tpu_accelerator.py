@@ -1,0 +1,167 @@
+"""TPU accelerator (JAX/XLA backed).
+
+The TPU answer to the reference's ``accelerator/cuda_accelerator.py:24``
+(``CUDA_Accelerator``).  Memory statistics come from
+``jax.Device.memory_stats()``; RNG is jax's functional PRNG; the communication
+backend name is "ici" (intra-slice interconnect), consumed by
+``deepspeed_tpu.comm`` the way the reference consumes "nccl"
+(``abstract_accelerator.py:201``).
+"""
+
+import os
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "ici"
+        self._compile_backend = "xla"
+        self._current_device_index = 0
+        self._initial_seed = 42
+
+    # Lazy jax import so that accelerator selection never forces TPU runtime
+    # bring-up (mirrors how the reference guards torch.cuda calls).
+    def _jax(self):
+        import jax
+        return jax
+
+    def _local_devices(self):
+        jax = self._jax()
+        return jax.local_devices()
+
+    # ------------------------------------------------------------------ device
+    def is_synchronized_device(self):
+        # jax dispatch is async; arrays must be waited on explicitly.
+        return False
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._local_devices()
+        return devs[self._current_device_index if device_index is None else device_index]
+
+    def set_device(self, device_index):
+        self._current_device_index = device_index
+
+    def current_device(self):
+        return self._current_device_index
+
+    def current_device_name(self):
+        return f"tpu:{self._current_device_index}"
+
+    def device_count(self):
+        return len(self._local_devices())
+
+    def global_device_count(self):
+        return self._jax().device_count()
+
+    def synchronize(self, device_index=None):
+        # Block until all outstanding XLA work on this process is complete.
+        jax = self._jax()
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+    # --------------------------------------------------------------------- RNG
+    def random_key(self, seed):
+        jax = self._jax()
+        return jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed):
+        self._initial_seed = seed
+
+    def initial_seed(self):
+        return self._initial_seed
+
+    # ------------------------------------------------------------------ memory
+    def memory_stats(self, device_index=None):
+        dev = self.device(device_index)
+        stats = dev.memory_stats()
+        return stats if stats is not None else {}
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        # XLA does not expose a reset; callers diff snapshots instead.
+        return None
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def empty_cache(self):
+        return None
+
+    # ---------------------------------------------------------------- dtypes
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # TPUs compute natively in bf16; fp16 storage is supported, and the
+        # fp16 dynamic-loss-scale path is kept for config parity.
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.float8_e4m3fn]
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+        return jnp.bfloat16
+
+    # ------------------------------------------------------------------- comm
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # -------------------------------------------------------------- op builder
+    def create_op_builder(self, op_name):
+        builder = self.get_op_builder(op_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, op_name):
+        from ..ops.op_builder import get_op_builder_class
+        return get_op_builder_class(op_name, accelerator_name=self._name)
+
+    # ------------------------------------------------------------------- misc
+    def is_available(self):
+        try:
+            jax = self._jax()
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def range_push(self, msg):
+        # Nested ranges form a stack (reference nvtx semantics).
+        stack = getattr(self, "_trace_stack", None)
+        if stack is None:
+            stack = []
+            self._trace_stack = stack
+        try:
+            import jax.profiler
+            ctx = jax.profiler.TraceAnnotation(msg)
+            ctx.__enter__()
+            stack.append(ctx)
+        except Exception:
+            stack.append(None)
+
+    def range_pop(self):
+        stack = getattr(self, "_trace_stack", None)
+        if stack:
+            ctx = stack.pop()
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+
+    def visible_devices_envs(self):
+        return ["TPU_VISIBLE_CHIPS", "TPU_PROCESS_BOUNDS"][:1]

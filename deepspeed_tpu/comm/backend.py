@@ -1,0 +1,259 @@
+"""Communication backend ABC + mesh-backed implementation.
+
+TPU-native re-design of the reference's backend stack
+(``deepspeed/comm/backend.py:25`` ABC, ``comm/torch.py:90`` TorchBackend):
+instead of wrapping torch.distributed process groups, a *group* here is a set of
+mesh axis names over a global ``jax.sharding.Mesh``; every collective is an XLA
+collective (`psum`, `all_gather`, `ppermute`, `all_to_all`) emitted via
+``shard_map`` over those axes, so the data never leaves HBM and the collective
+rides ICI (or DCN for a multi-slice axis).
+
+Two calling conventions are supported:
+
+* **eager / global-array**: collectives take a global (possibly sharded) jax
+  array and return a global array — used by engine bring-up code and tests;
+* **traced / axis-name** (``deepspeed_tpu.comm.functional``): thin ``jax.lax``
+  wrappers used *inside* shard_map/jit regions (Ulysses, MoE, pipeline p2p).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .reduce_op import ReduceOp
+from ..utils.logging import logger
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+# jax.jit caches by function identity, so the jitted collective for a given
+# (mesh, axes, op, ...) signature must be built once and reused — otherwise
+# every call retraces (review finding: hot-path throughput).  functools
+# lru_cache keyed on hashable params; Mesh is hashable.
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_all_reduce(mesh, axes, op, group_size):
+    red = _REDUCE_FNS.get(ReduceOp.SUM if op == ReduceOp.AVG else op)
+    if red is None:
+        raise ValueError(f"unsupported reduce op {op}")
+
+    def _k(blk):
+        r = red(blk, axes)
+        if op == ReduceOp.AVG:
+            r = r / group_size
+        return r
+
+    return jax.jit(jax.shard_map(_k, mesh=mesh, check_vma=False,
+                                 in_specs=(P(axes), ), out_specs=P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_all_gather(mesh, axes, axis, ndim, tiled):
+    in_spec = [None] * ndim
+    in_spec[axis] = axes
+    in_spec = P(*in_spec)
+
+    def _k(blk):
+        out = blk
+        for a in reversed(axes):
+            out = jax.lax.all_gather(out, a, axis=axis, tiled=tiled)
+        return out
+
+    return jax.jit(jax.shard_map(_k, mesh=mesh, check_vma=False,
+                                 in_specs=(in_spec, ), out_specs=P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_reduce_scatter(mesh, axes, op, axis, ndim, group_size):
+    out_spec = [None] * ndim
+    out_spec[axis] = axes
+    out_spec = P(*out_spec)
+
+    def _k(blk):
+        out = blk
+        for a in axes:
+            out = jax.lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / group_size
+        return out
+
+    return jax.jit(jax.shard_map(_k, mesh=mesh, check_vma=False,
+                                 in_specs=(P(), ), out_specs=out_spec))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_broadcast(mesh, axes, src, nblocks):
+
+    def _f(t):
+        block = t.shape[0] // nblocks
+
+        def _k(blk):
+            full = blk
+            for a in reversed(axes):
+                full = jax.lax.all_gather(full, a, axis=0, tiled=True)
+            return jax.lax.dynamic_slice_in_dim(full, src * block, block, axis=0)
+
+        return jax.shard_map(_k, mesh=mesh, check_vma=False,
+                             in_specs=(P(axes), ), out_specs=P())(t)
+
+    return jax.jit(_f)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_all_to_all(mesh, a, split_axis, concat_axis, ndim):
+    in_spec = [None] * ndim
+    in_spec[concat_axis] = a
+    in_spec = P(*in_spec)
+    out_spec = [None] * ndim
+    out_spec[split_axis] = a
+    out_spec = P(*out_spec)
+
+    def _k(blk):
+        return jax.lax.all_to_all(blk, a, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    return jax.jit(jax.shard_map(_k, mesh=mesh, check_vma=False,
+                                 in_specs=(in_spec, ), out_specs=out_spec))
+
+
+class ProcessGroup:
+    """A communication group = an ordered tuple of mesh axis names.
+
+    The analog of a torch.distributed process group (reference
+    ``comm/torch.py``); ``new_group(ranks)``-style arbitrary rank lists are
+    deliberately unsupported — groups are mesh-axis factored, which is the only
+    layout that maps onto ICI efficiently (SURVEY.md §2.4 TPU-equivalent note).
+    """
+
+    def __init__(self, mesh: Mesh, axis_names):
+        if isinstance(axis_names, str):
+            axis_names = (axis_names, )
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        for a in self.axis_names:
+            if a not in mesh.axis_names:
+                raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
+
+    def size(self):
+        return int(np.prod([self.mesh.shape[a] for a in self.axis_names], dtype=np.int64)) \
+            if self.axis_names else 1
+
+    def effective_axes(self):
+        """Axis names with size > 1 (size-1 axes are collective no-ops)."""
+        return tuple(a for a in self.axis_names if self.mesh.shape[a] > 1)
+
+    def __repr__(self):
+        return f"ProcessGroup(axes={self.axis_names}, size={self.size()})"
+
+
+class MeshBackend:
+    """The single comm backend: a global device mesh + collectives over it."""
+
+    def __init__(self, mesh: Mesh = None, name="ici"):
+        self.name = name
+        if mesh is None:
+            devices = np.array(jax.devices())
+            mesh = Mesh(devices, axis_names=("world", ))
+        self.mesh = mesh
+        self.world_group = ProcessGroup(mesh, mesh.axis_names)
+        self.initialized = True
+
+    # ----------------------------------------------------------------- identity
+    # Granularity note: under single-controller JAX there is one *process* per
+    # host but one *device* per chip.  ``world_size()`` is device-granular (one
+    # "rank" per chip, the reference's one-process-per-GPU model) because that
+    # is what partitioning math (ZeRO shard counts, batch splits) needs.
+    # ``rank()`` is the *process* index and is only valid for host-side
+    # concerns (logging, file naming, "is rank 0" checks); per-device ranks
+    # exist only inside shard_map via ``functional.axis_index``.  Do NOT write
+    # ``total // world_size() * rank()``-style partitioning with these.
+    def rank(self):
+        return jax.process_index()
+
+    def world_size(self):
+        return self.mesh.size
+
+    def process_count(self):
+        return jax.process_count()
+
+    # ----------------------------------------------------------------- helpers
+    def _group(self, group):
+        return group if group is not None else self.world_group
+
+    def _eager_collective(self, fn, x, group, extra_outputs=False):
+        """Run ``fn(block)`` under shard_map over ``group``'s axes.
+
+        ``x`` must be a global array whose leading dim is divisible by the
+        group size (for sharded ops) or any array (for reductions).
+        """
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- collectives
+    # Eager/global-array forms.  x is a jax array; if it is replicated the
+    # result is the reduction over per-axis *shards* of a leading-dim-sharded
+    # view.  The common case in framework code: x already sharded over the
+    # group axis on dim 0.
+    def all_reduce(self, x, op=ReduceOp.SUM, group=None):
+        group = self._group(group)
+        fn = _jit_all_reduce(group.mesh, group.axis_names, op, group.size())
+        return fn(x)
+
+    def all_gather(self, x, group=None, axis=0, tiled=True):
+        """Gather shards along ``axis``; input sharded over group axes."""
+        group = self._group(group)
+        fn = _jit_all_gather(group.mesh, group.axis_names, axis, x.ndim, tiled)
+        return fn(x)
+
+    def reduce_scatter(self, x, op=ReduceOp.SUM, group=None, axis=0):
+        """Reduce over the group and scatter along ``axis``.
+
+        Input replicated; output sharded along ``axis`` over group axes.
+        The ZeRO-2 gradient path (reference ``stage_1_and_2.py:1045``
+        ``average_tensor``) lowers to this.
+        """
+        group = self._group(group)
+        fn = _jit_reduce_scatter(group.mesh, group.axis_names, op, axis, x.ndim,
+                                 group.size())
+        return fn(x)
+
+    def broadcast(self, x, src=0, group=None):
+        """Broadcast ``src`` rank's shard to all ranks of the group.
+
+        With single-controller JAX a replicated global array is already
+        "broadcast"; this exists for API parity and for per-rank-distinct
+        arrays (input sharded on dim 0).
+        """
+        group = self._group(group)
+        fn = _jit_broadcast(group.mesh, group.axis_names, src, group.size())
+        return fn(x)
+
+    def all_to_all(self, x, group=None, split_axis=0, concat_axis=0):
+        """All-to-all: split ``split_axis`` across the group, concat received
+        chunks along ``concat_axis``.  Ulysses' reshard primitive (reference
+        ``sequence/layer.py:182 single_all_to_all``)."""
+        group = self._group(group)
+        eff = group.effective_axes()
+        if len(eff) == 0:
+            return x
+        if len(eff) != 1:
+            raise ValueError(
+                f"all_to_all requires a single (effective) mesh axis, got {eff}")
+        a = eff[0]
+        fn = _jit_all_to_all(group.mesh, a, split_axis, concat_axis, x.ndim)
+        return fn(x)
+
+    def barrier(self, group=None):
+        group = self._group(group)
+        # A psum across the group is a true cross-device barrier once waited on.
+        self.all_reduce(jnp.zeros((group.size(), )), op=ReduceOp.SUM,
+                        group=group).block_until_ready()
+
+    def log_summary(self):
+        logger.info(f"MeshBackend mesh={dict(self.mesh.shape)}")

@@ -1,0 +1,68 @@
+"""Axis-name collectives for use inside jit/shard_map regions.
+
+These are the traced-context counterpart of the eager facade in
+``deepspeed_tpu.comm``: thin wrappers over ``jax.lax`` collectives that take a
+mesh *axis name* (or tuple) instead of a group object.  Ulysses attention, MoE
+dispatch, pipeline p2p, and pallas-adjacent code call these; XLA lowers them to
+ICI/DCN collectives.
+
+The reference's analog is calling ``deepspeed.comm`` collectives on tensors
+inside the hot loop (e.g. ``sequence/layer.py:182``, ``runtime/pipe/p2p.py:46``)
+— here the hot loop is traced once, so these are ordinary lax primitives.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return jax.lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return jax.lax.pmin(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def send_next_recv_prev(x, axis_name, size):
+    """Pipeline p2p: shift ``x`` to the next rank along ``axis_name`` (ring).
+
+    Analog of reference ``runtime/pipe/p2p.py:46 send``/``:67 recv`` between
+    adjacent pipeline stages — on TPU this is a collective-permute that XLA
+    maps to neighbor ICI hops.
+    """
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send_prev_recv_next(x, axis_name, size):
+    perm = [(i, (i - 1) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,2 @@
+from .op_builder import (ALL_OPS, NativeOpBuilder, OpBuilder, PallasOpBuilder,
+                         get_op_builder_class, register_op_builder)

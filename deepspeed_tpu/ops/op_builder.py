@@ -1,0 +1,133 @@
+"""Op builder system — TPU-native analog of reference ``op_builder/builder.py``.
+
+The reference JIT-compiles CUDA extensions (``OpBuilder.load()``,
+``op_builder/builder.py:514,533``).  Here an "op" is either
+
+* a **Pallas kernel** (compiled by XLA at trace time — ``load()`` just returns
+  the python callable), or
+* a **native host extension** (C++ via the CPython C API / ctypes, e.g. the
+  async-IO library backing NVMe offload), compiled on demand with the system
+  toolchain.
+
+``ALL_OPS`` mirrors the reference's registry (``op_builder/all_ops.py``) and
+drives ``ds_report``'s compatibility matrix.
+"""
+
+import importlib
+import os
+import shutil
+import subprocess
+
+from ..utils.logging import logger
+
+
+class OpBuilder:
+    """Base builder (reference ``op_builder/builder.py:109``)."""
+
+    BUILD_DIR = os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops")
+
+    NAME = "base"
+
+    def __init__(self):
+        self._loaded = None
+
+    def name(self):
+        return self.NAME
+
+    def absolute_name(self):
+        return f"deepspeed_tpu.ops.{self.NAME}"
+
+    def is_compatible(self, verbose=False):
+        return True
+
+    def load(self, verbose=True):
+        if self._loaded is None:
+            self._loaded = self._load_impl()
+        return self._loaded
+
+    def _load_impl(self):
+        raise NotImplementedError
+
+
+class PallasOpBuilder(OpBuilder):
+    """An op implemented as jax/pallas code: load = import the module."""
+
+    MODULE = None  # dotted path under deepspeed_tpu
+
+    def is_compatible(self, verbose=False):
+        try:
+            importlib.import_module(self.MODULE)
+            return True
+        except Exception as e:
+            if verbose:
+                logger.warning(f"{self.NAME} incompatible: {e}")
+            return False
+
+    def _load_impl(self):
+        return importlib.import_module(self.MODULE)
+
+
+class NativeOpBuilder(OpBuilder):
+    """A host-side C++ extension compiled with g++ and loaded via ctypes."""
+
+    SOURCES = ()          # repo-relative .cpp paths
+    EXTRA_CFLAGS = ()
+    EXTRA_LDFLAGS = ()
+
+    def sources(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return [os.path.join(root, s) for s in self.SOURCES]
+
+    def is_compatible(self, verbose=False):
+        return shutil.which("g++") is not None and all(
+            os.path.exists(s) for s in self.sources())
+
+    def lib_path(self):
+        return os.path.join(self.BUILD_DIR, f"lib{self.NAME}.so")
+
+    def build(self, verbose=True):
+        os.makedirs(self.BUILD_DIR, exist_ok=True)
+        out = self.lib_path()
+        srcs = self.sources()
+        newest_src = max(os.path.getmtime(s) for s in srcs)
+        if os.path.exists(out) and os.path.getmtime(out) >= newest_src:
+            return out
+        cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] +
+               list(self.EXTRA_CFLAGS) + srcs + ["-o", out] +
+               list(self.EXTRA_LDFLAGS))
+        if verbose:
+            logger.info(f"building {self.NAME}: {' '.join(cmd)}")
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        return out
+
+    def _load_impl(self):
+        import ctypes
+        return ctypes.CDLL(self.build())
+
+
+# Registry: name → builder class.  Populated lazily by the ops modules to
+# avoid import cycles; see deepspeed_tpu/ops/__init__.py.
+ALL_OPS = {}
+
+
+def register_op_builder(cls):
+    ALL_OPS[cls.NAME] = cls
+    return cls
+
+
+def get_op_builder_class(op_name, accelerator_name="tpu"):
+    """Reference ``abstract_accelerator.py:271-286`` get_op_builder hook."""
+    _ensure_registered()
+    return ALL_OPS.get(op_name)
+
+
+def _ensure_registered():
+    # Import modules whose builders self-register.
+    if not ALL_OPS.get("_bootstrapped"):
+        ALL_OPS["_bootstrapped"] = True
+        for mod in ("deepspeed_tpu.ops.adam", "deepspeed_tpu.ops.lamb",
+                    "deepspeed_tpu.ops.lion", "deepspeed_tpu.ops.quantizer"):
+            try:
+                importlib.import_module(mod)
+            except ImportError:
+                pass

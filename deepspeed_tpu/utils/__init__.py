@@ -1,0 +1,3 @@
+from .logging import log_dist, logger, print_json_dist, warning_once
+from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
+from . import groups

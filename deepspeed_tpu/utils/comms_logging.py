@@ -1,0 +1,106 @@
+"""Comms logger — analog of reference ``deepspeed/utils/comms_logging.py:67``.
+
+Tracks per-op counts/sizes/latencies and computes algorithmic/bus bandwidth
+(``get_bw`` logic mirrors the reference's msg-size → busbw factors).
+"""
+
+import math
+
+from .logging import log_dist, logger
+
+
+def get_msg_size_from_args(x):
+    import numpy as np
+    try:
+        return int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def calc_bw_log(comm_op, size, duration, n):
+    """Return (algbw, busbw) in Gbps. Factors follow nccl-tests conventions,
+    as the reference does (``comms_logging.py`` ``get_bw``)."""
+    if duration <= 0:
+        return 0.0, 0.0
+    tput = size / duration  # bytes/sec
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        busbw = tput * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/reduce/barrier
+        busbw = tput
+    # bytes/sec → Gbits/sec
+    return tput * 8 / 1e9, busbw * 8 / 1e9
+
+
+class CommsLogger:
+
+    def __init__(self, enabled=False, verbose=False, prof_all=True, debug=False,
+                 prof_ops=None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        self.comms_dict = {}
+
+    def configure(self, comms_config):
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.prof_all = comms_config.comms_logger.prof_all
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+
+    def start_profiling_op(self, op_name_list):
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list):
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def append(self, raw_name, record_name, latency, msg_size, world_size):
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, world_size)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                entry = self.comms_dict[record_name][msg_size]
+                entry[0] += 1
+                entry[1].append(latency)
+                entry[2].append(algbw)
+                entry[3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(
+                f"rank=? | comm op: {record_name} | time(ms): {latency*1000:.2f} | "
+                f"msg size: {msg_size} | algbw(Gbps): {algbw:.2f} | busbw(Gbps): {busbw:.2f}",
+                ranks=[0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from ..utils.logging import logger
+        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
+                 f"{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]
+        for record_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(record_name)
+            for msg_size, (count, latencies, algbws, busbws) in sorted(sizes.items()):
+                total = sum(latencies) * 1000
+                avg = total / count
+                avg_alg = sum(algbws) / len(algbws)
+                avg_bus = sum(busbws) / len(busbws)
+                lines.append(f"{'':<20}{msg_size:<20}{count:<10}{total:<20.2f}"
+                             f"{avg:<20.2f}{avg_alg:<20.2f}{avg_bus:<20.2f}")
+        out = "\n".join(lines)
+        if print_log:
+            logger.info(out)
+        return self.comms_dict

@@ -1,0 +1,220 @@
+"""Process-group topology (L3) — mesh-axis factorization.
+
+TPU-native re-derivation of reference ``deepspeed/utils/groups.py:55-588`` +
+``runtime/pipe/topology.py``: instead of materializing rank lists and creating
+NCCL communicators per group, we build ONE global ``jax.sharding.Mesh`` whose
+named axes factor the device grid into
+
+    (pp, dp, sp, tp)   — pipeline / data / sequence / tensor axes
+
+with expert-parallel (ep) groups carved out of dp (reference
+``moe/layer.py:89 _create_process_groups``) and ZeRO secondary-partition (hpZ)
+groups as an intra-host sub-axis.  Any communication "group" is then just a
+tuple of axis names (see ``deepspeed_tpu.comm.backend.ProcessGroup``), and XLA
+lays the collectives onto ICI along those axes.
+
+Axis order: the *rightmost* mesh axes are most-minor (fastest-varying device
+index) and therefore map to physically-closest chips; we order
+(pp, dp, sp, tp) so tensor-parallel collectives (latency-bound, per-layer)
+ride the shortest ICI hops, matching how Megatron orders NCCL groups.
+"""
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .logging import logger
+
+# Canonical axis names, most-major → most-minor.
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+# Expert parallelism reuses a reshape of (dp,) — see expert_mesh().
+EP_AXIS = "ep"
+EDP_AXIS = "expert_dp"
+# hpZ (ZeRO++ secondary partition) axes: dp = zp_outer × zp
+ZP_AXIS = "zp"
+ZP_OUTER_AXIS = "zp_outer"
+
+_mesh_state = None
+
+
+@dataclass
+class MeshState:
+    mesh: Mesh
+    pp: int
+    dp: int
+    sp: int
+    tp: int
+    ep: int = 1
+    # expert mesh shares devices with `mesh` but reshapes dp → (expert_dp, ep)
+    expert_mesh: Mesh = None
+    # hpZ mesh reshapes dp → (zp_outer, zp); params secondarily replicated
+    # within the (intra-host) zp axis
+    hpz_mesh: Mesh = None
+    zero_partition_size: int = None  # hpZ secondary partition (ranks per shard group)
+
+
+def _check_sizes(total, pp, dp, sp, tp):
+    if pp * dp * sp * tp != total:
+        raise ValueError(
+            f"pp({pp}) * dp({dp}) * sp({sp}) * tp({tp}) = {pp*dp*sp*tp} "
+            f"!= device count {total}")
+
+
+def initialize_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None,
+                    zero_partition_size=None):
+    """Build the global mesh. ``dp=None`` → use all remaining devices.
+
+    Analog of reference ``deepspeed.initialize``'s mesh_device creation
+    (``deepspeed/__init__.py:153-162``) plus ``PipelineParallelGrid``
+    (``runtime/pipe/topology.py:251``) in one step.
+    """
+    global _mesh_state
+    if devices is None:
+        devices = np.array(jax.devices())
+    else:
+        devices = np.asarray(devices)
+    total = devices.size
+    if dp is None:
+        rem = pp * sp * tp
+        if total % rem != 0:
+            raise ValueError(f"device count {total} not divisible by pp*sp*tp={rem}")
+        dp = total // rem
+    _check_sizes(total, pp, dp, sp, tp)
+    if dp % ep != 0:
+        raise ValueError(f"expert parallel size ep={ep} must divide dp={dp} "
+                         f"(reference moe/layer.py:89 semantics)")
+
+    grid = devices.reshape(pp, dp, sp, tp)
+    mesh = Mesh(grid, axis_names=(PP_AXIS, DP_AXIS, SP_AXIS, TP_AXIS))
+
+    # Expert mesh shares the same devices; built unconditionally (cheap) so
+    # ep=1 accessors still work.
+    egrid = devices.reshape(pp, dp // ep, ep, sp, tp)
+    expert_mesh = Mesh(egrid, axis_names=(PP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
+
+    # hpZ secondary-partition mesh: dp factored into (outer, inner) where the
+    # inner axis groups physically-adjacent chips (intra-host) — reference
+    # groups.py:531 _create_zero_param_parallel_group.
+    hpz_mesh = None
+    if zero_partition_size and zero_partition_size > 1:
+        if dp % zero_partition_size != 0:
+            raise ValueError(
+                f"zero_partition_size={zero_partition_size} must divide dp={dp}")
+        zgrid = devices.reshape(pp, dp // zero_partition_size,
+                                zero_partition_size, sp, tp)
+        hpz_mesh = Mesh(zgrid, axis_names=(PP_AXIS, ZP_OUTER_AXIS, ZP_AXIS,
+                                           SP_AXIS, TP_AXIS))
+
+    _mesh_state = MeshState(mesh=mesh, pp=pp, dp=dp, sp=sp, tp=tp, ep=ep,
+                            expert_mesh=expert_mesh, hpz_mesh=hpz_mesh,
+                            zero_partition_size=zero_partition_size)
+    logger.debug(f"initialized mesh pp={pp} dp={dp} sp={sp} tp={tp} ep={ep}")
+    return _mesh_state
+
+
+def mesh_is_initialized():
+    return _mesh_state is not None
+
+
+def get_mesh_state() -> MeshState:
+    if _mesh_state is None:
+        initialize_mesh()
+    return _mesh_state
+
+
+def reset_mesh():
+    global _mesh_state
+    _mesh_state = None
+
+
+def get_global_mesh() -> Mesh:
+    return get_mesh_state().mesh
+
+
+def get_expert_mesh() -> Mesh:
+    return get_mesh_state().expert_mesh
+
+
+# ----------------------------------------------------------------- group API
+# Accessor names mirror reference utils/groups.py so engine code reads the same.
+
+def _pg(axes, mesh=None):
+    from ..comm.backend import ProcessGroup
+    return ProcessGroup(mesh or get_global_mesh(), axes)
+
+
+def _get_data_parallel_group():
+    return _pg((DP_AXIS, ))
+
+
+def _get_sequence_parallel_group():
+    return _pg((SP_AXIS, ))
+
+
+def _get_sequence_data_parallel_group():
+    """ZeRO shards over the combined seq×dp group when SP is on (reference
+    ``engine.py:1580,1651`` seq_data_parallel_group)."""
+    return _pg((DP_AXIS, SP_AXIS))
+
+
+def _get_model_parallel_group():
+    return _pg((TP_AXIS, ))
+
+
+def _get_pipe_parallel_group():
+    return _pg((PP_AXIS, ))
+
+
+def _get_expert_parallel_group():
+    return _pg((EP_AXIS, ), mesh=get_expert_mesh())
+
+
+def _get_expert_data_parallel_group():
+    return _pg((EDP_AXIS, ), mesh=get_expert_mesh())
+
+
+def _get_zero_param_partition_group():
+    """hpZ secondary partition group (reference ``groups.py:531``): params are
+    secondarily replicated within this group so allgather rides intra-host ICI."""
+    st = get_mesh_state()
+    if st.hpz_mesh is None:
+        return None
+    return _pg((ZP_AXIS, ), mesh=st.hpz_mesh)
+
+
+def _get_data_parallel_world_size():
+    return get_mesh_state().dp
+
+
+def _get_sequence_parallel_world_size():
+    return get_mesh_state().sp
+
+
+def _get_model_parallel_world_size():
+    return get_mesh_state().tp
+
+
+def _get_pipe_parallel_world_size():
+    return get_mesh_state().pp
+
+
+def _get_expert_parallel_world_size():
+    return get_mesh_state().ep
+
+
+def _get_data_parallel_rank():
+    # Single-controller: per-device rank only meaningful inside shard_map; for
+    # host-level code return process-level dp coordinate (0 on single host).
+    return 0
+
+
+def zero_sharding_axes(sequence_parallel=False):
+    """Mesh axes over which ZeRO partitions optimizer/grad/param state."""
+    return (DP_AXIS, SP_AXIS) if sequence_parallel else (DP_AXIS, )

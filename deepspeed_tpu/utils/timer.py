@@ -1,0 +1,199 @@
+"""Timers — analog of reference ``deepspeed/utils/timer.py``.
+
+``SynchronizedWallClockTimer`` (reference ``timer.py:44``) with jax
+block_until_ready in place of CUDA events; ``ThroughputTimer`` (reference
+``timer.py:199``) reports samples/sec and TFLOPS.
+"""
+
+import time
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+class SynchronizedWallClockTimer:
+
+    class Timer:
+
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = 0.0
+            self.elapsed_ = 0.0
+            self.records = []
+
+        def start(self, sync=False):
+            assert not self.started_, f"{self.name_} timer already started"
+            if sync:
+                self._sync()
+            self.start_time = time.perf_counter()
+            self.started_ = True
+
+        def stop(self, reset=False, record=True, sync=False):
+            assert self.started_, f"{self.name_} timer not started"
+            if sync:
+                self._sync()
+            elapsed = time.perf_counter() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            if record:
+                self.records.append(elapsed * 1000.0)
+            self.started_ = False
+
+        def _sync(self):
+            from ..accelerator import get_accelerator
+            get_accelerator().synchronize()
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop(record=False)
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def mean(self):
+            return (sum(self.records) / len(self.records)) if self.records else 0.0
+
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    @staticmethod
+    def memory_usage():
+        from ..accelerator import get_accelerator
+        acc = get_accelerator()
+        alloc = acc.memory_allocated() / (1024**3)
+        peak = acc.max_memory_allocated() / (1024**3)
+        return f"mem_alloc={alloc:.2f}GB peak={peak:.2f}GB"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+
+class NoopTimer:
+    """Reference ``timer.py:164`` — disabled-timer stand-in."""
+
+    class Timer:
+
+        def start(self, **kwargs):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def reset(self):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0.0
+
+        def mean(self):
+            return 0.0
+
+    def __init__(self):
+        self.timer = self.Timer()
+
+    def __call__(self, name):
+        return self.timer
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names, **kwargs):
+        ...
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS reporting (reference ``timer.py:199``)."""
+
+    def __init__(self, config, batch_size, start_step=2, steps_per_output=None,
+                 monitor_memory=False, logging_fn=None):
+        self.config = config
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    @property
+    def enabled(self):
+        return getattr(self.config, "enabled", True)
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def start(self):
+        if not self.enabled:
+            return
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.enabled or not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        duration = time.perf_counter() - self.start_time
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count >= self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+                if report_speed and self.steps_per_output and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
+                        f"{self.avg_samples_per_sec():.2f}, CurrSamplesPerSec="
+                        f"{self.batch_size / self.step_elapsed_time:.2f}")
+                # Reset every global step so CurrSamplesPerSec reflects the
+                # latest step only (reference timer.py behavior).
+                self.step_elapsed_time = 0.0
+            else:
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step + 1)
+            return samples / self.total_elapsed_time
+        return 0.0

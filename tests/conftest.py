@@ -1,0 +1,38 @@
+"""Test harness configuration.
+
+TPU analog of the reference's distributed test strategy (SURVEY.md §4): instead
+of spawning N processes with a FileStore rendezvous (reference
+``tests/unit/common.py:326``), we run single-process JAX with a *virtual
+8-device CPU mesh* (``--xla_force_host_platform_device_count=8``) so every
+mesh-axis collective (dp/sp/pp/tp/ep) executes with real SPMD semantics.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.  Force-override: the ambient
+# environment may pin JAX_PLATFORMS to the real TPU tunnel (e.g. "axon").
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+# sitecustomize may have imported jax already (TPU tunnel registration), so the
+# env var alone is not enough — update the config knob too, before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a fresh mesh/comm world (analog of per-test process
+    groups in the reference's DistributedTest)."""
+    yield
+    from deepspeed_tpu.utils import groups
+    from deepspeed_tpu import comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()

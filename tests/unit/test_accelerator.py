@@ -1,0 +1,49 @@
+"""Accelerator abstraction tests (reference tests/unit/accelerator analog)."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator_name
+
+
+def test_get_accelerator_cpu():
+    acc = get_accelerator()
+    assert acc._name in ("cpu", "tpu")
+    assert acc.is_available()
+    assert acc.device_count() >= 1
+
+
+def test_device_api():
+    acc = get_accelerator()
+    d = acc.device(0)
+    assert d is not None
+    assert acc.current_device() == 0
+    acc.set_device(0)
+    assert acc.current_device_name().endswith(":0")
+
+
+def test_dtypes():
+    acc = get_accelerator()
+    assert acc.is_bf16_supported()
+    assert jnp.float32 in acc.supported_dtypes()
+    assert acc.preferred_dtype() in (jnp.float32, jnp.bfloat16)
+
+
+def test_rng():
+    acc = get_accelerator()
+    key = acc.random_key(0)
+    assert key is not None
+    acc.manual_seed(123)
+    assert acc.initial_seed() == 123
+
+
+def test_comm_backend_name():
+    acc = get_accelerator()
+    assert acc.communication_backend_name() in ("gloo", "ici")
+
+
+def test_visible_devices_envs():
+    acc = set_accelerator_name("tpu")
+    env = {}
+    acc.set_visible_devices_envs(env, [0, 1])
+    assert env.get("TPU_VISIBLE_CHIPS") == "0,1"
+    set_accelerator_name("cpu")

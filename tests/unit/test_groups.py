@@ -1,0 +1,67 @@
+"""Mesh topology / group calculus tests (reference utils/groups.py +
+runtime/pipe/topology.py analog)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils import groups
+
+
+def test_default_mesh_all_dp():
+    st = groups.initialize_mesh()
+    assert st.dp == 8 and st.pp == 1 and st.sp == 1 and st.tp == 1
+    assert st.mesh.shape["dp"] == 8
+
+
+def test_mesh_factorization():
+    st = groups.initialize_mesh(pp=2, dp=2, sp=1, tp=2)
+    assert st.mesh.size == 8
+    assert groups._get_pipe_parallel_world_size() == 2
+    assert groups._get_data_parallel_world_size() == 2
+    assert groups._get_model_parallel_world_size() == 2
+
+
+def test_invalid_factorization_raises():
+    with pytest.raises(ValueError):
+        groups.initialize_mesh(pp=3, dp=3)
+
+
+def test_expert_mesh():
+    st = groups.initialize_mesh(dp=8, ep=4)
+    assert st.expert_mesh.shape["ep"] == 4
+    assert st.expert_mesh.shape["expert_dp"] == 2
+    g = groups._get_expert_parallel_group()
+    assert g.size() == 4
+    g2 = groups._get_expert_data_parallel_group()
+    assert g2.size() == 2
+
+
+def test_ep_must_divide_dp():
+    with pytest.raises(ValueError):
+        groups.initialize_mesh(dp=8, ep=3)
+
+
+def test_seq_data_parallel_group():
+    groups.initialize_mesh(dp=4, sp=2)
+    g = groups._get_sequence_data_parallel_group()
+    assert g.size() == 8
+    assert groups._get_sequence_parallel_world_size() == 2
+
+
+def test_zero_sharding_axes():
+    groups.initialize_mesh(dp=4, sp=2)
+    assert groups.zero_sharding_axes(sequence_parallel=True) == ("dp", "sp")
+    assert groups.zero_sharding_axes() == ("dp", )
+
+
+def test_hpz_mesh():
+    st = groups.initialize_mesh(dp=8, zero_partition_size=4)
+    assert st.hpz_mesh is not None
+    g = groups._get_zero_param_partition_group()
+    assert g.size() == 4
+    assert g.axis_names == ("zp", )
+
+
+def test_hpz_must_divide_dp():
+    with pytest.raises(ValueError):
+        groups.initialize_mesh(dp=8, zero_partition_size=3)
