@@ -55,7 +55,7 @@ def initialize(args=None,
     ds_config = DeepSpeedConfig(config, mesh_param=mesh_param)
 
     if isinstance(model, PipelineModule):
-        from .runtime.pipe.engine import PipelineEngine
+        from .runtime.pipe.engine import PipelineEngine  # noqa
         engine = PipelineEngine(args=args,
                                 model=model,
                                 optimizer=optimizer,

@@ -164,4 +164,4 @@ class TPU_Accelerator(DeepSpeedAccelerator):
                 ctx.__exit__(None, None, None)
 
     def visible_devices_envs(self):
-        return ["TPU_VISIBLE_CHIPS", "TPU_PROCESS_BOUNDS"][:1]
+        return ["TPU_VISIBLE_CHIPS"]

@@ -187,14 +187,6 @@ class MeshBackend:
     def _group(self, group):
         return group if group is not None else self.world_group
 
-    def _eager_collective(self, fn, x, group, extra_outputs=False):
-        """Run ``fn(block)`` under shard_map over ``group``'s axes.
-
-        ``x`` must be a global array whose leading dim is divisible by the
-        group size (for sharded ops) or any array (for reductions).
-        """
-        raise NotImplementedError
-
     # -------------------------------------------------------------- collectives
     # Eager/global-array forms.  x is a jax array; if it is replicated the
     # result is the reduction over per-axis *shards* of a leading-dim-sharded

@@ -53,6 +53,8 @@ def configure(config=None, enabled=None, prof_all=None, prof_ops=None, verbose=N
 
 
 def timed_op(func):
+    import inspect
+    sig = inspect.signature(func)
 
     @functools.wraps(func)
     def wrapper(*args, log_name=None, **kwargs):
@@ -68,9 +70,11 @@ def timed_op(func):
         except Exception:
             pass
         latency = time.perf_counter() - t0
-        x = args[0] if args else kwargs.get("tensor")
+        # Bind args so a positionally-passed group is still found.
+        bound = sig.bind_partial(*args, **kwargs).arguments
+        x = bound.get("tensor", args[0] if args else None)
         msg_size = get_msg_size_from_args(x) if x is not None else 0
-        group = kwargs.get("group")
+        group = bound.get("group")
         ws = group.size() if group is not None else (cdb.world_size() if cdb else 1)
         comms_logger.append(func.__name__, name, latency, msg_size, ws)
         return result
@@ -219,3 +223,10 @@ def log_summary(show_straggler=False):
 def destroy_process_group():
     global cdb
     cdb = None
+    # Drop jitted-collective caches so stale Mesh objects and their XLA
+    # executables can be garbage collected.
+    from . import backend as _backend
+    for fn in (_backend._jit_all_reduce, _backend._jit_all_gather,
+               _backend._jit_reduce_scatter, _backend._jit_broadcast,
+               _backend._jit_all_to_all):
+        fn.cache_clear()

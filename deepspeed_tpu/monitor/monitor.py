@@ -1,0 +1,106 @@
+"""Monitoring — analog of reference ``monitor/monitor.py:30`` (MonitorMaster
+fan-out to TensorBoard / W&B / CSV).  Backends are optional; missing packages
+degrade to disabled with a warning (reference behavior)."""
+
+import csv
+import os
+
+from ..utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, config):
+        self.config = config
+        self.enabled = getattr(config, "enabled", False)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                out = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=out)
+            except ImportError:
+                logger.warning("tensorboard not available; disabling TB monitor")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group,
+                           entity=config.team)
+                self._wandb = wandb
+            except ImportError:
+                logger.warning("wandb not available; disabling wandb monitor")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csv_monitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        if self.enabled:
+            self.output_path = os.path.join(config.output_path or "./csv_logs",
+                                            config.job_name)
+            os.makedirs(self.output_path, exist_ok=True)
+            self._files = {}
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(self.output_path,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Reference ``monitor/monitor.py:30``: dispatch to enabled backends."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = csv_monitor(monitor_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list):
+        if self.tb_monitor.enabled:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor.enabled:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor.enabled:
+            self.csv_monitor.write_events(event_list)

@@ -1,0 +1,115 @@
+"""Fused Adam/AdamW — TPU answer to reference ``csrc/adam/multi_tensor_adam.cu``
++ ``deepspeed/ops/adam/fused_adam.py:18`` (FusedAdam) and
+``cpu_adam.cpp`` (DeepSpeedCPUAdam, reference ``csrc/adam``).
+
+Design: optax-style ``GradientTransformation`` whose update math is a single
+fused elementwise region — XLA fuses the whole tree update into one kernel per
+buffer, which on TPU matches what multi-tensor-apply achieves on CUDA.  A
+Pallas variant (``deepspeed_tpu.ops.pallas.fused_adam``) exists for the cases
+XLA's fusion falls short (interleaved master-weight cast + update).
+
+The ``step`` counter lives in the optimizer state (bias correction), matching
+``FusedAdam``'s semantics (bias_correction=True, adam_w_mode=True by default).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .op_builder import PallasOpBuilder, register_op_builder
+
+
+class ScaleByAdamState(NamedTuple):
+    count: jnp.ndarray  # int32 scalar
+    mu: any
+    nu: any
+
+
+class GradientTransformation(NamedTuple):
+    """Minimal optax-compatible pair (init, update)."""
+    init: callable
+    update: callable
+
+
+def _bias_correction(decay, count):
+    return 1.0 - decay**count
+
+
+def fused_adam(lr=1e-3,
+               betas=(0.9, 0.999),
+               eps=1e-8,
+               weight_decay=0.0,
+               adam_w_mode=True,
+               bias_correction=True,
+               lr_fn=None):
+    """FusedAdam/FusedAdamW (reference ``ops/adam/fused_adam.py:18``).
+
+    ``lr_fn``: optional schedule step→lr overriding ``lr`` (engine wires the
+    LR scheduler through this).
+    """
+    b1, b2 = betas
+
+    def init(params):
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return ScaleByAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu)
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cur_lr = lr_fn(count) if lr_fn is not None else lr
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not adam_w_mode and weight_decay != 0.0:
+                g = g + weight_decay * p32  # L2 mode (reference adam_w_mode=False)
+            m_ = b1 * m + (1 - b1) * g
+            v_ = b2 * v + (1 - b2) * (g * g)
+            if bias_correction:
+                m_hat = m_ / _bias_correction(b1, count.astype(jnp.float32))
+                v_hat = v_ / _bias_correction(b2, count.astype(jnp.float32))
+            else:
+                m_hat, v_hat = m_, v_
+            step = m_hat / (jnp.sqrt(v_hat) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                step = step + weight_decay * p32  # decoupled decay
+            return (-cur_lr * step).astype(p.dtype), m_, v_
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        outs = [upd(g, m, v, p) for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p)]
+        updates = treedef.unflatten([o[0] for o in outs])
+        mu = treedef.unflatten([o[1] for o in outs])
+        nu = treedef.unflatten([o[2] for o in outs])
+        return updates, ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return GradientTransformation(init=init, update=update)
+
+
+def fused_adamw(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01,
+                **kw):
+    return fused_adam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                      adam_w_mode=True, **kw)
+
+
+def cpu_adam(*args, **kwargs):
+    """DeepSpeedCPUAdam analog: same math; the *placement* (host memory) is
+    decided by the ZeRO-Offload sharding policy, not the optimizer (reference
+    keeps a separate AVX C++ impl because torch CPU Adam is slow; XLA:CPU
+    vectorizes this fine)."""
+    return fused_adam(*args, **kwargs)
+
+
+@register_op_builder
+class FusedAdamBuilder(PallasOpBuilder):
+    NAME = "fused_adam"
+    MODULE = "deepspeed_tpu.ops.adam"
+
+
+@register_op_builder
+class CPUAdamBuilder(PallasOpBuilder):
+    NAME = "cpu_adam"
+    MODULE = "deepspeed_tpu.ops.adam"

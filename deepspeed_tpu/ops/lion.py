@@ -1,0 +1,100 @@
+"""Fused Lion — TPU answer to reference ``csrc/lion/multi_tensor_lion.cu`` +
+``cpu_lion.cpp`` (``FusedLion``/``DeepSpeedCPULion``).
+
+Lion: sign-of-interpolated-momentum update; decoupled weight decay.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .adam import GradientTransformation
+from .op_builder import PallasOpBuilder, register_op_builder
+
+
+class ScaleByLionState(NamedTuple):
+    count: jnp.ndarray
+    mu: any
+
+
+def fused_lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, lr_fn=None):
+    b1, b2 = betas
+
+    def init(params):
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu)
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cur_lr = lr_fn(count) if lr_fn is not None else lr
+
+        def upd(g, m, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            c = b1 * m + (1 - b1) * g
+            step = jnp.sign(c)
+            if weight_decay != 0.0:
+                step = step + weight_decay * p32
+            m_ = b2 * m + (1 - b2) * g
+            return (-cur_lr * step).astype(p.dtype), m_
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_p = treedef.flatten_up_to(params)
+        outs = [upd(g, m, p) for g, m, p in zip(flat_g, flat_m, flat_p)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                ScaleByLionState(count=count,
+                                 mu=treedef.unflatten([o[1] for o in outs])))
+
+    return GradientTransformation(init=init, update=update)
+
+
+def sgd(lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False, lr_fn=None):
+    """Plain SGD (reference maps config "sgd" to torch.optim.SGD)."""
+
+    def init(params):
+        if momentum == 0.0:
+            return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=())
+        mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu)
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cur_lr = lr_fn(count) if lr_fn is not None else lr
+
+        def upd(g, m, p):
+            g = g.astype(jnp.float32)
+            if weight_decay != 0.0:
+                g = g + weight_decay * p.astype(jnp.float32)
+            if momentum == 0.0:
+                return (-cur_lr * g).astype(p.dtype), m
+            m_ = momentum * m + g
+            d = (g + momentum * m_) if nesterov else m_
+            return (-cur_lr * d).astype(p.dtype), m_
+
+        if momentum == 0.0:
+            updates = jax.tree_util.tree_map(
+                lambda g, p: upd(g, None, p)[0], grads, params)
+            return updates, ScaleByLionState(count=count, mu=state.mu)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_p = treedef.flatten_up_to(params)
+        outs = [upd(g, m, p) for g, m, p in zip(flat_g, flat_m, flat_p)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                ScaleByLionState(count=count,
+                                 mu=treedef.unflatten([o[1] for o in outs])))
+
+    return GradientTransformation(init=init, update=update)
+
+
+@register_op_builder
+class FusedLionBuilder(PallasOpBuilder):
+    NAME = "fused_lion"
+    MODULE = "deepspeed_tpu.ops.lion"
+
+
+@register_op_builder
+class CPULionBuilder(PallasOpBuilder):
+    NAME = "cpu_lion"
+    MODULE = "deepspeed_tpu.ops.lion"

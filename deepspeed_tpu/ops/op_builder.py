@@ -121,10 +121,14 @@ def get_op_builder_class(op_name, accelerator_name="tpu"):
     return ALL_OPS.get(op_name)
 
 
+_registered = False
+
+
 def _ensure_registered():
     # Import modules whose builders self-register.
-    if not ALL_OPS.get("_bootstrapped"):
-        ALL_OPS["_bootstrapped"] = True
+    global _registered
+    if not _registered:
+        _registered = True
         for mod in ("deepspeed_tpu.ops.adam", "deepspeed_tpu.ops.lamb",
                     "deepspeed_tpu.ops.lion", "deepspeed_tpu.ops.quantizer"):
             try:

@@ -1,0 +1,372 @@
+"""DeepSpeed-compatible JSON config system.
+
+Analog of reference ``runtime/config.py:706`` (``DeepSpeedConfig``) with the
+same JSON schema — the batch-size trinity, optimizer/scheduler sections,
+fp16/bf16, zero_optimization, gradient clipping, monitoring, comms logging,
+flops profiler, activation checkpointing, pipeline and mesh topology.
+
+TPU-specific addition: a ``"mesh"`` section (``{"pp":1,"dp":-1,"sp":1,"tp":1,
+"ep":1}``) declaring the device-grid factorization; absent, it is derived from
+``pipeline``/``tensor_parallel``/``sequence_parallel_size`` keys the reference
+spreads across subsystems.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from .config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
+from .zero.config import DeepSpeedZeroConfig
+from ..utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM_OPTIMIZER = "fusedadam"
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB_OPTIMIZER = "fusedlamb"
+LION_OPTIMIZER = "lion"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+SGD_OPTIMIZER = "sgd"
+MUON_OPTIMIZER = "muon"
+
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, LAMB_OPTIMIZER,
+    FUSED_LAMB_OPTIMIZER, LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER,
+]
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """Reference fp16 section (``runtime/fp16/loss_scaler.py`` consumers)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 = dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, ge=1)
+    hysteresis: int = Field(2, ge=1)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = True
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = []
+
+
+class CommsConfig(DeepSpeedConfigModel):
+    comms_logger: CommsLoggerConfig = CommsLoggerConfig()
+
+    @property
+    def comms_logger_enabled(self):
+        return self.comms_logger.enabled
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    """Reference ``monitor/config.py``: tensorboard/wandb/comet/csv."""
+
+    class TensorBoardConfig(DeepSpeedConfigModel):
+        enabled: bool = False
+        output_path: str = ""
+        job_name: str = "DeepSpeedJobName"
+
+    class WandbConfig(DeepSpeedConfigModel):
+        enabled: bool = False
+        group: Optional[str] = None
+        team: Optional[str] = None
+        project: str = "deepspeed"
+
+    class CSVConfig(DeepSpeedConfigModel):
+        enabled: bool = False
+        output_path: str = ""
+        job_name: str = "DeepSpeedJobName"
+
+    tensorboard: TensorBoardConfig = TensorBoardConfig()
+    wandb: WandbConfig = WandbConfig()
+    csv_monitor: CSVConfig = CSVConfig()
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/activation_checkpointing/config.py`` schema; on TPU
+    this steers ``jax.checkpoint`` policies (SURVEY.md §7)."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: Union[int, str] = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+    # TPU addition: microbatch schedule executed inside one jitted program
+    schedule: str = "1f1b"  # or "gpipe"
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU device-grid factorization (dp=-1 → all remaining devices)."""
+    pp: int = Field(1, ge=1)
+    dp: int = -1
+    sp: int = Field(1, ge=1)
+    tp: int = Field(1, ge=1)
+    ep: int = Field(1, ge=1)
+
+
+class GradientClippingConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = {}
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class AioConfig(DeepSpeedConfigModel):
+    """Reference ``csrc/aio`` tuning knobs (``deepspeed/runtime/swap_tensor``)."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = [2, 4, 6]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch_size: bool = True
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfig:
+    """Parsed + validated master config (reference ``runtime/config.py:706``)."""
+
+    def __init__(self, config: Union[str, Dict, None], mpu=None, mesh_param=None):
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(
+                    f"DeepSpeed config path does not exist: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif isinstance(config, DeepSpeedConfig):
+            self._param_dict = dict(config._param_dict)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict, got {type(config)}")
+
+        self.mesh_param = mesh_param
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------ parse
+    def _initialize_params(self, pd):
+        """Reference ``runtime/config.py:801 _initialize_params``."""
+        self.train_batch_size = pd.get("train_batch_size", None)
+        self.train_micro_batch_size_per_gpu = pd.get(
+            "train_micro_batch_size_per_gpu", None)
+        self.gradient_accumulation_steps = pd.get("gradient_accumulation_steps", None)
+        self.steps_per_print = pd.get("steps_per_print", 10)
+        self.dump_state = pd.get("dump_state", False)
+        self.disable_allgather = pd.get("disable_allgather", False)
+        self.communication_data_type = pd.get("communication_data_type", None)
+        self.seq_parallel_communication_data_type = pd.get(
+            "seq_parallel_comm_data_type", "fp32")
+        self.prescale_gradients = pd.get("prescale_gradients", False)
+        self.gradient_predivide_factor = pd.get("gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled = pd.get("sparse_gradients", False)
+
+        self.zero_config = DeepSpeedZeroConfig(**pd.get("zero_optimization", {}) or {})
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.fp16_config = FP16Config(**pd.get("fp16", {}) or {})
+        self.bf16_config = BF16Config(**pd.get("bfloat16", pd.get("bf16", {})) or {})
+        self.fp16_enabled = self.fp16_config.enabled
+        self.bfloat16_enabled = self.bf16_config.enabled
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.fp16_auto_cast = self.fp16_config.auto_cast
+        self.loss_scale = self.fp16_config.loss_scale
+        self.initial_dynamic_scale = 2**self.fp16_config.initial_scale_power
+        self.dynamic_loss_scale_args = {
+            "init_scale": 2**self.fp16_config.initial_scale_power,
+            "scale_window": self.fp16_config.loss_scale_window,
+            "min_scale": self.fp16_config.min_loss_scale,
+            "delayed_shift": self.fp16_config.hysteresis,
+        }
+
+        grad_clip = pd.get("gradient_clipping", 0.0)
+        self.gradient_clipping = float(grad_clip) if grad_clip else 0.0
+
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = False
+        opt = pd.get("optimizer")
+        if opt:
+            self.optimizer_name = str(opt.get("type", "")).lower()
+            self.optimizer_params = opt.get("params", {})
+            self.optimizer_legacy_fusion = opt.get("legacy_fusion", False)
+
+        self.scheduler_name = None
+        self.scheduler_params = None
+        sched = pd.get("scheduler")
+        if sched:
+            self.scheduler_name = sched.get("type")
+            self.scheduler_params = sched.get("params", {})
+
+        self.wall_clock_breakdown = pd.get("wall_clock_breakdown", False)
+        self.memory_breakdown = pd.get("memory_breakdown", False)
+        self.monitor_config = MonitorConfig(**{
+            k: v
+            for k, v in pd.items()
+            if k in ("tensorboard", "wandb", "csv_monitor")
+        })
+        self.comms_config = CommsConfig(**pd.get("comms_logger", {})
+                                        and {"comms_logger": pd.get("comms_logger")})
+        self.flops_profiler_config = FlopsProfilerConfig(
+            **pd.get("flops_profiler", {}) or {})
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **pd.get("activation_checkpointing", {}) or {})
+        self.pipeline_config = PipelineConfig(**pd.get("pipeline", {}) or {})
+        self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}) or {})
+        self.data_types_config = DataTypesConfig(**pd.get("data_types", {}) or {})
+        self.aio_config = AioConfig(**pd.get("aio", {}) or {})
+        self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}) or {})
+
+        self.gradient_accumulation_dtype = self.data_types_config.grad_accum_dtype
+
+        # Mesh factorization (TPU addition): explicit "mesh" block wins, else
+        # derive from reference-style keys.
+        mesh_dict = dict(pd.get("mesh", {}) or {})
+        if "tensor_parallel" in pd:
+            mesh_dict.setdefault("tp", pd["tensor_parallel"].get("tp_size", 1))
+        if "sequence_parallel_size" in pd:
+            mesh_dict.setdefault("sp", pd["sequence_parallel_size"])
+        if self.mesh_param is not None:
+            # mesh_param: tuple (dp, sp) like reference initialize() :153-162
+            mesh_dict.setdefault("dp", self.mesh_param[0])
+            if len(self.mesh_param) > 1:
+                mesh_dict.setdefault("sp", self.mesh_param[1])
+        self.mesh_config = MeshConfig(**mesh_dict)
+
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
+
+        self.seed = pd.get("seed", 1234)
+        self.compile_config = pd.get("compile", {})
+        self.graph_harvesting = pd.get("graph_harvesting", False)
+        self.train_data_config = pd.get("data_efficiency", {})
+        self.curriculum_enabled_legacy = bool(
+            pd.get("curriculum_learning", {}).get("enabled", False))
+        self.curriculum_params_legacy = pd.get("curriculum_learning", {})
+
+    # ----------------------------------------------------- batch size trinity
+    def _configure_train_batch_size(self):
+        """Resolve train_batch = micro_batch * grad_accum * dp_world
+        (reference ``runtime/config.py`` ``_set_batch_related_parameters``)."""
+        self._dp_degree = None  # resolved lazily once mesh exists
+
+        tb = self.train_batch_size
+        mb = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        # Defer full resolution to resolve_batch_sizes(dp) — record raw here.
+        self._raw_batch = (tb, mb, gas)
+
+    def resolve_batch_sizes(self, dp_world_size):
+        """Complete the trinity given the DP degree (called by the engine once
+        the mesh is built).  Mirrors reference assertions (~config.py:837+)."""
+        tb, mb, gas = self._raw_batch
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({tb}) != micro_batch ({mb}) * "
+                    f"grad_accum ({gas}) * dp_world ({dp_world_size})")
+        elif tb is not None and mb is not None:
+            gas = tb // (mb * dp_world_size)
+            if gas == 0 or tb % (mb * dp_world_size) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({tb}) not divisible by micro_batch*dp "
+                    f"({mb}*{dp_world_size})")
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp_world_size) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({tb}) not divisible by gas*dp")
+            mb = tb // (gas * dp_world_size)
+        elif tb is not None:
+            gas = 1
+            if tb % dp_world_size != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({tb}) not divisible by dp ({dp_world_size})")
+            mb = tb // dp_world_size
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        else:
+            raise DeepSpeedConfigError(
+                "At least train_batch_size or train_micro_batch_size_per_gpu "
+                "must be set in the config")
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+        self._dp_degree = dp_world_size
+        return tb, mb, gas
+
+    # ------------------------------------------------------------------ checks
+    def _do_sanity_check(self):
+        if self.optimizer_name is not None and self.fp16_enabled:
+            pass  # fp16 + any optimizer is allowed; dynamic scale handles it
+        if self.zero_optimization_stage > 0 and not (self.fp16_enabled
+                                                     or self.bfloat16_enabled):
+            logger.debug("ZeRO enabled with fp32 — allowed, but bf16 is the "
+                         "TPU-recommended precision")
+
+    def print_user_config(self):
+        logger.info(json.dumps(self._param_dict, sort_keys=True, indent=4))

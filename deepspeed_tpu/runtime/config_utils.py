@@ -1,0 +1,115 @@
+"""Config model base — analog of reference ``runtime/config_utils.py:17``
+(``DeepSpeedConfigModel``): pydantic model with
+
+* ``"auto"``-value tolerance (reference ``config_utils.py:54-57``) for HF
+  integration — any field may be the literal string "auto", resolved later;
+* deprecated-field migration machinery (``deprecated`` / ``new_param`` kwargs);
+* dict-style ``get``/``__getitem__`` helpers used across the engine.
+"""
+
+from functools import reduce
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all subsystem configs (same JSON schema as the reference so
+    existing DeepSpeed configs run unmodified — SURVEY.md §5 config note)."""
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # filter out "auto" values to use field defaults
+            data = {
+                k: v
+                for k, v in data.items()
+                if not (isinstance(v, str) and v == "auto"
+                        and k not in self._fields_accepting_auto())
+            }
+        super().__init__(**data)
+
+    @classmethod
+    def _fields_accepting_auto(cls):
+        out = set()
+        for name, field in cls.model_fields.items():
+            extra = getattr(field, "json_schema_extra", None) or {}
+            if isinstance(extra, dict) and extra.get("accepts_auto"):
+                out.add(name)
+                if field.alias:
+                    out.add(field.alias)
+        return out
+
+    @model_validator(mode="after")
+    def _deprecated_fields_check(self):
+        fields = type(self).model_fields
+        for name, field in fields.items():
+            extra = getattr(field, "json_schema_extra", None) or {}
+            if isinstance(extra, dict) and extra.get("deprecated"):
+                self._process_deprecated_field(name, field, extra)
+        return self
+
+    def _process_deprecated_field(self, dep_param, field, extra):
+        fields_set = self.model_fields_set
+        if dep_param not in fields_set:
+            return
+        new_param_fn = extra.get("new_param_fn", lambda x: x)
+        param_value = new_param_fn(getattr(self, dep_param))
+        new_param = extra.get("new_param", "")
+        dep_msg = extra.get("deprecated_msg", "")
+        logger.warning(f"Config parameter {dep_param} is deprecated" +
+                       (f" use {new_param} instead" if new_param else "") +
+                       (f". {dep_msg}" if dep_msg else ""))
+        if new_param and extra.get("set_new_param", True):
+            # Transfer to the new location unless the user set it explicitly.
+            new_param_nested = new_param.split(".")
+            if len(new_param_nested) > 1:
+                nested_obj = reduce(getattr, new_param_nested[:-1], self)
+                target = new_param_nested[-1]
+            else:
+                nested_obj = self
+                target = new_param
+            if target not in getattr(nested_obj, "model_fields_set", set()):
+                setattr(nested_obj, target, param_value)
+
+    # ------------------------------------------------------------ dict parity
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    """Reference ``runtime/config_utils.py`` helper."""
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the JSON config (reference behavior)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d

@@ -1,0 +1,97 @@
+"""Data loader — analog of reference ``runtime/dataloader.py``
+(``DeepSpeedDataLoader``) + ``engine.py:1753 deepspeed_io``.
+
+Single-controller difference: the reference gives each of the N processes a
+``DistributedSampler`` shard of the dataset; here one process forms the
+*global* batch (micro_batch × dp) and the engine device_puts it sharded over
+the dp axis — the per-chip slice is what lands in each chip's HBM, so the
+memory/behavior is the same, without the sampler rank bookkeeping.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+
+
+def _to_numpy(x):
+    if isinstance(x, np.ndarray):
+        return x
+    try:
+        import torch
+        if isinstance(x, torch.Tensor):
+            return x.detach().cpu().numpy()
+    except ImportError:
+        pass
+    return np.asarray(x)
+
+
+def default_collate(samples):
+    """Stack a list of samples (each a tuple/list/dict/array) into batch arrays."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    arrs = [_to_numpy(s) for s in samples]
+    return np.stack(arrs)
+
+
+class DeepSpeedDataLoader:
+    """Iterates a map-style dataset in global batches.
+
+    ``batch_size`` here is the *global* effective micro batch
+    (micro_batch_per_gpu × dp_world_size), matching what the engine shards.
+    """
+
+    def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
+                 seed=0, drop_last=True, num_local_io_workers=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
+
+    def __len__(self):
+        return self.len
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(samples)
+
+
+class RepeatingLoader:
+    """Reference ``runtime/dataloader.py`` RepeatingLoader: wrap an iterator to
+    restart on StopIteration (pipeline engine uses this)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

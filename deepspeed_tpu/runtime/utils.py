@@ -1,0 +1,98 @@
+"""Runtime utilities — analog of reference ``runtime/utils.py:1103``
+(clip_grad_norm_, see_memory_usage, partition helpers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def global_grad_norm(grads):
+    """L2 norm over a gradient pytree.  Under pjit, sharded leaves still
+    produce the *global* norm (GSPMD reduces across shards) — this replaces
+    the reference's mpu-aware ``clip_grad_norm_`` (runtime/utils.py)."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+def clip_grads_by_global_norm(grads, max_norm, norm=None):
+    """Scale grads so that global norm ≤ max_norm; returns (grads, norm).
+    Non-finite norms leave grads unscaled (overflow path handles skipping)."""
+    if norm is None:
+        norm = global_grad_norm(grads)
+    clip_coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    clip_coef = jnp.where(jnp.isfinite(clip_coef), clip_coef, 1.0)
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads), norm
+
+
+def partition_uniform(num_items, num_parts):
+    """Reference ``partition_uniform``: balanced contiguous split boundaries."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights, num_parts):
+    """Reference ``partition_balanced``: split so max part weight is minimized
+    (prefix-sum + binary search)."""
+    n = len(weights)
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+
+    def can(limit):
+        parts, last, count = [0], 0, 0
+        for i in range(1, n + 1):
+            if prefix[i] - prefix[last] > limit:
+                if i - 1 == last:
+                    return None
+                parts.append(i - 1)
+                last = i - 1
+                count += 1
+                if count >= num_parts:
+                    return None
+        parts.append(n)
+        return parts if len(parts) <= num_parts + 1 else None
+
+    lo = max(weights) if n else 0
+    hi = int(prefix[-1]) or 1
+    best = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        p = can(mid)
+        if p is not None:
+            best = p
+            hi = mid - 1
+        else:
+            lo = mid + 1
+    if best is None:
+        return partition_uniform(n, num_parts)
+    # pad to exactly num_parts+1 boundaries
+    while len(best) < num_parts + 1:
+        best.append(n)
+    return best
+
+
+def see_memory_usage(message, force=False):
+    """Reference ``see_memory_usage``: device + host memory snapshot."""
+    if not force:
+        return
+    from ..accelerator import get_accelerator
+    acc = get_accelerator()
+    ga = acc.memory_allocated() / (1024**3)
+    peak = acc.max_memory_allocated() / (1024**3)
+    logger.info(f"{message} | device alloc: {ga:.2f}GB peak: {peak:.2f}GB")
+
+
+def count_parameters(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def ensure_directory_exists(filename):
+    import os
+    os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)

@@ -1,0 +1,2 @@
+from .config import DeepSpeedZeroConfig
+from .partition import ZeroPartitionPlan, shard_spec, tree_shardings

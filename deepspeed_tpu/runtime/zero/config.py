@@ -1,0 +1,100 @@
+"""ZeRO config — same JSON schema as reference ``runtime/zero/config.py:344``
+(``DeepSpeedZeroConfig``) + ``runtime/zero/offload_config.py:109``.
+
+On TPU many of the knobs steer the *sharding policy* handed to XLA GSPMD
+rather than hand-rolled bucketing (SURVEY.md §7 design stance); knobs that have
+no XLA analog (e.g. ``allgather_bucket_size``) are accepted for config
+compatibility and recorded, but only a documented subset changes compiled code.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Reference ``offload_config.py`` param offload section."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(int(1e8), ge=0)
+    max_in_cpu: int = Field(int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    use_multi_rank_bucket_allreduce: bool = True
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    sub_group_size: int = Field(int(1e9), ge=0)
+    cpu_offload_param: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_param"})
+    cpu_offload_use_pin_memory: Optional[bool] = None
+    cpu_offload: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_optimizer"})
+
+    prefetch_bucket_size: int = Field(int(5e7), ge=0,
+                                      alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(int(1e5), ge=0,
+                                             alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(int(1e14), ge=0,
+                                             alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(int(1e9), ge=0,
+                                     alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(int(1e9), ge=0,
+                                    alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(
+        False, alias="stage3_gather_16bit_weights_on_model_save")
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    # ZeRO++ (reference stage3.py:123 kwargs + engine.py:906-913)
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+
+    # MiCS (reference runtime/zero/mics.py)
+    mics_shard_size: int = Field(-1, alias="mics_shard_size")
+    mics_hierarchical_params_gather: bool = False
+
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+    log_trace_cache_warnings: bool = False
+
+    def __post_init__(self):
+        pass

@@ -1,0 +1,154 @@
+"""ZeRO partitioning as sharding policy.
+
+The TPU-native heart of ZeRO (SURVEY.md §7 design stance): the reference's
+flatten/bucket/hook machinery (``runtime/zero/stage_1_and_2.py:97``,
+``stage3.py:111``, ``partition_parameters.py``) collapses into *sharding
+functions* — given the ZeRO stage, produce ``NamedSharding``s for params /
+gradients / optimizer state over the ZeRO mesh axes, and let GSPMD emit the
+reduce-scatter / all-gather pipeline those files hand-roll:
+
+  stage 0: params, grads, optimizer state replicated; grads all-reduced.
+  stage 1: optimizer state (incl. fp32 master) sharded over dp.
+  stage 2: + gradient accumulator sharded over dp → XLA emits reduce-scatter
+           for the grad psum (reference ``average_tensor`` stage_1_and_2.py:1045).
+  stage 3: + parameters sharded over dp → XLA all-gathers on use, exactly the
+           fetch/release coordinator's job (partitioned_param_coordinator.py:276),
+           scheduled statically by the latency-hiding scheduler.
+
+Each tensor is sharded along its **largest divisible axis** (no flattening —
+keeping the logical shape lets XLA pick layouts, and sidesteps the reference's
+alignment/padding bookkeeping).  Tensors too small to split stay replicated —
+the analog of the reference's persistent-small-param threshold
+(``parameter_offload.py:249 mark_persistent_parameters``).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_spec(shape, mesh: Mesh, axes, min_size=1):
+    """PartitionSpec sharding ``shape``'s largest divisible dim over ``axes``.
+
+    ``axes`` is a tuple of mesh axis names treated as one factored axis
+    (e.g. ("dp", "sp") for seq-data-parallel ZeRO sharding, reference
+    engine.py:1651).
+    """
+    if not shape:
+        return P()
+    n = int(np.prod([mesh.shape[a] for a in axes], dtype=np.int64))
+    if n <= 1 or int(np.prod(shape, dtype=np.int64)) < min_size:
+        return P()
+    # largest dim divisible by n; ties → first
+    best = None
+    for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
+        if d % n == 0:
+            best = i
+            break
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axes if len(axes) > 1 else axes[0]
+    return P(*spec)
+
+
+def tree_shard_specs(tree, mesh, axes, min_size=1):
+    return jax.tree_util.tree_map(
+        lambda x: shard_spec(getattr(x, "shape", ()), mesh, axes, min_size), tree)
+
+
+def tree_shardings(tree, mesh, axes, min_size=1):
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, shard_spec(getattr(x, "shape", ()), mesh,
+                                                 axes, min_size)), tree)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def tree_replicated(tree, mesh):
+    return jax.tree_util.tree_map(lambda x: NamedSharding(mesh, P()), tree)
+
+
+class ZeroPartitionPlan:
+    """Sharding policy for one ZeRO stage over given mesh axes.
+
+    ``tp_rules``: optional callable path→PartitionSpec adding tensor-parallel
+    sharding (composed with ZeRO axes; the TP analog of module_inject).
+    ``min_partition_size``: params with fewer elements stay replicated
+    (persistence threshold analog).
+    """
+
+    def __init__(self, stage, mesh, zero_axes=("dp", ), min_partition_size=1,
+                 offload_optimizer=False, offload_param=False):
+        self.stage = stage
+        self.mesh = mesh
+        self.zero_axes = tuple(a for a in zero_axes if mesh.shape.get(a, 1) >= 1)
+        self.min_partition_size = min_partition_size
+        self.offload_optimizer = offload_optimizer
+        self.offload_param = offload_param
+
+    # specs -----------------------------------------------------------------
+    def param_spec(self, shape):
+        if self.stage >= 3:
+            return shard_spec(shape, self.mesh, self.zero_axes,
+                              self.min_partition_size)
+        return P()
+
+    def master_spec(self, shape):
+        """fp32 master weights + optimizer moments."""
+        if self.stage >= 1:
+            return shard_spec(shape, self.mesh, self.zero_axes,
+                              self.min_partition_size)
+        return P()
+
+    def grad_spec(self, shape):
+        """Gradient accumulator sharding. Stage ≥2 shards grads (the engine's
+        micro-step constrains grad outputs to this, making XLA lower the DP
+        psum to reduce-scatter)."""
+        if self.stage >= 2:
+            return shard_spec(shape, self.mesh, self.zero_axes,
+                              self.min_partition_size)
+        return P()
+
+    # tree versions ---------------------------------------------------------
+    def _memory_kind(self, offload):
+        # Host offload: params/optimizer state resident in pinned host memory,
+        # streamed to device per use (reference ZeRO-Offload; SURVEY.md §7
+        # "pinned-host offload → memory kinds").
+        return "pinned_host" if offload else None
+
+    def _sharding(self, spec, offload=False):
+        kind = self._memory_kind(offload)
+        if kind is not None:
+            try:
+                return NamedSharding(self.mesh, spec, memory_kind=kind)
+            except Exception:
+                return NamedSharding(self.mesh, spec)
+        return NamedSharding(self.mesh, spec)
+
+    def param_shardings(self, params):
+        return jax.tree_util.tree_map(
+            lambda x: self._sharding(self.param_spec(x.shape),
+                                     offload=self.offload_param and self.stage >= 3),
+            params)
+
+    def master_shardings(self, params):
+        return jax.tree_util.tree_map(
+            lambda x: self._sharding(self.master_spec(x.shape),
+                                     offload=self.offload_optimizer), params)
+
+    def grad_shardings(self, params):
+        return jax.tree_util.tree_map(
+            lambda x: self._sharding(self.grad_spec(x.shape)), params)
+
+    def param_specs(self, params):
+        return jax.tree_util.tree_map(lambda x: self.param_spec(x.shape), params)
+
+    def master_specs(self, params):
+        return jax.tree_util.tree_map(lambda x: self.master_spec(x.shape), params)
+
+    def grad_specs(self, params):
+        return jax.tree_util.tree_map(lambda x: self.grad_spec(x.shape), params)

@@ -116,6 +116,13 @@ def initialize_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None,
                             expert_mesh=expert_mesh, hpz_mesh=hpz_mesh,
                             zero_partition_size=zero_partition_size)
     logger.debug(f"initialized mesh pp={pp} dp={dp} sp={sp} tp={tp} ep={ep}")
+    # Keep an already-created comm backend in sync so facade collectives and
+    # groups-module accessors always agree on the topology.
+    from ..comm import comm as _comm
+    if _comm.cdb is not None:
+        from ..comm.backend import ProcessGroup
+        _comm.cdb.mesh = mesh
+        _comm.cdb.world_group = ProcessGroup(mesh, mesh.axis_names)
     return _mesh_state
 
 

@@ -1,0 +1,50 @@
+"""Tiny model fixtures — analog of reference ``tests/unit/simple_model.py``
+(SimpleModel ``:20``, random dataloaders ``:268-289``)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def make_simple_mlp_params(hidden_dim=16, nlayers=2, seed=0):
+    """Param pytree for an MLP regression model."""
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": rng.standard_normal((hidden_dim, hidden_dim)).astype(np.float32)
+                 * (1.0 / np.sqrt(hidden_dim)),
+            "b": np.zeros((hidden_dim, ), np.float32),
+        }
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def simple_mlp_apply(params, x, y):
+    """Returns scalar MSE loss — the 'model returns loss' convention used by
+    the reference's SimpleModel(x, y)."""
+    h = x
+    keys = sorted(params.keys())
+    for i, k in enumerate(keys):
+        h = h @ params[k]["w"] + params[k]["b"]
+        if i < len(keys) - 1:
+            h = jax.nn.relu(h)
+    return jnp.mean((h - y)**2)
+
+
+def random_dataset(total_samples, hidden_dim=16, seed=0):
+    """List of (x, y) numpy sample pairs (reference random_dataloader)."""
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((total_samples, hidden_dim)).astype(np.float32)
+    ys = (xs @ rng.standard_normal((hidden_dim, hidden_dim)).astype(np.float32)
+          * 0.1)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def batches(dataset, batch_size):
+    out = []
+    for i in range(0, len(dataset) - batch_size + 1, batch_size):
+        xs = np.stack([dataset[j][0] for j in range(i, i + batch_size)])
+        ys = np.stack([dataset[j][1] for j in range(i, i + batch_size)])
+        out.append((xs, ys))
+    return out
