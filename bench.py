@@ -1,0 +1,94 @@
+"""Benchmark: Llama train-step throughput on the available accelerator.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+
+Model: Llama-style causal LM sized to a single v5e chip (16G HBM), bf16,
+full train step (fwd+bwd+Adam) through the DeepSpeedEngine.
+
+MFU accounting: flops/token = 6N + 12·L·S·D (PaLM convention: 6N for the
+matmuls fwd+bwd, attention quadratic term; remat recompute NOT credited).
+``vs_baseline``: BASELINE.md's north-star target is ≥0.8× the per-chip MFU of
+the A100+NCCL reference, for which no in-repo number exists; we take 50% MFU
+as the A100 reference point (Ulysses blog reports >54% of peak as its best,
+blogs/deepspeed-ulysses/README.md:82), so vs_baseline = MFU / 0.40 — 1.0 means
+the 0.8× target is met.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16", remat=True)
+        B, S, steps, warmup = 8, 2048, 10, 2
+        peak_flops = 197e12  # v5e bf16 peak per chip
+    else:  # CPU smoke mode (sanity only)
+        cfg = llama.llama_tiny(dtype="float32", remat=False)
+        B, S, steps, warmup = 4, 64, 3, 1
+        peak_flops = 1e12
+
+    model = llama.LlamaModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": B,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "fusedadam", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": on_tpu},
+            "zero_optimization": {"stage": 0},
+        })
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+
+    def one_step():
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    for _ in range(warmup):
+        loss = one_step()
+    jax.block_until_ready(engine.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    jax.block_until_ready(engine.params)
+    dt = time.perf_counter() - t0
+
+    step_time = dt / steps
+    tokens_per_sec = B * S / step_time
+    n_params = llama.param_count(cfg)
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
+    mfu = tokens_per_sec * flops_per_token / peak_flops
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/s (B={B} S={S} params={n_params/1e6:.0f}M "
+                f"step={step_time*1000:.0f}ms MFU={mfu:.3f} backend={backend})",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,8 @@ def initialize(args=None,
                collate_fn=None,
                config=None,
                mpu=None,
-               config_params=None):
+               config_params=None,
+               tp_rules=None):
     """Build the training engine.
 
     Reference ``deepspeed/__init__.py:69``.  Returns
@@ -74,7 +75,8 @@ def initialize(args=None,
                                  lr_scheduler=lr_scheduler,
                                  collate_fn=collate_fn,
                                  config=ds_config,
-                                 mpu=mpu)
+                                 mpu=mpu,
+                                 tp_rules=tp_rules)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

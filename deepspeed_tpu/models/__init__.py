@@ -1,0 +1,4 @@
+from . import bert, gpt2, llama
+from .bert import BertConfig, BertModel
+from .gpt2 import GPT2Config, GPT2Model
+from .llama import LlamaConfig, LlamaModel

@@ -1,0 +1,117 @@
+"""BERT family (TPU-first flax) — covers BASELINE config 1 (BERT-base ZeRO-0
+fp32) and the BERT-Large pretraining throughput baseline (BASELINE.md).
+Post-LN encoder blocks per original BERT; MLM head; 'returns loss with labels'
+contract (labels = masked-token ids, -100 = ignore)."""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def bert_base(**overrides):
+    return BertConfig(**overrides)
+
+
+def bert_large(**overrides):
+    return BertConfig(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                                num_attention_heads=16, intermediate_size=4096),
+                         **overrides})
+
+
+def bert_tiny(**overrides):
+    return BertConfig(**{**dict(vocab_size=256, hidden_size=64,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=128,
+                                max_position_embeddings=128), **overrides})
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        dense = partial(nn.DenseGeneral, dtype=dtype, param_dtype=jnp.float32)
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=dtype,
+                     param_dtype=jnp.float32)
+
+        q = dense(features=(H, Dh), name="query")(x)
+        k = dense(features=(H, Dh), name="key")(x)
+        v = dense(features=(H, Dh), name="value")(x)
+        scale = Dh**-0.5
+        logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+        if mask is not None:
+            logits = jnp.where(mask[:, None, None, :].astype(bool), logits,
+                               jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(dtype)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v)
+        attn = dense(features=D, axis=(-2, -1), name="attention_output")(ctx)
+        x = ln(name="attention_ln")(x + attn)
+
+        h = dense(features=cfg.intermediate_size, name="intermediate")(x)
+        h = nn.gelu(h)
+        h = dense(features=D, name="output")(h)
+        return ln(name="output_ln")(x + h)
+
+
+class BertModel(nn.Module):
+    """Encoder + MLM head; ``__call__(input_ids, labels=None, attention_mask=None)``."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None,
+                 token_type_ids=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S = input_ids.shape
+        we = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                      param_dtype=jnp.float32, name="word_embeddings")
+        pe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                      dtype=dtype, param_dtype=jnp.float32,
+                      name="position_embeddings")
+        te = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=dtype,
+                      param_dtype=jnp.float32, name="token_type_embeddings")
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = we(input_ids) + pe(jnp.arange(S)[None, :]) + te(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                         param_dtype=jnp.float32, name="embeddings_ln")(x)
+
+        layer = BertLayer
+        if cfg.remat:
+            layer = nn.remat(BertLayer,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        logits = we.attend(x.astype(jnp.float32))
+        if labels is None:
+            return logits
+        from ..sequence.cross_entropy import softmax_cross_entropy_with_logits
+        per_tok = softmax_cross_entropy_with_logits(logits, jnp.maximum(labels, 0))
+        m = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0)

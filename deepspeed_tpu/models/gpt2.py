@@ -1,0 +1,125 @@
+"""GPT-2 family (TPU-first flax) — covers BASELINE configs 2/5 (GPT-2 350M,
+GPT-3-13B-style scaling).  Learned positions, pre-LN blocks, GELU MLP, tied
+LM head (GPT-2 convention).  Same 'returns loss with labels' contract as
+``models/llama.py``."""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    use_ulysses: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt2_350m(**overrides):
+    return GPT2Config(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                                num_attention_heads=16), **overrides})
+
+
+def gpt2_tiny(**overrides):
+    return GPT2Config(**{**dict(vocab_size=256, hidden_size=64,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                max_position_embeddings=128), **overrides})
+
+
+def gpt3_13b(**overrides):
+    return GPT2Config(**{**dict(vocab_size=50257, hidden_size=5120,
+                                num_hidden_layers=40, num_attention_heads=40,
+                                max_position_embeddings=2048), **overrides})
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                     param_dtype=jnp.float32)
+        dense = partial(nn.DenseGeneral, dtype=dtype, param_dtype=jnp.float32)
+
+        h = ln(name="ln_1")(x)
+        q = dense(features=(H, Dh), name="q_proj")(h)
+        k = dense(features=(H, Dh), name="k_proj")(h)
+        v = dense(features=(H, Dh), name="v_proj")(h)
+        if cfg.use_ulysses:
+            from ..sequence.layer import DistributedAttention
+            attn_out = DistributedAttention()(q, k, v, causal=True)
+        else:
+            from ..ops.attention import attention_core
+            attn_out = attention_core(q, k, v, causal=True)
+        attn_out = dense(features=D, axis=(-2, -1), name="c_proj")(attn_out)
+        x = x + attn_out
+
+        h = ln(name="ln_2")(x)
+        h = dense(features=4 * D, name="c_fc")(h)
+        h = nn.gelu(h)
+        h = dense(features=D, name="mlp_proj")(h)
+        return x + h
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                       param_dtype=jnp.float32, name="wte")
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=dtype, param_dtype=jnp.float32, name="wpe")
+        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+
+        block = GPT2Block
+        if cfg.remat:
+            block = nn.remat(GPT2Block,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        logits = wte.attend(x.astype(jnp.float32))
+        if labels is None:
+            return logits
+        from ..sequence.cross_entropy import softmax_cross_entropy_with_logits
+        loss = softmax_cross_entropy_with_logits(logits[:, :-1], labels[:, 1:])
+        if attention_mask is not None:
+            m = attention_mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(loss)
+
+
+def tp_rules(config: GPT2Config):
+    tp = "tp"
+    return {
+        "q_proj/kernel": P(None, tp, None),
+        "k_proj/kernel": P(None, tp, None),
+        "v_proj/kernel": P(None, tp, None),
+        "c_proj/kernel": P(tp, None, None),
+        "c_fc/kernel": P(None, tp),
+        "mlp_proj/kernel": P(tp, None),
+        "wte/embedding": P(tp, None),
+    }

@@ -1,0 +1,244 @@
+"""Llama-family model (TPU-first flax implementation).
+
+Fills the role of the reference's model coverage for Llama/Llama-2 (inference
+containers ``module_inject/containers/llama.py``, FastGen impl
+``inference/v2/model_implementations/llama_v2``) — but as a *training-capable*
+flax module designed for the MXU:
+
+* all matmuls batched [B*S, D]×[D, ·], bf16 compute, fp32 RMSNorm accums;
+* rotary embeddings precomputed once (static S) and fused by XLA;
+* GQA (n_kv_heads ≤ n_heads) with head-dim layouts [B, S, H, Dh];
+* optional Ulysses attention (sp axis) via ``deepspeed_tpu.sequence``;
+* ``remat`` flag → ``jax.checkpoint`` per block (activation checkpointing,
+  reference ``runtime/activation_checkpointing``);
+* TP logical sharding rules exposed via ``tp_rules()`` — column-parallel
+  qkv/gate/up, row-parallel o/down (AutoTP analog, reference
+  ``module_inject/auto_tp.py:273``).
+
+Returns loss when ``labels`` is given (DeepSpeed 'model returns loss'
+convention used across the reference's tests).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
+    use_ulysses: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_7b(**overrides):
+    return LlamaConfig(**{**dict(vocab_size=32000, hidden_size=4096,
+                                 intermediate_size=11008, num_hidden_layers=32,
+                                 num_attention_heads=32, num_key_value_heads=32),
+                          **overrides})
+
+
+def llama_13b(**overrides):
+    return LlamaConfig(**{**dict(vocab_size=32000, hidden_size=5120,
+                                 intermediate_size=13824, num_hidden_layers=40,
+                                 num_attention_heads=40, num_key_value_heads=40),
+                          **overrides})
+
+
+def llama_tiny(**overrides):
+    """Test-scale config."""
+    return LlamaConfig(**{**dict(vocab_size=256, hidden_size=64,
+                                 intermediate_size=128, num_hidden_layers=2,
+                                 num_attention_heads=4, num_key_value_heads=2,
+                                 max_position_embeddings=128),
+                          **overrides})
+
+
+def _rope_freqs(head_dim, max_len, theta):
+    inv = 1.0 / (theta**(np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_len)
+    freqs = np.outer(t, inv)  # [S, Dh/2]
+    return np.cos(freqs), np.sin(freqs)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """x: [B, S, H, Dh]; cos/sin: [Smax, Dh/2]."""
+    S = x.shape[1]
+    if positions is None:
+        c = cos[:S][None, :, None, :]
+        s = sin[:S][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1], ))
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * w).astype(self.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(H, Dh), name="q_proj")(x)
+        k = dense(features=(Hkv, Dh), name="k_proj")(x)
+        v = dense(features=(Hkv, Dh), name="v_proj")(x)
+
+        cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+
+        # GQA: repeat kv heads up to H
+        if Hkv != H:
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        if cfg.use_ulysses:
+            from ..sequence.layer import DistributedAttention
+            out = DistributedAttention()(q, k, v, causal=True)
+        else:
+            from ..ops.attention import attention_core
+            out = attention_core(q, k, v, causal=True)
+
+        out = out.reshape(B, S, H * Dh)
+        return dense(features=D, axis=-1, name="o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        dense = partial(nn.Dense, use_bias=False, dtype=dtype,
+                        param_dtype=jnp.float32)
+        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, dtype, name="input_layernorm")(x),
+            attention_mask)
+        return h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, dtype, name="post_attention_layernorm")(h))
+
+
+class LlamaModel(nn.Module):
+    """Causal-LM.  ``__call__(input_ids, labels=None)`` → loss (scalar) if
+    labels given else logits."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, dtype=dtype,
+                         name="embed_tokens")
+        x = embed(input_ids)
+
+        block = LlamaBlock
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block = nn.remat(LlamaBlock, policy=policy)
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"layers_{i}")(x, attention_mask)
+
+        x = RMSNorm(cfg.rms_norm_eps, dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=jnp.float32, param_dtype=jnp.float32,
+                              name="lm_head")(x.astype(jnp.float32))
+        if labels is None:
+            return logits
+        from ..sequence.cross_entropy import softmax_cross_entropy_with_logits
+        # next-token prediction: shift
+        loss = softmax_cross_entropy_with_logits(logits[:, :-1], labels[:, 1:])
+        if attention_mask is not None:
+            m = attention_mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(loss)
+
+
+def tp_rules(config: LlamaConfig):
+    """AutoTP-style sharding rules: param-path suffix → PartitionSpec.
+    Column-parallel q/k/v/gate/up (+ embed vocab dim), row-parallel o/down."""
+    tp = "tp"
+    return {
+        "q_proj/kernel": P(None, tp, None),
+        "k_proj/kernel": P(None, tp, None),
+        "v_proj/kernel": P(None, tp, None),
+        "o_proj/kernel": P(tp, None),
+        "gate_proj/kernel": P(None, tp),
+        "up_proj/kernel": P(None, tp),
+        "down_proj/kernel": P(tp, None),
+        "embed_tokens/embedding": P(tp, None),
+        "lm_head/kernel": P(None, tp),
+    }
+
+
+def param_count(config: LlamaConfig):
+    D, I, V, L = (config.hidden_size, config.intermediate_size,
+                  config.vocab_size, config.num_hidden_layers)
+    H, Hkv, Dh = (config.num_attention_heads, config.num_key_value_heads,
+                  config.head_dim)
+    per_layer = (D * H * Dh + 2 * D * Hkv * Dh + H * Dh * D) + 3 * D * I + 2 * D
+    total = V * D + L * per_layer + D
+    if not config.tie_word_embeddings:
+        total += D * V
+    return total

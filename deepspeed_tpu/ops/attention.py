@@ -1,0 +1,49 @@
+"""Attention core — dispatch layer for the attention kernels.
+
+Role of the reference's fused attention kernels (``csrc/transformer/inference``
+softmax/attention ops and the FastGen blocked flash, SURVEY.md §2.2): a single
+entry point the models call; on TPU it routes to the Pallas flash-attention
+kernel, elsewhere (CPU tests) to a plain XLA implementation that compiles to
+the same math.
+"""
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, causal=True, softmax_scale=None):
+    """Reference XLA path [B, S, H, D] (fp32 softmax accumulation)."""
+    B, S, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else D**-0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        Sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)
+        logits = jnp.where(mask[None, None], logits,
+                           jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _use_pallas():
+    if os.environ.get("DS_TPU_DISABLE_PALLAS_ATTN"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def attention_core(q, k, v, causal=True, softmax_scale=None):
+    """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere."""
+    if _use_pallas():
+        try:
+            from .pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)

@@ -96,7 +96,8 @@ class DeepSpeedEngine:
                  collate_fn=None,
                  config=None,
                  mpu=None,
-                 dont_change_device=False):
+                 dont_change_device=False,
+                 tp_rules=None):
         if not isinstance(config, DeepSpeedConfig):
             config = DeepSpeedConfig(config)
         self._config = config
@@ -167,8 +168,11 @@ class DeepSpeedEngine:
         zero_axes = groups.zero_sharding_axes(
             sequence_parallel=self.seq_parallel_world_size > 1)
         self.zero_stage = zc.stage
+        if tp_rules is None:
+            tp_rules = getattr(model, "tp_sharding_rules", None)
         self.plan = ZeroPartitionPlan(
             stage=zc.stage, mesh=self.mesh, zero_axes=zero_axes,
+            tp_rules=tp_rules,
             min_partition_size=max(1, zc.param_persistence_threshold // 8),
             offload_optimizer=(zc.offload_optimizer is not None
                                and zc.offload_optimizer.device != "none"),
@@ -234,10 +238,10 @@ class DeepSpeedEngine:
                 model_parameters, master_shardings)
         else:
             self.master = None  # pure fp32 stage-0: params are the master
-        self.grad_acc = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(
-                jnp.zeros(p.shape, dtype=self.grad_accum_dtype), s),
-            self.params, self.plan.grad_shardings(self.params))
+        # Gradient accumulator is allocated lazily: the first backward()'s
+        # stashed grads (already cast + sharded by the micro-step) become the
+        # accumulator, so gas=1 never materializes a second grad buffer.
+        self.grad_acc = None
         self.scale_state = self.loss_scaler.init()
 
     def initialize_parameters(self, rng_or_seed, *sample_inputs, **kw):
@@ -328,12 +332,15 @@ class DeepSpeedEngine:
             # moments have param shapes → shard like the param; find by shape
             return None
 
-        # Build by structure: state trees contain `mu`/`nu` shaped like target.
+        # Build by structure: state trees contain `mu`/`nu` shaped like the
+        # target params; suffix path-matching applies the same TP rules.
+        from .zero.partition import path_str
+
         def map_state(s):
-            return jax.tree_util.tree_map(
-                lambda x: NamedSharding(
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, x: NamedSharding(
                     self.mesh,
-                    self.plan.master_spec(x.shape)), s)
+                    self.plan.master_spec(x.shape, path_str(kp))), s)
         return map_state(state_shape)
 
     def _configure_lr_scheduler(self, client_scheduler):
@@ -469,6 +476,7 @@ class DeepSpeedEngine:
             inv = 1.0 / scale_state.scale
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) * inv, grad_acc)
+            del grad_acc
             # reshard grads to master layout (stage 1: scatter; free slice)
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(g, s),
@@ -502,8 +510,7 @@ class DeepSpeedEngine:
                 new_params = new_target
 
             new_scale = scaler.update(scale_state, overflow)
-            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
-            return new_params, new_master, new_opt, zero_acc, new_scale, overflow, gnorm
+            return new_params, new_master, new_opt, new_scale, overflow, gnorm
 
         return apply
 
@@ -553,11 +560,15 @@ class DeepSpeedEngine:
         self._check_params()
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
+            if self.grad_acc is None:
+                raise RuntimeError("step() at a grad-accum boundary without "
+                                   "any backward() since the last boundary")
             apply = self._get_compiled_apply()
-            (self.params, self.master, self.opt_state, self.grad_acc,
+            (self.params, self.master, self.opt_state,
              self.scale_state, overflow, gnorm) = apply(
                 self.params, self.master, self.opt_state, self.grad_acc,
                 self.scale_state)
+            self.grad_acc = None
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if bool(overflow):
@@ -634,11 +645,10 @@ class DeepSpeedEngine:
         from .utils import ensure_directory_exists
         path = os.path.join(save_dir, save_filename.replace(".bin", ".npz"))
         ensure_directory_exists(path)
+        from .zero.partition import path_str
         flat = {}
         for kp, leaf in jax.tree_util.tree_leaves_with_path(self.params):
-            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in kp)
-            flat[name] = onp.asarray(leaf)
+            flat[path_str(kp)] = onp.asarray(leaf)
         onp.savez(path, **flat)
         return path
 

@@ -28,29 +28,74 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def shard_spec(shape, mesh: Mesh, axes, min_size=1):
+def shard_spec(shape, mesh: Mesh, axes, min_size=1, base_spec=None):
     """PartitionSpec sharding ``shape``'s largest divisible dim over ``axes``.
 
     ``axes`` is a tuple of mesh axis names treated as one factored axis
     (e.g. ("dp", "sp") for seq-data-parallel ZeRO sharding, reference
-    engine.py:1651).
+    engine.py:1651).  ``base_spec`` (e.g. a tensor-parallel spec) is preserved:
+    the ZeRO axes go to the largest *unclaimed* dim; a dim already sharded by
+    base_spec divides its residual size.
     """
     if not shape:
-        return P()
+        return base_spec if base_spec is not None else P()
     n = int(np.prod([mesh.shape[a] for a in axes], dtype=np.int64))
+    base = list(base_spec) if base_spec is not None else []
+    base = base + [None] * (len(shape) - len(base))
     if n <= 1 or int(np.prod(shape, dtype=np.int64)) < min_size:
-        return P()
-    # largest dim divisible by n; ties → first
+        return P(*base)
+    # largest unclaimed dim divisible by n; ties → first
     best = None
     for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
+        if base[i] is not None:
+            continue
         if d % n == 0:
             best = i
             break
-    if best is None:
-        return P()
-    spec = [None] * len(shape)
-    spec[best] = axes if len(axes) > 1 else axes[0]
-    return P(*spec)
+    if best is not None:
+        base[best] = axes if len(axes) > 1 else axes[0]
+        return P(*base)
+    # No unclaimed dim fits: compose onto a claimed dim whose residual size
+    # (after its existing axes) still divides n — keeps ZeRO sharding alive
+    # when TP claimed the only divisible dim.
+    for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
+        if base[i] is None:
+            continue
+        existing = base[i] if isinstance(base[i], tuple) else (base[i], )
+        claimed = int(np.prod([mesh.shape[a] for a in existing], dtype=np.int64))
+        if d % (claimed * n) == 0:
+            base[i] = existing + tuple(axes)
+            return P(*base)
+    return P(*base)
+
+
+def path_str(kp):
+    """jax key-path → 'a/b/c' string for rule matching."""
+    parts = []
+    for k in kp:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_tp_rule(rules, path):
+    """Longest-suffix match of ``path`` against rule keys; the suffix must
+    start at a '/' component boundary (so 'wo/kernel' does not match
+    'moe_two/kernel')."""
+    if not rules:
+        return None
+    best, best_len = None, -1
+    for suffix, spec in rules.items():
+        if (path == suffix or path.endswith("/" + suffix)) and \
+                len(suffix) > best_len:
+            best, best_len = spec, len(suffix)
+    return best
 
 
 def tree_shard_specs(tree, mesh, axes, min_size=1):
@@ -75,43 +120,73 @@ def tree_replicated(tree, mesh):
 class ZeroPartitionPlan:
     """Sharding policy for one ZeRO stage over given mesh axes.
 
-    ``tp_rules``: optional callable path→PartitionSpec adding tensor-parallel
-    sharding (composed with ZeRO axes; the TP analog of module_inject).
-    ``min_partition_size``: params with fewer elements stay replicated
-    (persistence threshold analog).
+    ``tp_rules``: optional dict {path-suffix: PartitionSpec} adding
+    tensor-parallel sharding (composed with ZeRO axes; the TP analog of
+    module_inject).  ``min_partition_size``: params with fewer elements stay
+    replicated (persistence threshold analog).
     """
 
     def __init__(self, stage, mesh, zero_axes=("dp", ), min_partition_size=1,
-                 offload_optimizer=False, offload_param=False):
+                 offload_optimizer=False, offload_param=False, tp_rules=None):
         self.stage = stage
         self.mesh = mesh
         self.zero_axes = tuple(a for a in zero_axes if mesh.shape.get(a, 1) >= 1)
         self.min_partition_size = min_partition_size
         self.offload_optimizer = offload_optimizer
         self.offload_param = offload_param
+        # TP rules: path-suffix → PartitionSpec over the "tp" axis (AutoTP
+        # analog, reference module_inject/auto_tp.py:273) — composed with the
+        # ZeRO axes on every state tensor.
+        self.tp_rules = tp_rules or {}
 
     # specs -----------------------------------------------------------------
-    def param_spec(self, shape):
+    def _tp_base(self, path, shape=None):
+        if path is None:
+            return None
+        spec = match_tp_rule(self.tp_rules, path)
+        if spec is None or shape is None:
+            return spec
+        # kv-head-aware sanitization (reference module_inject/tp_shard.py):
+        # drop axes a dim can't divide (e.g. 2 kv heads on tp=4 → replicate).
+        out = []
+        for i, ax in enumerate(spec):
+            if ax is None or i >= len(shape):
+                out.append(None if i >= len(shape) else ax)
+                continue
+            names = ax if isinstance(ax, tuple) else (ax, )
+            for a in names:
+                if a not in self.mesh.shape:
+                    raise ValueError(
+                        f"tp_rules for {path!r} references axis {a!r} not in "
+                        f"mesh axes {tuple(self.mesh.shape)}")
+            n = int(np.prod([self.mesh.shape[a] for a in names], dtype=np.int64))
+            out.append(ax if shape[i] % n == 0 else None)
+        return P(*out)
+
+    def param_spec(self, shape, path=None):
+        base = self._tp_base(path, shape)
         if self.stage >= 3:
             return shard_spec(shape, self.mesh, self.zero_axes,
-                              self.min_partition_size)
-        return P()
+                              self.min_partition_size, base_spec=base)
+        return base if base is not None else P()
 
-    def master_spec(self, shape):
+    def master_spec(self, shape, path=None):
         """fp32 master weights + optimizer moments."""
+        base = self._tp_base(path, shape)
         if self.stage >= 1:
             return shard_spec(shape, self.mesh, self.zero_axes,
-                              self.min_partition_size)
-        return P()
+                              self.min_partition_size, base_spec=base)
+        return base if base is not None else P()
 
-    def grad_spec(self, shape):
+    def grad_spec(self, shape, path=None):
         """Gradient accumulator sharding. Stage ≥2 shards grads (the engine's
         micro-step constrains grad outputs to this, making XLA lower the DP
         psum to reduce-scatter)."""
+        base = self._tp_base(path, shape)
         if self.stage >= 2:
             return shard_spec(shape, self.mesh, self.zero_axes,
-                              self.min_partition_size)
-        return P()
+                              self.min_partition_size, base_spec=base)
+        return base if base is not None else P()
 
     # tree versions ---------------------------------------------------------
     def _memory_kind(self, offload):
@@ -130,25 +205,29 @@ class ZeroPartitionPlan:
         return NamedSharding(self.mesh, spec)
 
     def param_shardings(self, params):
-        return jax.tree_util.tree_map(
-            lambda x: self._sharding(self.param_spec(x.shape),
-                                     offload=self.offload_param and self.stage >= 3),
-            params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self._sharding(
+                self.param_spec(x.shape, path_str(kp)),
+                offload=self.offload_param and self.stage >= 3), params)
 
     def master_shardings(self, params):
-        return jax.tree_util.tree_map(
-            lambda x: self._sharding(self.master_spec(x.shape),
-                                     offload=self.offload_optimizer), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self._sharding(self.master_spec(x.shape, path_str(kp)),
+                                         offload=self.offload_optimizer), params)
 
     def grad_shardings(self, params):
-        return jax.tree_util.tree_map(
-            lambda x: self._sharding(self.grad_spec(x.shape)), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self._sharding(self.grad_spec(x.shape, path_str(kp))),
+            params)
 
     def param_specs(self, params):
-        return jax.tree_util.tree_map(lambda x: self.param_spec(x.shape), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self.param_spec(x.shape, path_str(kp)), params)
 
     def master_specs(self, params):
-        return jax.tree_util.tree_map(lambda x: self.master_spec(x.shape), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self.master_spec(x.shape, path_str(kp)), params)
 
     def grad_specs(self, params):
-        return jax.tree_util.tree_map(lambda x: self.grad_spec(x.shape), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: self.grad_spec(x.shape, path_str(kp)), params)

@@ -1,0 +1,132 @@
+"""Ulysses sequence parallelism — TPU-native re-design of reference
+``deepspeed/sequence/layer.py`` (``DistributedAttention`` ``:300``,
+``_SeqAllToAll`` ``:245``, ``single_all_to_all`` ``:182``).
+
+Semantics (identical to the reference): the transformer runs with the
+**sequence** dimension sharded over the "sp" mesh axis; around attention, an
+all-to-all re-shards from sequence-split to **head-split** (each rank holds
+full sequence for H/sp heads), local attention runs, and the inverse
+all-to-all restores sequence sharding.  On TPU both all-to-alls are
+``jax.lax.all_to_all`` over the sp axis inside ``shard_map`` — XLA lays them
+on ICI; gradients are handled by autodiff (all_to_all is its own transpose),
+so no custom autograd.Function is needed.
+
+GQA/uneven heads: the reference has ``uneven_heads_all2all`` (``:72``); here
+heads must divide sp (asserted), and KV heads with n_kv < sp are *replicated*
+gather-style — see ``_kv_reshard``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import groups
+
+
+def _default_attention(q, k, v, causal=True, softmax_scale=None):
+    """Local attention core [B, S, H, D] — plain XLA implementation.  The
+    pallas flash kernel (ops/pallas/flash_attention.py) slots in here on TPU."""
+    B, S, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else D**-0.5
+    # [B, H, S, S]
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        Sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def single_all_to_all(x, scatter_idx, gather_idx, axis_name):
+    """All-to-all inside a shard_map region (reference ``:182``): scatter
+    ``scatter_idx`` across the axis, gather ``gather_idx``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                              concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Reference ``DistributedAttention`` (``sequence/layer.py:300``).
+
+    ``local_attention``: callable (q, k, v, **kw) -> out, operating on
+    [B, S_full, H_local, D] blocks.  Call this object *inside* a shard_map (or
+    GSPMD-jit via ``__call__`` on global arrays with an sp-sharded seq dim).
+    """
+
+    def __init__(self, local_attention=None, sequence_process_group=None,
+                 scatter_idx=2, gather_idx=1, sp_axis=None):
+        self.local_attn = local_attention or _default_attention
+        self.sp_axis = sp_axis or groups.SP_AXIS
+        self.scatter_idx = scatter_idx  # head dim of [B,S,H,D]
+        self.gather_idx = gather_idx    # sequence dim
+
+    # ---- traced form: call inside shard_map; x are local blocks ------------
+    def attend_local(self, q, k, v, **kwargs):
+        a = self.sp_axis
+        sp = jax.lax.axis_size(a)
+        if sp == 1:
+            return self.local_attn(q, k, v, **kwargs)
+        H = q.shape[self.scatter_idx]
+        n_kv = k.shape[self.scatter_idx]
+        # seq-sharded [B, S/sp, H, D] → head-sharded [B, S, H/sp, D]
+        q = single_all_to_all(q, self.scatter_idx, self.gather_idx, a)
+        k = self._kv_reshard(k, sp, H)
+        v = self._kv_reshard(v, sp, H)
+        out = self.local_attn(q, k, v, **kwargs)
+        # back: head-sharded → seq-sharded
+        return single_all_to_all(out, self.gather_idx, self.scatter_idx, a)
+
+    def _kv_reshard(self, t, sp, n_q_heads):
+        """KV reshard with GQA alignment (reference uneven-heads analog,
+        ``sequence/layer.py:72``).  Returns kv with exactly the head count the
+        local q block has (n_q_heads / sp), so ``local_attn`` always sees
+        matched heads:
+
+        * n_kv divisible by sp → all-to-all like Q, then local group-repeat
+          (contiguous head blocks keep q↔kv group alignment);
+        * else → all-gather the sequence (kv stays whole) and gather-select
+          the kv heads serving this rank's q-head block."""
+        n_kv = t.shape[self.scatter_idx]
+        group = max(1, n_q_heads // n_kv)  # q heads per kv head
+        qh_local = n_q_heads // sp
+        if n_kv % sp == 0:
+            t = single_all_to_all(t, self.scatter_idx, self.gather_idx,
+                                  self.sp_axis)
+            if n_kv != n_q_heads:
+                t = jnp.repeat(t, group, axis=self.scatter_idx)
+            return t
+        # small-kv path: full kv heads on every rank
+        t = jax.lax.all_gather(t, self.sp_axis, axis=self.gather_idx,
+                               tiled=True)
+        r = jax.lax.axis_index(self.sp_axis)
+        local_q_global = r * qh_local + jnp.arange(qh_local)
+        kv_idx = local_q_global // group
+        return jnp.take(t, kv_idx, axis=self.scatter_idx)
+
+    # ---- eager/GSPMD form: global arrays, seq dim sp-sharded ---------------
+    def __call__(self, query, key, value, mesh=None, **kwargs):
+        mesh = mesh or groups.get_global_mesh()
+        a = self.sp_axis
+        if mesh.shape.get(a, 1) == 1:
+            return self.local_attn(query, key, value, **kwargs)
+        key_ = (mesh, tuple(sorted(kwargs.items())))
+        cache = getattr(self, "_jit_cache", None)
+        if cache is None:
+            cache = {}
+            self._jit_cache = cache
+        if key_ not in cache:
+            spec = P(None, a, None, None)  # [B, S(sp), H, D]
+
+            def f(q, k, v):
+                return self.attend_local(q, k, v, **kwargs)
+
+            cache[key_] = jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                              out_specs=spec, check_vma=False))
+        return cache[key_](query, key, value)
+
+
+class UlyssesAttention(DistributedAttention):
+    """Name parity with user-facing import in reference examples."""

@@ -1,0 +1,78 @@
+"""Tensor-parallel tests (AutoTP analog): TP sharding must not change the
+math, and must actually shard the params (reference tests/unit/model_parallelism
+intent)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.utils import groups
+
+
+GLOBAL_BATCH = 16
+
+
+def _run(tp, stage, steps=4, seed=0):
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    dp = 8 // tp
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        tp_rules=llama.tp_rules(cfg),
+        config={"train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": stage},
+                "mesh": {"tp": tp, "dp": -1}})
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size,
+                       size=(GLOBAL_BATCH, 16)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    losses = []
+    for _ in range(steps):
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    final = engine.get_fp32_param()
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    return losses, final, engine
+
+
+def test_tp_matches_no_tp():
+    losses_tp, _, _ = _run(tp=2, stage=1)
+    losses_ref, _, _ = _run(tp=1, stage=1)
+    np.testing.assert_allclose(losses_tp, losses_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_tp_param_actually_sharded():
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, tp_rules=llama.tp_rules(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "zero_optimization": {"stage": 0},
+                "mesh": {"tp": 4, "dp": -1}})
+    ids = np.zeros((2 * engine.dp_world_size, 8), np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    # find a q_proj kernel leaf and check its sharding spec references "tp"
+    found = False
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(engine.params):
+        from deepspeed_tpu.runtime.zero.partition import path_str
+        if path_str(kp).endswith("q_proj/kernel"):
+            spec = leaf.sharding.spec
+            assert any(ax == "tp" or (isinstance(ax, tuple) and "tp" in ax)
+                       for ax in spec if ax is not None), spec
+            found = True
+    assert found
+
+
+def test_tp_with_zero3_composes():
+    losses, _, engine = _run(tp=2, stage=3)
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]

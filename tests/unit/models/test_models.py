@@ -1,0 +1,75 @@
+"""Model-family smoke + training tests (tiny configs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import llama, gpt2, bert
+
+
+def _lm_batch(vocab, B=8, S=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(B, S)).astype(np.int32)
+    return ids
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2", "bert"])
+def test_model_trains(family):
+    if family == "llama":
+        cfg = llama.llama_tiny(dtype="float32", remat=False)
+        model = llama.LlamaModel(cfg)
+    elif family == "gpt2":
+        cfg = gpt2.gpt2_tiny(dtype="float32", remat=False)
+        model = gpt2.GPT2Model(cfg)
+    else:
+        cfg = bert.bert_tiny()
+        model = bert.BertModel(cfg)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    ids = _lm_batch(cfg.vocab_size, B=8, S=16)
+    engine.initialize_parameters(0, ids, ids)
+    losses = []
+    for i in range(8):
+        loss = engine(ids, ids)  # memorize one batch
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"{family}: {losses}"
+    assert np.isfinite(losses[-1])
+
+
+def test_llama_gqa_logits_shape():
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    ids = _lm_batch(cfg.vocab_size, B=2, S=8)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+
+
+def test_llama_param_count_formula():
+    cfg = llama.llama_tiny()
+    model = llama.LlamaModel(cfg)
+    ids = _lm_batch(cfg.vocab_size, B=1, S=8)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids)["params"]
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes))
+    assert n == llama.param_count(cfg)
+
+
+def test_causality_gpt2():
+    """Changing a future token must not change past logits."""
+    cfg = gpt2.gpt2_tiny(dtype="float32", remat=False)
+    model = gpt2.GPT2Model(cfg)
+    ids = _lm_batch(cfg.vocab_size, B=1, S=8)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    l1 = model.apply({"params": params}, ids)
+    ids2 = ids.copy(); ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    l2 = model.apply({"params": params}, ids2)
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
