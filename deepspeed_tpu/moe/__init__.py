@@ -1,0 +1,3 @@
+from .experts import ExpertFFN, Experts, expert_sharding_rules
+from .layer import MoE
+from .sharded_moe import TopKGate, top1gating, top2gating, topkgating

@@ -1,0 +1,89 @@
+"""MoE layer — analog of reference ``deepspeed/moe/layer.py:17`` (``MoE``).
+
+API mirrors the reference: wraps an expert module, owns the gate, returns
+``(output, l_aux, exp_counts)``.  Expert-parallel groups are the "ep" mesh
+axis (no ``_create_process_groups`` dance — reference moe/layer.py:89); use
+``deepspeed_tpu.moe.experts.expert_sharding_rules()`` in ``initialize()``'s
+``tp_rules`` to shard the expert params.
+
+PR-MoE (residual MoE, reference ``layer.py:38 use_residual``): a dense MLP
+runs in parallel and a learned coefficient mixes it with the MoE output.
+"""
+
+from typing import Optional, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils import groups
+from ..utils.logging import logger
+from .experts import ExpertFFN, Experts
+from .sharded_moe import TopKGate, dispatch_combine
+
+
+class MoE(nn.Module):
+    """``MoE(hidden_size, expert_module=..., num_experts=8, k=1, ...)``
+
+    ``__call__(x)`` with x [B, S, D] (or [T, D]) →
+    ``(output, l_aux, exp_counts)`` like the reference.
+    """
+    hidden_size: int
+    num_experts: int = 8
+    expert_module: Type[nn.Module] = ExpertFFN
+    expert_kwargs: Optional[dict] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, used_token=None, train=True):
+        D = self.hidden_size
+        orig_shape = x.shape
+        tokens = x.reshape(-1, D)  # [T, D]
+
+        # gate (kept fp32 — reference gates in fp32 for stability)
+        wg = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
+                      param_dtype=jnp.float32, name="gate")
+        logits = wg(tokens.astype(jnp.float32))
+        gate = TopKGate(k=self.k, capacity_factor=self.capacity_factor,
+                        eval_capacity_factor=self.eval_capacity_factor,
+                        min_capacity=self.min_capacity,
+                        noisy_gate_policy=self.noisy_gate_policy,
+                        drop_tokens=self.drop_tokens)
+        rng = self.make_rng("gating") if (train and self.noisy_gate_policy
+                                          and self.has_rng("gating")) else None
+        l_aux, combine, dispatch, exp_counts = gate(logits, train=train, rng=rng)
+
+        experts = Experts(expert_module=self.expert_module,
+                          expert_kwargs=self.expert_kwargs or
+                          {"hidden_size": D,
+                           "intermediate_size": 4 * D,
+                           "dtype": self.dtype},
+                          num_experts=self.num_experts, name="deepspeed_moe")
+
+        try:
+            mesh = groups.get_global_mesh()
+        except Exception:
+            mesh = None
+        out = dispatch_combine(tokens, combine, dispatch, experts, mesh=mesh)
+
+        if self.use_residual:
+            # PR-MoE: dense residual MLP + learned 2-way mixing coefficient
+            mlp_out = self.expert_module(
+                **(self.expert_kwargs or {"hidden_size": D,
+                                          "intermediate_size": 4 * D,
+                                          "dtype": self.dtype}),
+                name="residual_mlp")(tokens)
+            coef = nn.Dense(2, dtype=jnp.float32, param_dtype=jnp.float32,
+                            name="coefficient")(tokens.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = (out.astype(jnp.float32) * coef[..., 0:1] +
+                   mlp_out.astype(jnp.float32) * coef[..., 1:2]).astype(out.dtype)
+
+        return out.reshape(orig_shape), l_aux, exp_counts

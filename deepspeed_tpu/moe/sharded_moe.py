@@ -1,0 +1,174 @@
+"""MoE gating + expert-parallel layer.
+
+TPU-native re-design of reference ``deepspeed/moe/sharded_moe.py`` (TopKGate
+``:374``, top1gating ``:183``, top2gating ``:290``, MOELayer ``:533``).
+
+The reference dispatches tokens with einsums then ``all_to_all`` over the
+expert group.  Here the same algebra runs under GSPMD: the dispatched tensor
+[E, C, D] carries a sharding constraint P("ep", None, None) while tokens are
+sharded over ("dp","ep") — XLA lowers the reshard to the all-to-all pair over
+ICI, which *is* the reference's dispatch/return comm (SURVEY.md §2.1 MoE row).
+
+Gating math (capacity, load-balance aux loss, random token priority) follows
+GShard/the reference exactly so loss curves are comparable.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import groups
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def _capacity(num_tokens, num_experts, capacity_factor, min_capacity=4):
+    cap = int(num_tokens * capacity_factor / num_experts)
+    cap = max(cap, min_capacity)
+    return cap
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=None,
+               rng=None, used_token=None):
+    """Reference ``top1gating`` (sharded_moe.py:183): returns
+    (l_aux, combine_weights [T,E,C], dispatch_mask [T,E,C], exp_counts [E])."""
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor, min_capacity)
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_for_sel = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_for_sel = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits_for_sel, axis=-1)  # [T]
+    mask1 = _one_hot(idx, E)  # [T, E]
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None]
+
+    # aux loss: E * mean(gates per expert) · mean(tokens per expert)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # position in expert buffer (cumsum over tokens), drop beyond capacity
+    locations1 = jnp.cumsum(mask1, axis=0) - 1.0  # [T, E]
+    mask1 = mask1 * (locations1 < C)
+    pos = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)  # [T]
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)  # [T]
+    combine = (gate1[:, None, None] * mask1[:, :, None] *
+               _one_hot(pos, C)[:, None, :])  # [T, E, C]
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
+    """Reference ``top2gating`` (sharded_moe.py:290): top-2 with 2nd-expert
+    jitter dropped (deterministic), capacity-bounded."""
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor * 2, min_capacity)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    logits_wo1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    idx2 = jnp.argmax(logits_wo1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1.0
+    locations2 = jnp.cumsum(mask2, axis=0) - 1.0 + jnp.sum(mask1, axis=0)[None]
+    mask1 = mask1 * (locations1 < C)
+    mask2 = mask2 * (locations2 < C)
+    pos1 = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)
+    pos2 = jnp.sum(locations2 * mask2, axis=-1).astype(jnp.int32)
+
+    g1 = jnp.sum(gates * mask1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, jnp.finfo(gates.dtype).eps)
+    g1, g2 = g1 / denom, g2 / denom
+
+    combine = (g1[:, None, None] * mask1[:, :, None] * _one_hot(pos1, C)[:, None, :]
+               + g2[:, None, None] * mask2[:, :, None] * _one_hot(pos2, C)[:, None, :])
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def topkgating(logits, k, capacity_factor=1.0, min_capacity=4, drop_tokens=True):
+    """Reference ``topkgating`` (sharded_moe.py:374) — general k."""
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor * k, min_capacity)
+    gates = jax.nn.softmax(logits, axis=-1)
+    topk_gates, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
+    mask = jnp.sum(_one_hot(topk_idx, E), axis=1)  # [T, E]
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    l_aux = jnp.sum(me * ce) * E / k
+
+    locations = jnp.cumsum(mask, axis=0) - 1.0
+    if drop_tokens:
+        mask = mask * (locations < C)
+    pos = (locations * mask).astype(jnp.int32)  # [T, E]
+
+    gates_masked = gates * mask
+    denom = jnp.maximum(jnp.sum(gates_masked, axis=-1, keepdims=True),
+                        jnp.finfo(gates.dtype).eps)
+    gates_norm = gates_masked / denom
+
+    combine = gates_norm[:, :, None] * mask[:, :, None] * \
+        jax.nn.one_hot(pos, C, dtype=gates.dtype)
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, jnp.sum(mask, axis=0)
+
+
+class TopKGate:
+    """Reference ``TopKGate`` (sharded_moe.py:374 class) — functional form:
+    ``gate(wg_logits)`` returns (l_aux, combine, dispatch, counts)."""
+
+    def __init__(self, k=1, capacity_factor=1.0, eval_capacity_factor=1.0,
+                 min_capacity=4, noisy_gate_policy=None, drop_tokens=True):
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+
+    def __call__(self, logits, train=True, rng=None):
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity,
+                              self.noisy_gate_policy if train else None, rng)
+        if self.k == 2:
+            return top2gating(logits, cf, self.min_capacity, rng)
+        return topkgating(logits, self.k, cf, self.min_capacity,
+                          self.drop_tokens)
+
+
+def dispatch_combine(x, combine, dispatch, expert_fn, ep_axis=groups.EP_AXIS,
+                     mesh=None):
+    """Einsum dispatch → experts → einsum combine, with "ep" sharding
+    constraints so XLA emits the a2a pair (reference MOELayer.forward
+    sharded_moe.py:533).
+
+    x: [T, D]; combine/dispatch: [T, E, C]; expert_fn: [E, C, D] → [E, C, D].
+    """
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    if mesh is not None and mesh.shape.get(ep_axis, 1) > 1:
+        dispatched = jax.lax.with_sharding_constraint(
+            dispatched, jax.sharding.NamedSharding(mesh, P(ep_axis, None, None)))
+    out = expert_fn(dispatched)  # [E, C, D]
+    if mesh is not None and mesh.shape.get(ep_axis, 1) > 1:
+        out = jax.lax.with_sharding_constraint(
+            out, jax.sharding.NamedSharding(mesh, P(ep_axis, None, None)))
+    return jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)

@@ -413,7 +413,7 @@ class DeepSpeedEngine:
         ndim = getattr(x, "ndim", 0)
         spec = [None] * ndim
         if ndim >= 1:
-            spec[0] = groups.DP_AXIS
+            spec[0] = groups.dp_axes()
         if ndim >= 2 and self.seq_parallel_world_size > 1:
             spec[1] = groups.SP_AXIS
         return NamedSharding(self.mesh, P(*spec))

@@ -1,0 +1,377 @@
+"""PipelineEngine — TPU-native pipeline parallelism (L5).
+
+The reference's ``PipelineEngine`` (``runtime/pipe/engine.py:61``) is an
+instruction interpreter: a Python loop dispatches ``ForwardPass``/
+``BackwardPass``/``SendActivation``… commands (built by ``TrainSchedule``,
+``schedule.py:189``) against torch autograd + p2p NCCL sends.
+
+On TPU, per-instruction dispatch fights the XLA compilation model (SURVEY.md
+§7 hard part 3).  Instead the ENTIRE pipelined train step is ONE jitted SPMD
+program:
+
+* the transformer's uniform blocks are **stacked**: every leaf [L, ...] with
+  the leading layer dim sharded over the "pp" mesh axis — each pp rank owns
+  its stage's slice (the ``PipelineModule._partition_layers`` analog);
+* inside ``shard_map`` over "pp", each tick runs the stage's layers with
+  ``lax.scan`` and hands activations to the next stage with ``ppermute``
+  (the ``p2p.send/recv`` analog — a neighbor ICI hop);
+* the microbatch loop is unrolled over ``M + pp - 1`` ticks (GPipe filling/
+  draining); losses accumulate on the last stage and are ``psum``-averaged;
+* ``jax.grad`` through the whole program gives the backward schedule — XLA's
+  scheduler overlaps the reverse ppermutes exactly where 1F1B would, and
+  per-block ``remat`` keeps activation memory at the 1F1B level;
+* ZeRO/bf16/fp16 compose unchanged: stacked block params get base spec
+  P("pp") on the layer dim and the ZeRO axes shard the rest (same plan
+  machinery as TP).
+
+The instruction schedule (``schedule.py``) is retained for parity tests and
+for the ``exec_schedule`` debugging path.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...utils import groups
+from ...utils.logging import log_dist, logger
+from ..engine import DeepSpeedEngine
+from .module import PipelineModule, TiedLayerSpec
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Engine for ``PipelineModule`` models.  Use ``train_batch(data_iter)``
+    (reference ``pipe/engine.py:338``) — forward/backward/step of the base
+    class are superseded by the fused pipelined step."""
+
+    def __init__(self, args=None, model=None, optimizer=None,
+                 model_parameters=None, training_data=None, lr_scheduler=None,
+                 collate_fn=None, config=None, mpu=None, tp_rules=None,
+                 **kw):
+        assert isinstance(model, PipelineModule)
+        self.pipe_module = model
+        # Identify the uniform block region (longest run of identical specs).
+        self._analyze_layers(model)
+
+        rules = dict(tp_rules or {})
+        # stacked blocks: leading layer dim sharded over pp
+        rules.setdefault("blocks/*", P("pp"))
+
+        super().__init__(args=args, model=self._build_apply(), optimizer=optimizer,
+                         model_parameters=model_parameters,
+                         training_data=training_data, lr_scheduler=lr_scheduler,
+                         collate_fn=collate_fn, config=config, mpu=mpu,
+                         tp_rules=rules, **kw)
+        if self.pp_world_size > 1 and self.n_blocks % self.pp_world_size != 0:
+            raise ValueError(
+                f"num pipeline blocks ({self.n_blocks}) must be divisible by "
+                f"pp ({self.pp_world_size})")
+        self._compiled_pipe = {}
+        self.micro_batches = self.gradient_accumulation_steps()
+
+    # ----------------------------------------------------------- layer split
+    def _analyze_layers(self, model):
+        specs = model.specs
+        sig = [(s.typename, s.module_args, tuple(sorted(s.module_kwargs.items())))
+               for s in specs]
+        # longest run of equal signatures
+        best_start, best_len = 0, 0
+        i = 0
+        while i < len(sig):
+            j = i
+            while j < len(sig) and sig[j] == sig[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        if best_len < 1:
+            raise ValueError("PipelineModule needs at least one layer")
+        self.pre_specs = specs[:best_start]
+        self.block_specs = specs[best_start:best_start + best_len]
+        self.post_specs = specs[best_start + best_len:]
+        self.n_blocks = best_len
+        self.pre_layers = [s.build() for s in self.pre_specs]
+        self.block_proto = self.block_specs[0].build()
+        self.post_layers = [s.build() for s in self.post_specs]
+        self.loss_fn = model.loss_fn
+
+    # ------------------------------------------------------------- model fns
+    def _build_apply(self):
+        """A plain (non-pipelined) apply over the same params — used for
+        pp=1 and for numerical-parity tests."""
+        engine_self = self
+
+        def apply_fn(params, *batch):
+            *inputs, labels = batch
+            x = inputs[0] if len(inputs) == 1 else tuple(inputs)
+            for i, layer in enumerate(engine_self.pre_layers):
+                x = layer.apply({"params": params["pre"][f"layer_{i}"]}, x)
+
+            def body(x, lp):
+                y = engine_self.block_proto.apply({"params": lp}, x)
+                return y, None
+
+            x, _ = jax.lax.scan(body, x, params["blocks"])
+            for i, layer in enumerate(engine_self.post_layers):
+                x = layer.apply({"params": params["post"][f"layer_{i}"]}, x)
+            if engine_self.loss_fn is not None:
+                return engine_self.loss_fn(x, labels)
+            return x
+
+        return apply_fn
+
+    def initialize_parameters(self, rng_or_seed, *sample_batch):
+        """Init pre/blocks/post params; blocks vmapped → leaves [L, ...]."""
+        rng = (jax.random.PRNGKey(rng_or_seed)
+               if isinstance(rng_or_seed, int) else rng_or_seed)
+        *inputs, labels = sample_batch
+        x = jnp.asarray(inputs[0]) if len(inputs) == 1 else tuple(
+            map(jnp.asarray, inputs))
+        pre = {}
+        for i, layer in enumerate(self.pre_layers):
+            rng, sub = jax.random.split(rng)
+            pre[f"layer_{i}"] = layer.init(sub, x)["params"]
+            x = layer.apply({"params": pre[f"layer_{i}"]}, x)
+
+        rng, sub = jax.random.split(rng)
+        block_rngs = jax.random.split(sub, self.n_blocks)
+        if self.pipe_module.seed_layers:
+            init_one = lambda r: self.block_proto.init(r, x)["params"]
+            blocks = jax.vmap(init_one)(block_rngs)
+        else:
+            one = self.block_proto.init(block_rngs[0], x)["params"]
+            blocks = jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(p[None], (self.n_blocks, ) + p.shape),
+                one)
+            # still different per layer if seed_layers=False? reference seeds
+            # identically only when seed_layers set; default: unique init
+            blocks = jax.vmap(lambda r: self.block_proto.init(r, x)["params"])(
+                block_rngs)
+        x = self.block_proto.apply(
+            {"params": jax.tree_util.tree_map(lambda p: p[0], blocks)}, x)
+
+        post = {}
+        for i, layer in enumerate(self.post_layers):
+            rng, sub = jax.random.split(rng)
+            post[f"layer_{i}"] = layer.init(sub, x)["params"]
+            x = layer.apply({"params": post[f"layer_{i}"]}, x)
+
+        params = {"pre": pre, "blocks": blocks, "post": post}
+        shardings = self.plan.master_shardings(params)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), params, shardings)
+        self._install_parameters(params)
+        if self.optimizer is None or self.opt_state is None:
+            self._configure_optimizer(self.client_optimizer)
+        return self.params
+
+    # ---------------------------------------------------------- fused pipeline
+    def _pipe_loss_fn(self):
+        """Build loss(params, batch_mb, labels_mb) running the full GPipe
+        schedule under shard_map over the pp axis."""
+        pp = self.pp_world_size
+        M = self.micro_batches
+        mesh = self.mesh
+        engine_self = self
+        loss_fn = self.loss_fn
+        stage_blocks = self.n_blocks // pp
+
+        def stage_scan(blocks_local, x):
+            def body(x, lp):
+                y = engine_self.block_proto.apply({"params": lp}, x)
+                return y, None
+
+            x, _ = jax.lax.scan(body, x, blocks_local)
+            return x
+
+        def pre_apply(pre_params, x):
+            for i, layer in enumerate(engine_self.pre_layers):
+                x = layer.apply({"params": pre_params[f"layer_{i}"]}, x)
+            return x
+
+        def post_apply(post_params, x):
+            for i, layer in enumerate(engine_self.post_layers):
+                x = layer.apply({"params": post_params[f"layer_{i}"]}, x)
+            return x
+
+        def pipe(params, batch_mb, labels_mb):
+            """Runs inside shard_map over ("pp",).  blocks leaves are the
+            LOCAL stage slice [stage_blocks, ...]; pre/post replicated."""
+            stage = jax.lax.axis_index("pp")
+            # embed all microbatches up front on stage 0 (cheap; keeps the
+            # tick loop uniform): [M, mb, ...] → hidden [M, mb, S, D]
+            h0 = jax.vmap(lambda b: pre_apply(params["pre"], b))(batch_mb)
+            mb_hidden_shape = h0.shape[1:]
+
+            state = jnp.zeros(mb_hidden_shape, h0.dtype)
+            total_loss = jnp.zeros((), jnp.float32)
+
+            for t in range(M + pp - 1):
+                # stage 0 injects microbatch t (if any)
+                feed = h0[min(t, M - 1)]
+                x = jnp.where(stage == 0, feed, state)
+                y = stage_scan(params["blocks"], x)
+                # last stage computes loss for microbatch t - (pp - 1)
+                m_idx = t - (pp - 1)
+                if 0 <= m_idx < M:
+                    logits = post_apply(params["post"], y)
+                    l = loss_fn(logits, labels_mb[m_idx]).astype(jnp.float32)
+                    total_loss = total_loss + jnp.where(stage == pp - 1, l, 0.0)
+                # hand off activations to the next stage (ring; stage pp-1's
+                # output wraps to stage 0 where it is overwritten by feed)
+                perm = [(i, (i + 1) % pp) for i in range(pp)]
+                state = jax.lax.ppermute(y, "pp", perm)
+
+            # loss lives on the last stage only → psum broadcasts it
+            return jax.lax.psum(total_loss, "pp") / M
+
+        def loss(params, batch_mb, labels_mb):
+            # shard_map in/out specs: blocks leaves carry P("pp") on dim 0 and
+            # are otherwise replicated inside the region; ZeRO/TP sharding of
+            # the non-layer dims is handled OUTSIDE by GSPMD via jit shardings.
+            param_specs = {
+                "pre": jax.tree_util.tree_map(lambda _: P(), params["pre"]),
+                "blocks": jax.tree_util.tree_map(lambda _: P("pp"),
+                                                 params["blocks"]),
+                "post": jax.tree_util.tree_map(lambda _: P(), params["post"]),
+            }
+            return jax.shard_map(
+                pipe, mesh=mesh,
+                in_specs=(param_specs, P(), P()),
+                out_specs=P(), check_vma=False)(params, batch_mb, labels_mb)
+
+        return loss
+
+    def _get_compiled_pipe(self, batch_mb, labels_mb):
+        key = (tuple(batch_mb.shape), str(batch_mb.dtype),
+               tuple(labels_mb.shape))
+        if key not in self._compiled_pipe:
+            loss_fn = (self._pipe_loss_fn() if self.pp_world_size > 1 else
+                       self._plain_gas_loss_fn())
+
+            def step_fn(params, master, opt_state, scale_state, batch_mb,
+                        labels_mb):
+                target = master if master is not None else params
+
+                def scaled(p):
+                    cp = jax.tree_util.tree_map(
+                        lambda t: t.astype(self.compute_dtype), p)
+                    return loss_fn(cp, batch_mb, labels_mb) * scale_state.scale
+
+                loss_val, grads = jax.value_and_grad(
+                    lambda p: scaled(p))(target)
+                grads = jax.tree_util.tree_map(
+                    lambda g, s: jax.lax.with_sharding_constraint(
+                        g.astype(jnp.float32), s),
+                    grads, self.plan.master_shardings(grads))
+                inv = 1.0 / scale_state.scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                from ..loss_scaler import has_overflow
+                from ..utils import clip_grads_by_global_norm, global_grad_norm
+                overflow = (has_overflow(grads) if self._config.fp16_enabled
+                            else jnp.zeros((), jnp.bool_))
+                gnorm = global_grad_norm(grads)
+                gc = self._config.gradient_clipping
+                if gc and gc > 0:
+                    grads, _ = clip_grads_by_global_norm(grads, gc, norm=gnorm)
+                updates, new_opt = self._grad_transform.update(
+                    grads, opt_state, target)
+                new_target = jax.tree_util.tree_map(
+                    lambda p, u: (p.astype(jnp.float32) +
+                                  u.astype(jnp.float32)).astype(p.dtype),
+                    target, updates)
+                sel = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(overflow, o, n), new, old)
+                new_target = sel(new_target, target)
+                new_opt = sel(new_opt, opt_state)
+                if master is not None:
+                    new_master = new_target
+                    new_params = jax.tree_util.tree_map(
+                        lambda m, s: jax.lax.with_sharding_constraint(
+                            m.astype(self.compute_dtype), s),
+                        new_master, self.plan.param_shardings(new_master))
+                else:
+                    new_master, new_params = None, new_target
+                new_scale = self.loss_scaler.update(scale_state, overflow)
+                return (new_params, new_master, new_opt, new_scale,
+                        loss_val / scale_state.scale, overflow)
+
+            self._compiled_pipe[key] = jax.jit(step_fn,
+                                               donate_argnums=(0, 1, 2))
+        return self._compiled_pipe[key]
+
+    def _plain_gas_loss_fn(self):
+        """pp=1 fallback: mean loss over the microbatch dim (vmap+mean)."""
+        apply_fn = self._apply_fn
+
+        def loss(params, batch_mb, labels_mb):
+            def one(b, l):
+                return apply_fn(params, b, l)
+
+            losses = jax.vmap(one)(batch_mb, labels_mb)
+            return jnp.mean(losses.astype(jnp.float32))
+
+        return loss
+
+    # -------------------------------------------------------------- public API
+    def train_batch(self, data_iter=None):
+        """One full training step over gas microbatches (reference
+        ``train_batch`` pipe/engine.py:338)."""
+        self._check_params()
+        if data_iter is None:
+            data_iter = iter(self.training_dataloader)
+        M = self.micro_batches
+        xs, ys = [], []
+        for _ in range(M):
+            batch = next(data_iter)
+            x, y = batch[0], batch[1]
+            xs.append(np.asarray(x))
+            ys.append(np.asarray(y))
+        batch_mb = jnp.asarray(np.stack(xs))   # [M, mb*dp, ...]
+        labels_mb = jnp.asarray(np.stack(ys))
+
+        # shard microbatch data over dp on dim 1
+        nd = batch_mb.ndim
+        spec = [None] * nd
+        spec[1] = groups.dp_axes()
+        batch_mb = jax.device_put(batch_mb, NamedSharding(self.mesh, P(*spec)))
+        nd = labels_mb.ndim
+        lspec = [None] * nd
+        lspec[1] = groups.dp_axes()
+        labels_mb = jax.device_put(labels_mb,
+                                   NamedSharding(self.mesh, P(*lspec)))
+
+        self.tput_timer.start()
+        step_fn = self._get_compiled_pipe(batch_mb, labels_mb)
+        (self.params, self.master, self.opt_state, self.scale_state, loss,
+         overflow) = step_fn(self.params, self.master, self.opt_state,
+                             self.scale_state, batch_mb, labels_mb)
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if bool(overflow):
+            self.skipped_steps += 1
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+        self.tput_timer.stop(global_step=True)
+        return loss
+
+    def eval_batch(self, data_iter, return_logits=False):
+        """Forward-only (reference ``eval_batch`` pipe/engine.py:441)."""
+        batch = next(data_iter)
+        x, y = np.asarray(batch[0]), np.asarray(batch[1])
+        loss_fn = self._plain_gas_loss_fn()
+        return loss_fn(self.params, jnp.asarray(x)[None], jnp.asarray(y)[None])
+
+    # forward/backward/step are not the PP interface (reference raises too)
+    def forward(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch/eval_batch "
+                           "(reference pipe/engine.py also disables forward())")
+
+    def backward(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch")
+
+    def step(self, *a, **k):
+        raise RuntimeError("PipelineEngine: use train_batch")

@@ -39,9 +39,21 @@ def shard_spec(shape, mesh: Mesh, axes, min_size=1, base_spec=None):
     """
     if not shape:
         return base_spec if base_spec is not None else P()
-    n = int(np.prod([mesh.shape[a] for a in axes], dtype=np.int64))
     base = list(base_spec) if base_spec is not None else []
     base = base + [None] * (len(shape) - len(base))
+    # Axes already claimed by the base spec are excluded: e.g. expert params
+    # sharded over "ep" take ZeRO sharding over "dp" only — which is exactly
+    # the reference's expert-DP reduction group (engine.py:2510
+    # _reduce_expert_gradients).
+    used = set()
+    for ax in base:
+        if ax is None:
+            continue
+        used.update(ax if isinstance(ax, tuple) else (ax, ))
+    axes = tuple(a for a in axes if a not in used)
+    if not axes:
+        return P(*base)
+    n = int(np.prod([mesh.shape[a] for a in axes], dtype=np.int64))
     if n <= 1 or int(np.prod(shape, dtype=np.int64)) < min_size:
         return P(*base)
     # largest unclaimed dim divisible by n; ties → first
@@ -85,16 +97,38 @@ def path_str(kp):
 
 
 def match_tp_rule(rules, path):
-    """Longest-suffix match of ``path`` against rule keys; the suffix must
-    start at a '/' component boundary (so 'wo/kernel' does not match
-    'moe_two/kernel')."""
+    """Match ``path`` against rule keys.
+
+    Two rule kinds, which COMPOSE rather than compete:
+
+    * exact suffix keys (``'q_proj/kernel'``) — longest suffix wins; the
+      suffix must start at a '/' component boundary (so ``'wo/kernel'`` does
+      not match ``'moe_two/kernel'``);
+    * scope wildcards (``'scope/*'`` or ``'a/b/*'``) — match any path that
+      contains that component sequence before the leaf; their spec claims the
+      *leading* dims (e.g. the stacked-layer dim of pipeline blocks or the
+      expert dim), and a simultaneously-matching exact rule's spec is appended after
+      it (so ``'blocks/*': P('pp')`` + ``'q_proj/kernel': P(None,'tp',None)``
+      → ``P('pp', None, 'tp', None)`` on a stacked param).
+    """
     if not rules:
         return None
     best, best_len = None, -1
-    for suffix, spec in rules.items():
-        if (path == suffix or path.endswith("/" + suffix)) and \
-                len(suffix) > best_len:
-            best, best_len = spec, len(suffix)
+    scope_spec, scope_len = None, -1
+    bounded = "/" + path
+    for key, spec in rules.items():
+        if key.endswith("/*"):
+            scope = key[:-2]
+            # component-boundary containment (multi-component scopes allowed)
+            if ("/" + scope + "/") in bounded and len(key) > scope_len:
+                scope_spec, scope_len = spec, len(key)
+            continue
+        if (path == key or path.endswith("/" + key)) and len(key) > best_len:
+            best, best_len = spec, len(key)
+    if scope_spec is not None and best is not None:
+        return P(*tuple(scope_spec) + tuple(best))
+    if scope_spec is not None:
+        return scope_spec
     return best
 
 

@@ -2,20 +2,21 @@
 
 TPU-native re-derivation of reference ``deepspeed/utils/groups.py:55-588`` +
 ``runtime/pipe/topology.py``: instead of materializing rank lists and creating
-NCCL communicators per group, we build ONE global ``jax.sharding.Mesh`` whose
-named axes factor the device grid into
+NCCL communicators per group, we build ONE global 5-axis
+``jax.sharding.Mesh``
 
-    (pp, dp, sp, tp)   — pipeline / data / sequence / tensor axes
+    (pp, dp, ep, sp, tp)   — pipeline / expert-data / expert / sequence /
+                             tensor axes
 
-with expert-parallel (ep) groups carved out of dp (reference
-``moe/layer.py:89 _create_process_groups``) and ZeRO secondary-partition (hpZ)
-groups as an intra-host sub-axis.  Any communication "group" is then just a
-tuple of axis names (see ``deepspeed_tpu.comm.backend.ProcessGroup``), and XLA
-lays the collectives onto ICI along those axes.
+where the FULL data-parallel degree is the product of ("dp", "ep") — see the
+axis-name comment below.  ZeRO secondary-partition (hpZ) groups live on a
+separate reshaped mesh.  Any communication "group" is then just a tuple of
+axis names (see ``deepspeed_tpu.comm.backend.ProcessGroup``), and XLA lays the
+collectives onto ICI along those axes.
 
 Axis order: the *rightmost* mesh axes are most-minor (fastest-varying device
 index) and therefore map to physically-closest chips; we order
-(pp, dp, sp, tp) so tensor-parallel collectives (latency-bound, per-layer)
+(pp, dp, ep, sp, tp) so tensor-parallel collectives (latency-bound, per-layer)
 ride the shortest ICI hops, matching how Megatron orders NCCL groups.
 """
 
@@ -29,14 +30,19 @@ from jax.sharding import Mesh
 
 from .logging import logger
 
-# Canonical axis names, most-major → most-minor.
+# Canonical axis names, most-major → most-minor.  The global mesh is ALWAYS
+# 5-axis (pp, dp, ep, sp, tp): "dp" is the expert-data-parallel part and the
+# full data-parallel degree is the product of ("dp", "ep") — when ep=1 they
+# coincide.  Keeping expert parallelism as a first-class axis of the ONE
+# global mesh (instead of the reference's separate expert process groups,
+# utils/groups.py:117-310) lets a single jitted step shard experts over "ep"
+# while ZeRO shards state over ("dp","ep").
 PP_AXIS = "pp"
 DP_AXIS = "dp"
 SP_AXIS = "sp"
 TP_AXIS = "tp"
-# Expert parallelism reuses a reshape of (dp,) — see expert_mesh().
 EP_AXIS = "ep"
-EDP_AXIS = "expert_dp"
+EDP_AXIS = DP_AXIS  # expert-data-parallel IS the dp axis
 # hpZ (ZeRO++ secondary partition) axes: dp = zp_outer × zp
 ZP_AXIS = "zp"
 ZP_OUTER_AXIS = "zp_outer"
@@ -48,12 +54,10 @@ _mesh_state = None
 class MeshState:
     mesh: Mesh
     pp: int
-    dp: int
+    dp: int  # TOTAL data-parallel degree (= mesh dp × ep)
     sp: int
     tp: int
     ep: int = 1
-    # expert mesh shares devices with `mesh` but reshapes dp → (expert_dp, ep)
-    expert_mesh: Mesh = None
     # hpZ mesh reshapes dp → (zp_outer, zp); params secondarily replicated
     # within the (intra-host) zp axis
     hpz_mesh: Mesh = None
@@ -91,13 +95,8 @@ def initialize_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None,
         raise ValueError(f"expert parallel size ep={ep} must divide dp={dp} "
                          f"(reference moe/layer.py:89 semantics)")
 
-    grid = devices.reshape(pp, dp, sp, tp)
-    mesh = Mesh(grid, axis_names=(PP_AXIS, DP_AXIS, SP_AXIS, TP_AXIS))
-
-    # Expert mesh shares the same devices; built unconditionally (cheap) so
-    # ep=1 accessors still work.
-    egrid = devices.reshape(pp, dp // ep, ep, sp, tp)
-    expert_mesh = Mesh(egrid, axis_names=(PP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
+    grid = devices.reshape(pp, dp // ep, ep, sp, tp)
+    mesh = Mesh(grid, axis_names=(PP_AXIS, DP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
 
     # hpZ secondary-partition mesh: dp factored into (outer, inner) where the
     # inner axis groups physically-adjacent chips (intra-host) — reference
@@ -113,7 +112,7 @@ def initialize_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None,
                                            SP_AXIS, TP_AXIS))
 
     _mesh_state = MeshState(mesh=mesh, pp=pp, dp=dp, sp=sp, tp=tp, ep=ep,
-                            expert_mesh=expert_mesh, hpz_mesh=hpz_mesh,
+                            hpz_mesh=hpz_mesh,
                             zero_partition_size=zero_partition_size)
     logger.debug(f"initialized mesh pp={pp} dp={dp} sp={sp} tp={tp} ep={ep}")
     # Keep an already-created comm backend in sync so facade collectives and
@@ -145,8 +144,9 @@ def get_global_mesh() -> Mesh:
     return get_mesh_state().mesh
 
 
-def get_expert_mesh() -> Mesh:
-    return get_mesh_state().expert_mesh
+def dp_axes():
+    """Mesh axes whose product is the full data-parallel degree."""
+    return (DP_AXIS, EP_AXIS)
 
 
 # ----------------------------------------------------------------- group API
@@ -158,7 +158,7 @@ def _pg(axes, mesh=None):
 
 
 def _get_data_parallel_group():
-    return _pg((DP_AXIS, ))
+    return _pg(dp_axes())
 
 
 def _get_sequence_parallel_group():
@@ -168,7 +168,7 @@ def _get_sequence_parallel_group():
 def _get_sequence_data_parallel_group():
     """ZeRO shards over the combined seq×dp group when SP is on (reference
     ``engine.py:1580,1651`` seq_data_parallel_group)."""
-    return _pg((DP_AXIS, SP_AXIS))
+    return _pg(dp_axes() + (SP_AXIS, ))
 
 
 def _get_model_parallel_group():
@@ -180,11 +180,13 @@ def _get_pipe_parallel_group():
 
 
 def _get_expert_parallel_group():
-    return _pg((EP_AXIS, ), mesh=get_expert_mesh())
+    return _pg((EP_AXIS, ))
 
 
 def _get_expert_data_parallel_group():
-    return _pg((EDP_AXIS, ), mesh=get_expert_mesh())
+    """Grads of expert params reduce over this group only (reference
+    engine.py:2510 _reduce_expert_gradients)."""
+    return _pg((DP_AXIS, ))
 
 
 def _get_zero_param_partition_group():
@@ -224,4 +226,4 @@ def _get_data_parallel_rank():
 
 def zero_sharding_axes(sequence_parallel=False):
     """Mesh axes over which ZeRO partitions optimizer/grad/param state."""
-    return (DP_AXIS, SP_AXIS) if sequence_parallel else (DP_AXIS, )
+    return dp_axes() + ((SP_AXIS, ) if sequence_parallel else ())

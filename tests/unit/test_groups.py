@@ -26,14 +26,16 @@ def test_invalid_factorization_raises():
         groups.initialize_mesh(pp=3, dp=3)
 
 
-def test_expert_mesh():
+def test_expert_axes():
     st = groups.initialize_mesh(dp=8, ep=4)
-    assert st.expert_mesh.shape["ep"] == 4
-    assert st.expert_mesh.shape["expert_dp"] == 2
+    assert st.mesh.shape["ep"] == 4
+    assert st.mesh.shape["dp"] == 2  # expert-dp part
+    assert st.dp == 8  # total data-parallel degree
     g = groups._get_expert_parallel_group()
     assert g.size() == 4
     g2 = groups._get_expert_data_parallel_group()
     assert g2.size() == 2
+    assert groups._get_data_parallel_group().size() == 8
 
 
 def test_ep_must_divide_dp():
@@ -50,8 +52,8 @@ def test_seq_data_parallel_group():
 
 def test_zero_sharding_axes():
     groups.initialize_mesh(dp=4, sp=2)
-    assert groups.zero_sharding_axes(sequence_parallel=True) == ("dp", "sp")
-    assert groups.zero_sharding_axes() == ("dp", )
+    assert groups.zero_sharding_axes(sequence_parallel=True) == ("dp", "ep", "sp")
+    assert groups.zero_sharding_axes() == ("dp", "ep")
 
 
 def test_hpz_mesh():
