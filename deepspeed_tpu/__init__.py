@@ -88,8 +88,15 @@ def init_inference(model, config=None, **kwargs):
     if config is None:
         config = {}
     if isinstance(config, DeepSpeedInferenceConfig):
+        if kwargs:
+            # merge explicit kwargs over the config object (reference
+            # init_inference rejects double-specification; we apply overrides)
+            merged = config.model_dump()
+            merged.update(kwargs)
+            config = DeepSpeedInferenceConfig(**merged)
         ds_inference_config = config
     else:
+        config = dict(config)
         config.update(kwargs)
         ds_inference_config = DeepSpeedInferenceConfig(**config)
     return InferenceEngine(model, config=ds_inference_config)

@@ -1,0 +1,89 @@
+"""Inference config — same JSON schema as reference ``inference/config.py``
+(``DeepSpeedInferenceConfig``, ``DeepSpeedTPConfig`` :333) so existing
+DeepSpeed inference configs run unmodified.  CUDA-only knobs
+(``use_triton``, cuda-graph) are accepted and mapped to their XLA analogs
+(jit compilation cache *is* the graph capture) or ignored with a log line.
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py`` TP block."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1], alias="num_experts")
+    ep_mp_group: Optional[Any] = None
+    ep_group: Optional[Any] = None
+
+
+class QuantTypeConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+    group_dim: int = 0
+    symmetric: bool = True
+
+
+class InferenceQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    activation: QuantTypeConfig = Field(default_factory=QuantTypeConfig)
+    weight: QuantTypeConfig = Field(default_factory=QuantTypeConfig)
+    qkv: QuantTypeConfig = Field(default_factory=QuantTypeConfig)
+
+
+class InferenceCheckpointConfig(DeepSpeedConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Top-level inference engine config (reference ``inference/config.py``)."""
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False  # XLA: jit cache plays this role
+    use_triton: bool = False
+    triton_autotune: bool = False
+    zero: Dict = Field(default_factory=dict)
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: InferenceQuantConfig = Field(default_factory=InferenceQuantConfig)
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: InferenceCheckpointConfig = Field(
+        default_factory=InferenceCheckpointConfig, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = False
+    mp_size: int = Field(1, deprecated=True)
+
+    def __init__(self, **data):
+        # legacy alias: mp_size → tensor_parallel.tp_size
+        # (reference inference/config.py handles the same migration)
+        mp = data.pop("mp_size", None)
+        super().__init__(**data)
+        if mp is not None and int(mp) > 1 and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = int(mp)

@@ -1,0 +1,273 @@
+"""InferenceEngine (v1) — TPU-native re-design of reference
+``inference/engine.py:41``.
+
+Reference flow: build TP groups (:249), swap transformer blocks for fused
+CUDA kernels or AutoTP-shard the linears (:403), optionally capture a CUDA
+graph (:519), wrap ``generate`` (:608).
+
+TPU flow:
+* TP groups      → a ``tp`` axis on the global mesh (``utils/groups.py``);
+* kernel-inject  → unnecessary as module surgery: XLA fuses the block; the
+  hot kernels (attention) already route through ``ops/attention.py``
+  (Pallas-ready).  ``replace_with_kernel_inject`` is accepted and simply
+  keeps the same jitted path;
+* AutoTP         → ``module_inject.auto_tp`` sharding rules + GSPMD;
+* CUDA graph     → the jit cache: every (batch, seq) bucket compiles once
+  and replays;
+* generate       → static-shape KV cache (``models/cache.py``) with a jitted
+  prefill and a ``lax.scan`` decode loop — the whole token loop is ONE
+  XLA program, the TPU analog of FastGen's persistent decode kernels.
+"""
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..module_inject.auto_tp import AutoTP, shard_params_for_tp
+from ..utils import groups
+from ..utils.logging import log_dist, logger
+from .config import DeepSpeedInferenceConfig
+
+
+def _model_tp_rules(module):
+    """Look up the ``tp_rules(config)`` helper next to the model class
+    (our model families each export one — e.g. ``models/llama.py:tp_rules``)."""
+    import sys
+    mod = sys.modules.get(type(module).__module__)
+    fn = getattr(mod, "tp_rules", None)
+    if fn is not None and hasattr(module, "config"):
+        try:
+            return fn(module.config)
+        except TypeError:
+            pass
+    return None
+
+
+class InferenceEngine:
+    """Wraps a flax module (+ params) for TP-sharded, KV-cached serving."""
+
+    def __init__(self, model, config=None, params=None):
+        if config is None:
+            config = DeepSpeedInferenceConfig()
+        elif isinstance(config, dict):
+            config = DeepSpeedInferenceConfig(**config)
+        self._config = config
+
+        # accept (module, params) tuples and training engines
+        if isinstance(model, tuple):
+            model, params = model
+        if hasattr(model, "module") and hasattr(model, "params"):  # engine
+            params = model.params if params is None else params
+            model = model.module
+        self.module = model
+        if params is None:
+            raise ValueError(
+                "InferenceEngine needs parameters: pass params=, a "
+                "(module, params) tuple, or a training engine")
+
+        tp_size = config.tensor_parallel.tp_size
+        # mesh before init_distributed: the latter builds a default (all-dp)
+        # mesh if none exists, which would pin tp=1
+        if not groups.mesh_is_initialized():
+            groups.initialize_mesh(tp=tp_size)
+        if not dist.is_initialized():
+            dist.init_distributed()
+        self.mesh = groups.get_global_mesh()
+        mesh_tp = self.mesh.shape.get("tp", 1)
+        if tp_size > 1 and mesh_tp != tp_size:
+            logger.warning(
+                "init_inference requested tp_size=%d but the existing global "
+                "mesh has tp=%d — serving with tp=%d (reset the mesh via "
+                "groups.reset_mesh() before init_inference to change it)",
+                tp_size, mesh_tp, mesh_tp)
+        self._tp_enabled = mesh_tp > 1
+
+        # precision: cast float leaves to the serving dtype (reference
+        # engine.py:46 converts the module to config.dtype)
+        dtype = jnp.dtype("bfloat16" if config.dtype in
+                          ("bf16", "bfloat16") else config.dtype)
+        self.dtype = dtype
+
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+        params = jax.tree.map(cast, params)
+
+        # TP sharding (AutoTP analog); injection_policy overrides
+        rules = None
+        if self._tp_enabled:
+            rules = (config.injection_policy or _model_tp_rules(model)
+                     or AutoTP.derive_rules(params))
+            log_dist(f"AutoTP: {len(rules)} sharding rules", ranks=[0])
+        with self.mesh:
+            if rules is not None:
+                self.params = shard_params_for_tp(params, self.mesh, rules)
+            else:
+                self.params = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(self.mesh, P())), params)
+        self._tp_rules = rules
+
+        self._accepts_positions = "positions" in inspect.signature(
+            type(model).__call__).parameters
+        self._accepts_decode = "decode" in inspect.signature(
+            type(model).__call__).parameters
+
+        self._jit_forward = jax.jit(self._forward_impl)
+        self._jit_prefill = jax.jit(self._prefill_impl)
+        self._jit_decode = jax.jit(self._decode_impl,
+                                   static_argnames=("steps", "do_sample",
+                                                    "top_k", "top_p",
+                                                    "eos_token_id"))
+        self._cache_struct = {}
+
+    # ------------------------------------------------------------- forward
+    def _forward_impl(self, params, input_ids):
+        return self.module.apply({"params": params}, input_ids)
+
+    def forward(self, input_ids, **kwargs):
+        """Full (non-cached) forward → logits.  Reference engine forward
+        w/ graph replay (``inference/engine.py:538``) ≙ the jit cache."""
+        with self.mesh:
+            return self._jit_forward(self.params, jnp.asarray(input_ids))
+
+    __call__ = forward
+
+    # -------------------------------------------------------------- cache
+    def _init_cache(self, batch, max_len):
+        key = (batch, max_len)
+        if key not in self._cache_struct:
+            shapes = jax.eval_shape(
+                lambda: self.module.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((batch, max_len), jnp.int32), decode=True))
+            self._cache_struct[key] = shapes["cache"]
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self._cache_struct[key])
+
+    def _prefill_impl(self, params, cache, input_ids):
+        kw = {"positions": jnp.arange(input_ids.shape[1])[None, :]
+              } if self._accepts_positions else {}
+        logits, mut = self.module.apply({"params": params, "cache": cache},
+                                        input_ids, decode=True,
+                                        mutable=["cache"], **kw)
+        return logits[:, -1, :], mut["cache"]
+
+    def _decode_impl(self, params, cache, first_logits, rng, pos0, *, steps,
+                     do_sample, top_k, eos_token_id, temperature, top_p):
+        """ONE compiled XLA program for the whole decode loop."""
+
+        def sample(logits, key):
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1)
+
+        eos = -1 if eos_token_id is None else eos_token_id
+
+        def body(carry, _):
+            cache, logits, rng, pos, done = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample(logits, sub)
+            tok = jnp.where(done, eos if eos >= 0 else 0, tok)
+            done = done | (tok == eos)
+            kw = ({"positions": pos[None, None] + jnp.zeros(
+                (tok.shape[0], 1), jnp.int32)}
+                  if self._accepts_positions else {})
+            out, mut = self.module.apply(
+                {"params": params, "cache": cache}, tok[:, None], decode=True,
+                mutable=["cache"], **kw)
+            return (mut["cache"], out[:, -1, :], rng, pos + 1, done), tok
+
+        B = first_logits.shape[0]
+        init = (cache, first_logits, rng, pos0,
+                jnp.zeros((B, ), dtype=bool))
+        (_, _, _, _, _), toks = lax.scan(body, init, None, length=steps)
+        return toks.T  # [B, steps]
+
+    # ------------------------------------------------------------ generate
+    def generate(self, input_ids, max_new_tokens=None, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 rng=None, **kwargs):
+        """Token-id generation (reference ``engine.py:608`` wraps HF
+        ``generate``; here the loop is native and fully jitted)."""
+        if not self._accepts_decode:
+            raise ValueError(f"{type(self.module).__name__} has no KV-cache "
+                             "decode path")
+        if "attention_mask" in kwargs:
+            mask = kwargs.pop("attention_mask")
+            if mask is not None and not bool(jnp.all(jnp.asarray(mask) == 1)):
+                raise NotImplementedError(
+                    "generate() assumes unpadded same-length prompts; "
+                    "left-padded attention_mask batching is the ragged "
+                    "(inference v2) engine's job")
+        for k in kwargs:
+            logger.warning("generate(): ignoring unsupported argument %r", k)
+        input_ids = jnp.asarray(input_ids)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None, :]
+        B, S0 = input_ids.shape
+        steps = max_new_tokens or max(self._config.max_out_tokens - S0, 1)
+        max_pos = getattr(getattr(self.module, "config", None),
+                          "max_position_embeddings", None)
+        if max_pos is not None:
+            if S0 >= max_pos:
+                raise ValueError(f"prompt length {S0} ≥ model "
+                                 f"max_position_embeddings {max_pos}")
+            if S0 + steps > max_pos:
+                logger.warning(
+                    "generate: clamping %d new tokens to %d "
+                    "(max_position_embeddings=%d)", steps, max_pos - S0,
+                    max_pos)
+                steps = max_pos - S0
+        max_len = S0 + steps
+        rng = jax.random.PRNGKey(0) if rng is None else rng
+
+        with self.mesh:
+            cache = self._init_cache(B, max_len)
+            logits, cache = self._jit_prefill(self.params, cache, input_ids)
+            new = self._jit_decode(
+                self.params, cache, logits, rng, jnp.int32(S0), steps=steps,
+                do_sample=do_sample, top_k=top_k, eos_token_id=eos_token_id,
+                temperature=temperature, top_p=top_p)
+        return jnp.concatenate([input_ids, new], axis=1)
+
+    # --------------------------------------------------------- checkpoints
+    def load_checkpoint(self, load_dir, tag=None):
+        """Load the ``model/`` tree from a training-engine checkpoint
+        (layout: ``runtime/checkpoint_engine.py``)."""
+        import os
+        from ..runtime.checkpoint_engine import _pytree_restore
+        load_dir = os.path.abspath(load_dir)
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        restored = _pytree_restore(os.path.join(load_dir, str(tag), "model"))
+        # preserve dtype AND the TP sharding applied in __init__
+        self.params = jax.tree.map(
+            lambda new, old: jax.device_put(
+                jnp.asarray(new).astype(old.dtype), old.sharding), restored,
+            self.params)
+        return self
+
+    @property
+    def config(self):
+        return self._config
+
+    def empty_cache(self):
+        self._cache_struct.clear()

@@ -1,0 +1,79 @@
+"""KV-cache machinery for autoregressive decode.
+
+TPU-native answer to the reference's inference KV handling (v1 kernels
+``csrc/transformer/inference/csrc/transform.cu`` copy KV into a contiguous
+cache; FastGen's blocked KV in ``inference/v2/ragged/kv_cache.py``).  Here the
+cache is a flax ``"cache"`` variable collection with **static shapes** so the
+whole decode loop jits once:
+
+* ``cached_key/cached_value`` — [B, max_len, Hkv, Dh] ring-less buffers;
+* ``cache_index``             — scalar int32 write cursor;
+* prefill writes S tokens at index 0, each decode step appends 1 token via
+  ``lax.dynamic_update_slice`` (no dynamic shapes → no recompilation).
+
+The cache is created by ``model.init(..., decode=True)`` on a [B, max_len]
+dummy — the init pass sizes the buffers; subsequent ``apply(...,
+mutable=["cache"])`` calls stream tokens through it.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def kv_cache_update(module, k, v, rotate_fn=None):
+    """Create-or-append to the module's KV cache.
+
+    ``k``/``v``: freshly projected [B, S, Hkv, Dh] (pre-rotary).
+    ``rotate_fn(k, start_index)``: optional positional rotation applied to the
+    keys *before* they are stored (the cache holds rotated keys so decode
+    steps never re-rotate history).
+
+    Returns ``(k_full, v_full, start_index)`` where ``start_index`` is the
+    cursor *before* this write (callers rotate q with it).
+    """
+    is_initialized = module.has_variable("cache", "cached_key")
+    cached_key = module.variable("cache", "cached_key", jnp.zeros, k.shape,
+                                 k.dtype)
+    cached_value = module.variable("cache", "cached_value", jnp.zeros, v.shape,
+                                   v.dtype)
+    cache_index = module.variable("cache", "cache_index",
+                                  lambda: jnp.zeros((), jnp.int32))
+    if not is_initialized:
+        # init pass: the [B, max_len] dummy input sizes the buffers
+        idx = jnp.zeros((), jnp.int32)
+        if rotate_fn is not None:
+            k = rotate_fn(k, idx)
+        return k, v, idx
+
+    idx = cache_index.value
+    if rotate_fn is not None:
+        k = rotate_fn(k, idx)
+    cached_key.value = lax.dynamic_update_slice(
+        cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0))
+    cached_value.value = lax.dynamic_update_slice(
+        cached_value.value, v.astype(cached_value.value.dtype), (0, idx, 0, 0))
+    cache_index.value = idx + k.shape[1]
+    return cached_key.value, cached_value.value, idx
+
+
+def decode_attention(q, k_full, v_full, start_index, softmax_scale=None):
+    """Attention of S query tokens (global positions ``start_index + s``)
+    over a full-length KV buffer, masked so query s sees keys
+    ``j <= start_index + s``.  Degenerates to plain causal attention for the
+    prefill/init pass (start_index == 0, S == L).
+
+    q: [B, S, H, Dh]; k_full/v_full: [B, L, H, Dh].
+    """
+    B, S, H, Dh = q.shape
+    L = k_full.shape[1]
+    scale = softmax_scale if softmax_scale is not None else Dh**-0.5
+    scores = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+                        k_full.astype(jnp.float32)) * scale
+    key_pos = jnp.arange(L)[None, :]
+    query_pos = start_index + jnp.arange(S)[:, None]
+    mask = key_pos <= query_pos                      # [S, L]
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsl,blhd->bshd", probs, v_full.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -50,7 +50,7 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S, D = x.shape
@@ -63,7 +63,11 @@ class GPT2Block(nn.Module):
         q = dense(features=(H, Dh), name="q_proj")(h)
         k = dense(features=(H, Dh), name="k_proj")(h)
         v = dense(features=(H, Dh), name="v_proj")(h)
-        if cfg.use_ulysses:
+        if decode:
+            from .cache import decode_attention, kv_cache_update
+            k, v, start = kv_cache_update(self, k, v)
+            attn_out = decode_attention(q, k, v, start)
+        elif cfg.use_ulysses:
             from ..sequence.layer import DistributedAttention
             attn_out = DistributedAttention()(q, k, v, causal=True)
         else:
@@ -83,7 +87,8 @@ class GPT2Model(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, labels=None, attention_mask=None):
+    def __call__(self, input_ids, labels=None, attention_mask=None,
+                 decode=False, positions=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S = input_ids.shape
@@ -91,14 +96,17 @@ class GPT2Model(nn.Module):
                        param_dtype=jnp.float32, name="wte")
         wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        dtype=dtype, param_dtype=jnp.float32, name="wpe")
-        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = wte(input_ids) + wpe(positions)
 
         block = GPT2Block
-        if cfg.remat:
+        if cfg.remat and not decode:
             block = nn.remat(GPT2Block,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=jax.checkpoint_policies.nothing_saveable,
+                             static_argnums=(2, ))
         for i in range(cfg.num_hidden_layers):
-            x = block(cfg, name=f"h_{i}")(x)
+            x = block(cfg, name=f"h_{i}")(x, decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
         logits = wte.attend(x.astype(jnp.float32))

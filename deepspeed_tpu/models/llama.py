@@ -114,7 +114,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, attention_mask=None):
+    def __call__(self, x, attention_mask=None, decode=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S, D = x.shape
@@ -128,21 +128,41 @@ class LlamaAttention(nn.Module):
 
         cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
         cos, sin = jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32)
-        q = apply_rotary(q, cos, sin)
-        k = apply_rotary(k, cos, sin)
 
-        # GQA: repeat kv heads up to H
-        if Hkv != H:
-            rep = H // Hkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if decode:
+            # KV-cached path (inference): rotary offset by the cache cursor,
+            # keys stored rotated (models/cache.py).
+            from .cache import decode_attention, kv_cache_update
 
-        if cfg.use_ulysses:
-            from ..sequence.layer import DistributedAttention
-            out = DistributedAttention()(q, k, v, causal=True)
+            def rotate_k(kk, start):
+                pos = start + jnp.arange(kk.shape[1])[None, :]
+                return apply_rotary(kk, cos, sin, positions=pos)
+
+            k, v, start = kv_cache_update(self, k, v, rotate_fn=rotate_k)
+            q = apply_rotary(
+                q, cos, sin,
+                positions=start + jnp.arange(S)[None, :])
+            if Hkv != H:
+                rep = H // Hkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = decode_attention(q, k, v, start)
         else:
-            from ..ops.attention import attention_core
-            out = attention_core(q, k, v, causal=True)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+
+            # GQA: repeat kv heads up to H
+            if Hkv != H:
+                rep = H // Hkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+
+            if cfg.use_ulysses:
+                from ..sequence.layer import DistributedAttention
+                out = DistributedAttention()(q, k, v, causal=True)
+            else:
+                from ..ops.attention import attention_core
+                out = attention_core(q, k, v, causal=True)
 
         out = out.reshape(B, S, H * Dh)
         return dense(features=D, axis=-1, name="o_proj")(out)
@@ -166,12 +186,12 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, attention_mask=None):
+    def __call__(self, x, attention_mask=None, decode=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         h = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, dtype, name="input_layernorm")(x),
-            attention_mask)
+            attention_mask, decode=decode)
         return h + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, dtype, name="post_attention_layernorm")(h))
 
@@ -182,7 +202,8 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, labels=None, attention_mask=None):
+    def __call__(self, input_ids, labels=None, attention_mask=None,
+                 decode=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
@@ -191,11 +212,11 @@ class LlamaModel(nn.Module):
         x = embed(input_ids)
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and not decode:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-            block = nn.remat(LlamaBlock, policy=policy)
+            block = nn.remat(LlamaBlock, policy=policy, static_argnums=(3, ))
         for i in range(cfg.num_hidden_layers):
-            x = block(cfg, name=f"layers_{i}")(x, attention_mask)
+            x = block(cfg, name=f"layers_{i}")(x, attention_mask, decode)
 
         x = RMSNorm(cfg.rms_norm_eps, dtype, name="norm")(x)
         if cfg.tie_word_embeddings:

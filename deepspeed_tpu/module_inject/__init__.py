@@ -1,0 +1,2 @@
+from .auto_tp import AutoTP, shard_params_for_tp
+from .layers import ColumnParallelLinear, RowParallelLinear, LinearAllreduce, LinearLayer

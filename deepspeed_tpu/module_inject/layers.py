@@ -1,0 +1,78 @@
+"""Tensor-parallel linear layers — analogs of reference
+``module_inject/layers.py`` (``LinearLayer`` :124, ``LinearAllreduce`` :78,
+``LmHeadLinearAllreduce`` :95).
+
+The reference implements row-parallel linears by computing a partial matmul
+per rank then calling ``dist.inference_all_reduce``.  On TPU the same
+structure is expressed declaratively: the kernel carries a sharding
+constraint and XLA GSPMD inserts the all-reduce (over the ``tp`` mesh axis)
+at the reduce point.  These modules exist so hand-written inference models
+can opt into TP without AutoTP rule derivation.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.zero.partition import shard_spec  # noqa: F401  (re-export)
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except ValueError:
+        return x  # no mesh context — single-device path
+
+
+class ColumnParallelLinear(nn.Module):
+    """Output-feature-sharded linear: y[..., f] with f split over ``tp``.
+    Reference ``LinearLayer`` (module_inject/layers.py:124)."""
+    features: int
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.with_partitioning(
+                nn.initializers.lecun_normal(), (None, self.tp_axis)),
+            (x.shape[-1], self.features), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.with_partitioning(nn.initializers.zeros,
+                                             (self.tp_axis, )),
+                (self.features, ), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return _constrain(y, P(*(None, ) * (x.ndim - 1), self.tp_axis))
+
+
+class RowParallelLinear(nn.Module):
+    """Input-feature-sharded linear; the contraction over the sharded dim is
+    the all-reduce point (XLA inserts it).  Reference ``LinearAllreduce``
+    (module_inject/layers.py:78)."""
+    features: int
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.with_partitioning(
+                nn.initializers.lecun_normal(), (self.tp_axis, None)),
+            (x.shape[-1], self.features), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        y = _constrain(y, P(*(None, ) * y.ndim))  # replicated after reduce
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features, ), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+# reference-compatible names
+LinearLayer = ColumnParallelLinear
+LinearAllreduce = RowParallelLinear
