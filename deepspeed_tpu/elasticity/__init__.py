@@ -1,0 +1,8 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config,
+                         get_compatible_gpus)
+from .constants import (ELASTICITY, ENABLED, ENABLED_DEFAULT,
+                        MAX_ACCEPTABLE_BATCH_SIZE,
+                        MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT, MICRO_BATCHES,
+                        MICRO_BATCHES_DEFAULT)
+from .elastic_agent import DSElasticAgent

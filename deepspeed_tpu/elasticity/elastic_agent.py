@@ -1,0 +1,58 @@
+"""Elastic worker agent — reference ``elasticity/elastic_agent.py:32``
+(``DSElasticAgent(LocalElasticAgent)`` atop torchelastic).
+
+TPU analog: there is no torchelastic; the agent is a restart supervisor used
+by ``launcher/launch.py --enable_elastic_training``.  On worker failure it
+recomputes the admissible-chip-count schedule (``compute_elastic_config``)
+against the surviving hosts and relaunches — checkpoint+resume (the
+reference's real recovery story, SURVEY.md §5) does the state recovery.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+from .elasticity import (ElasticityIncompatibleWorldSize,
+                         compute_elastic_config)
+
+
+class DSElasticAgent:
+    def __init__(self, cmd, env, ds_config, min_nodes=1, max_nodes=None,
+                 max_restarts=100, monitor_interval=1.0):
+        self.cmd = list(cmd)
+        self.env = dict(env)
+        self.ds_config = ds_config
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.max_restarts = max_restarts
+        self.monitor_interval = monitor_interval
+        self.restart_count = 0
+
+    def _validate_world(self, world_size):
+        try:
+            compute_elastic_config(self.ds_config, world_size=world_size)
+            return True
+        except ElasticityIncompatibleWorldSize:
+            return False
+
+    def run(self, world_size):
+        """Supervise one local worker; restart on failure up to
+        max_restarts as long as the world size stays admissible."""
+        while True:
+            if not self._validate_world(world_size):
+                raise ElasticityIncompatibleWorldSize(
+                    f"cannot run with world size {world_size}")
+            proc = subprocess.Popen(self.cmd, env=self.env)
+            while proc.poll() is None:
+                time.sleep(self.monitor_interval)
+            if proc.returncode == 0:
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                logger.error("elastic agent: max restarts exceeded")
+                return proc.returncode
+            logger.warning(
+                "elastic agent: worker died rc=%s; restart %d/%d",
+                proc.returncode, self.restart_count, self.max_restarts)

@@ -1,0 +1,191 @@
+"""Elastic batch-size scheduling — reference ``elasticity/elasticity.py``.
+
+The contract (reference ``compute_elastic_config`` :233): from an elasticity
+config block, produce a final train batch size that is simultaneously
+divisible into (micro_batch × grad_accum × world_size) for EVERY admissible
+chip count, so the job can lose or gain hosts and resume from checkpoint
+without changing the effective batch (loss-curve-stable elasticity).
+
+v0.1 (:83): batch = highly-composite multiple of some micro-batch candidate;
+v0.2 (:126): adds fixed micro-batch per chip-count and model-parallel /
+chips-per-node divisibility constraints.
+"""
+
+import numpy as np
+
+from ..utils.logging import logger
+from . import constants as C
+
+
+class ElasticityError(Exception):
+    """Base elasticity error (reference elasticity/config.py)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+# Candidate multipliers: highly-composite numbers — many divisors → many
+# admissible chip counts (reference HCN_LIST)
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040]
+
+
+def _candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    candidates = set()
+    for base in base_list:
+        for hcn in HCN_LIST:
+            if base * hcn <= max_acceptable_batch_size:
+                candidates.add(base * hcn)
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """All chip counts g for which batch_size = mbs × gas × g works for some
+    admissible micro batch (reference ``_get_valid_gpus``)."""
+    valid = set()
+    for mbs in micro_batches:
+        if batch_size % mbs != 0:
+            continue
+        total_micros = batch_size // mbs
+        for g in range(1, total_micros + 1):
+            if total_micros % g == 0 and min_valid_gpus <= g <= max_valid_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus,
+                        max_gpus, prefer_larger):
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        current = get_valid_gpus(batch_size, micro_batches, min_gpus,
+                                 max_gpus)
+        better = (len(current), batch_size if prefer_larger else -batch_size)
+        best = (max_valid_gpus,
+                final_batch_size if prefer_larger else -final_batch_size)
+        if current and better > best:
+            max_valid_gpus = len(current)
+            valid_gpus = current
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def get_compatible_gpus(micro_batches, max_acceptable_batch_size, min_gpus=1,
+                        max_gpus=None, prefer_larger=True,
+                        num_gpus_per_node=1, model_parallel_size=1,
+                        version=0.1):
+    """Core solver (reference ``_get_compatible_gpus_v01``/``_v02``)."""
+    if version not in (0.1, 0.2):
+        raise ElasticityConfigError(f"Unknown elasticity version {version}")
+    max_gpus = max_gpus or max_acceptable_batch_size
+    micro_batches = sorted(set(int(m) for m in micro_batches))
+    if any(m <= 0 for m in micro_batches):
+        raise ElasticityConfigError("micro batches must be positive")
+
+    if version == 0.2 and (model_parallel_size > 1 or num_gpus_per_node > 1):
+        # data-parallel replicas come in groups of (chips_per_node / mp) —
+        # constrain admissible chip counts to whole-node multiples of mp
+        group = int(np.lcm(num_gpus_per_node, model_parallel_size))
+        candidates = _candidate_batch_sizes(micro_batches,
+                                            max_acceptable_batch_size)
+        batch, gpus = get_best_candidates(candidates, micro_batches,
+                                          min_gpus, max_gpus, prefer_larger)
+        if gpus is None:
+            raise ElasticityConfigError(
+                f"No valid chip counts for max batch "
+                f"{max_acceptable_batch_size} with micros {micro_batches}")
+        gpus = [g * model_parallel_size for g in gpus
+                if (g * model_parallel_size) % group == 0
+                and g * model_parallel_size <= max_gpus]
+        if not gpus:
+            raise ElasticityConfigError(
+                "model-parallel/node constraints eliminated every chip count")
+        return batch, gpus
+
+    candidates = _candidate_batch_sizes(micro_batches,
+                                        max_acceptable_batch_size)
+    batch, gpus = get_best_candidates(candidates, micro_batches, min_gpus,
+                                      max_gpus, prefer_larger)
+    if gpus is None:
+        raise ElasticityConfigError(
+            f"No valid chip counts for max batch {max_acceptable_batch_size} "
+            f"with micros {micro_batches}")
+    return batch, gpus
+
+
+def _micro_batch_for(final_batch_size, world_size, micro_batches,
+                     prefer_larger):
+    candidates = [m for m in sorted(micro_batches, reverse=prefer_larger)
+                  if final_batch_size % (m * world_size) == 0]
+    if not candidates:
+        return None
+    return candidates[0]
+
+
+def elasticity_enabled(ds_config: dict):
+    return ds_config.get(C.ELASTICITY, {}).get(C.ENABLED, C.ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Guard against the engine mutating the schedule after launch
+    (reference ``elasticity.py`` same name)."""
+    import json
+    import os
+    env = os.environ.get("DEEPSPEED_ELASTICITY_CONFIG")
+    if env:
+        frozen = json.loads(env)
+        if frozen != runtime_elastic_config_dict:
+            raise ElasticityConfigError(
+                "Elastic config changed between launcher and runtime; "
+                "this would break batch-size stability across restarts")
+    else:
+        os.environ["DEEPSPEED_ELASTICITY_CONFIG"] = json.dumps(
+            runtime_elastic_config_dict)
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version=None,
+                           world_size=0, return_microbatch=False):
+    """Reference ``elasticity.py:233``.
+
+    Returns ``(final_batch_size, valid_gpus[, micro_batch_size])``; raises
+    ``ElasticityIncompatibleWorldSize`` when ``world_size`` is not in the
+    admissible set.
+    """
+    if not elasticity_enabled(ds_config):
+        raise ElasticityError("elasticity is not enabled in the config")
+    cfg = ds_config[C.ELASTICITY]
+    version = float(cfg.get(C.VERSION, C.VERSION_DEFAULT))
+    micro_batches = cfg.get(C.MICRO_BATCHES, C.MICRO_BATCHES_DEFAULT)
+    max_batch = cfg.get(C.MAX_ACCEPTABLE_BATCH_SIZE,
+                        C.MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+    min_gpus = cfg.get(C.MIN_GPUS, C.MIN_GPUS_DEFAULT)
+    max_gpus = cfg.get(C.MAX_GPUS, C.MAX_GPUS_DEFAULT)
+    prefer_larger = cfg.get(C.PREFER_LARGER_BATCH,
+                            C.PREFER_LARGER_BATCH_DEFAULT)
+    num_gpus_per_node = cfg.get(C.NUM_GPUS_PER_NODE,
+                                C.NUM_GPUS_PER_NODE_DEFAULT)
+    mp_size = cfg.get(C.MODEL_PARALLEL_SIZE, C.MODEL_PARALLEL_SIZE_DEFAULT)
+
+    final_batch_size, valid_gpus = get_compatible_gpus(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger,
+        num_gpus_per_node, mp_size, version)
+
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in admissible chip counts "
+            f"{valid_gpus}")
+
+    logger.info("elasticity: batch=%s admissible chip counts=%s",
+                final_batch_size, valid_gpus)
+    if return_microbatch:
+        ws = world_size if world_size > 0 else valid_gpus[0]
+        mbs = _micro_batch_for(final_batch_size, ws // max(mp_size, 1),
+                               micro_batches, prefer_larger)
+        return final_batch_size, valid_gpus, mbs
+    return final_batch_size, valid_gpus

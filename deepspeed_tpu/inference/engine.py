@@ -33,6 +33,31 @@ from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
 
 
+def _convert_injection_policy(policy):
+    """Normalize the two injection_policy spellings to a rule table:
+
+    * string-keyed: ``{"o_proj/kernel": PartitionSpec(...)}`` — native form,
+      passed through;
+    * reference form (``init_inference(..., injection_policy={Block:
+      ('o_proj', )})``, reference ``replace_module.py``): class-keyed with a
+      tuple of row-parallel (all-reduce-point) layer names — converted to
+      row-parallel rules, with everything else left to AutoTP heuristics.
+    """
+    if not policy:
+        return None
+    rules = {}
+    for key, val in policy.items():
+        if isinstance(key, str):
+            rules[key] = val
+            continue
+        # class-keyed reference form: val names the output/row layers
+        names = val if isinstance(val, (tuple, list)) else (val, )
+        for name in names:
+            name = str(name).split(".")[-1]
+            rules[f"{name}/kernel"] = P("tp", None)
+    return rules or None
+
+
 def _model_tp_rules(module):
     """Look up the ``tp_rules(config)`` helper next to the model class
     (our model families each export one — e.g. ``models/llama.py:tp_rules``)."""
@@ -87,10 +112,31 @@ class InferenceEngine:
         self._tp_enabled = mesh_tp > 1
 
         # precision: cast float leaves to the serving dtype (reference
-        # engine.py:46 converts the module to config.dtype)
-        dtype = jnp.dtype("bfloat16" if config.dtype in
-                          ("bf16", "bfloat16") else config.dtype)
+        # engine.py:46 converts the module to config.dtype).  Accept every
+        # spelling existing DeepSpeed configs use.
+        _DTYPE_ALIASES = {
+            "bf16": "bfloat16", "bfloat16": "bfloat16",
+            "torch.bfloat16": "bfloat16",
+            "fp16": "float16", "half": "float16", "float16": "float16",
+            "torch.float16": "float16", "torch.half": "float16",
+            "fp32": "float32", "float": "float32", "float32": "float32",
+            "torch.float32": "float32", "torch.float": "float32",
+            "int8": "int8", "torch.int8": "int8",
+        }
+        name = str(config.dtype).lower()
+        dtype = jnp.dtype(_DTYPE_ALIASES.get(name, name))
+        if dtype == jnp.int8:
+            logger.warning("dtype=int8 serving is the weight-quantization "
+                           "path (inference/quantization); serving bf16")
+            dtype = jnp.dtype("bfloat16")
         self.dtype = dtype
+        if config.quant.enabled:
+            logger.warning("quantized serving (config.quant) is not yet "
+                           "applied by the v1 engine — serving unquantized")
+        if config.replace_with_kernel_inject or config.use_triton:
+            log_dist("kernel injection/use_triton: XLA fusion + the "
+                     "Pallas-backed attention core already cover this path",
+                     ranks=[0])
 
         def cast(x):
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
@@ -101,7 +147,8 @@ class InferenceEngine:
         # TP sharding (AutoTP analog); injection_policy overrides
         rules = None
         if self._tp_enabled:
-            rules = (config.injection_policy or _model_tp_rules(model)
+            policy = _convert_injection_policy(config.injection_policy)
+            rules = (policy or _model_tp_rules(model)
                      or AutoTP.derive_rules(params))
             log_dist(f"AutoTP: {len(rules)} sharding rules", ranks=[0])
         with self.mesh:
@@ -133,6 +180,14 @@ class InferenceEngine:
     def forward(self, input_ids, **kwargs):
         """Full (non-cached) forward → logits.  Reference engine forward
         w/ graph replay (``inference/engine.py:538``) ≙ the jit cache."""
+        if "attention_mask" in kwargs:
+            mask = kwargs.pop("attention_mask")
+            if mask is not None and not bool(jnp.all(jnp.asarray(mask) == 1)):
+                raise NotImplementedError(
+                    "forward() does not apply padding masks; strip padding "
+                    "or use the ragged (inference v2) engine")
+        for k in kwargs:
+            logger.warning("forward(): ignoring unsupported argument %r", k)
         with self.mesh:
             return self._jit_forward(self.params, jnp.asarray(input_ids))
 
@@ -222,7 +277,12 @@ class InferenceEngine:
         if input_ids.ndim == 1:
             input_ids = input_ids[None, :]
         B, S0 = input_ids.shape
-        steps = max_new_tokens or max(self._config.max_out_tokens - S0, 1)
+        if max_new_tokens is None:
+            steps = max(self._config.max_out_tokens - S0, 1)
+        else:
+            steps = int(max_new_tokens)
+            if steps <= 0:
+                return input_ids
         max_pos = getattr(getattr(self.module, "config", None),
                           "max_position_embeddings", None)
         if max_pos is not None:

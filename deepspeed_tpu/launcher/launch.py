@@ -1,0 +1,151 @@
+"""Per-node process spawner — reference ``launcher/launch.py:133 main``.
+
+Reference behavior: decode base64 world-info, set CUDA_VISIBLE_DEVICES-like
+env via the accelerator (:166), export RANK/LOCAL_RANK/MASTER_*, fork one
+subprocess per local device, fan out signals, write pid files.
+
+TPU-native: JAX wants **one process per host** that owns every local chip
+(SPMD), so the default is a single child per node with
+``JAX_PROCESS_COUNT = num_nodes`` and ``COORDINATOR_ADDRESS`` rendezvous.
+``--one_proc_per_device`` restores the reference's process-per-device layout
+(sets ``TPU_VISIBLE_DEVICES``/``TPU_PROCESS_BOUNDS`` per child) for tools
+that need it.  Both MASTER_* and COORDINATOR_ADDRESS spellings are exported.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+from .runner import decode_world_info
+
+PID_FILE_BASEPATH = "/tmp"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get(
+                            "NODE_RANK",
+                            os.environ.get(
+                                "OMPI_COMM_WORLD_RANK",
+                                os.environ.get("SLURM_PROCID", 0)))))
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--one_proc_per_device", action="store_true")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("--enable_elastic_training", action="store_true")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--save_pid", action="store_true")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_child_env(args, world_info, node_rank, local_rank, procs_per_node):
+    """Environment for one child process."""
+    hosts = list(world_info.keys())
+    num_nodes = len(hosts)
+    env = os.environ.copy()
+    coordinator = f"{args.master_addr}:{args.master_port}"
+
+    if procs_per_node == 1:
+        # JAX SPMD: process == host
+        world_size = num_nodes
+        rank = node_rank
+        env["JAX_PROCESS_COUNT"] = str(world_size)
+        env["JAX_PROCESS_ID"] = str(rank)
+    else:
+        world_size = sum(len(s) for s in world_info.values())
+        rank = sum(
+            len(world_info[h]) for h in hosts[:node_rank]) + local_rank
+        env["JAX_PROCESS_COUNT"] = str(world_size)
+        env["JAX_PROCESS_ID"] = str(rank)
+        slots = world_info[hosts[node_rank]]
+        env["TPU_VISIBLE_DEVICES"] = str(slots[local_rank])
+        env["CUDA_VISIBLE_DEVICES"] = str(slots[local_rank])
+
+    if world_size > 1:
+        env["COORDINATOR_ADDRESS"] = coordinator
+    # torch-style spellings for user scripts that read them
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["WORLD_SIZE"] = str(world_size)
+    env["RANK"] = str(rank)
+    env["LOCAL_RANK"] = str(local_rank)
+    env["CROSS_RANK"] = str(node_rank)
+    env["CROSS_SIZE"] = str(num_nodes)
+    env["LOCAL_SIZE"] = str(procs_per_node)
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    node_rank = args.node_rank
+    assert 0 <= node_rank < len(hosts), \
+        f"node_rank {node_rank} out of range for {len(hosts)} hosts"
+    procs_per_node = (len(world_info[hosts[node_rank]])
+                      if args.one_proc_per_device else 1)
+
+    processes = []
+    for local_rank in range(procs_per_node):
+        env = build_child_env(args, world_info, node_rank, local_rank,
+                              procs_per_node)
+        cmd = []
+        if not args.no_python:
+            cmd = [sys.executable, "-u"]
+            if args.module:
+                cmd.append("-m")
+        cmd.append(args.training_script)
+        cmd.extend(args.training_script_args)
+        logger.info("launching rank %s: %s", env["RANK"], " ".join(cmd))
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    if args.save_pid:
+        pid_path = os.path.join(PID_FILE_BASEPATH,
+                                f"ds_launch_{os.getpid()}.pids")
+        with open(pid_path, "w") as f:
+            f.write(",".join(str(p.pid) for p in processes))
+
+    def sigkill_handler(signum, frame):
+        # reference launch.py:317 — fan the signal out and die
+        for p in processes:
+            if p.poll() is None:
+                p.send_signal(signum)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    # monitor: if any child fails, kill the rest (reference sigkill_handler)
+    alive = list(processes)
+    rc = 0
+    while alive:
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0:
+                rc = ret
+                logger.error("child %s exited with %s — terminating node",
+                             p.pid, ret)
+                for q in alive:
+                    if q.poll() is None:
+                        q.terminate()
+                alive = []
+                break
+        time.sleep(0.5)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Multinode launch backends — reference ``launcher/multinode_runner.py``
+(``PDSHRunner`` :51, ``OpenMPIRunner`` :118, ``SlurmRunner`` :336).
+
+Each runner turns (args, world_info, env) into the shell command that starts
+``launcher.launch`` on every node.  The SSHRunner is the zero-dependency
+fallback (plain ssh fan-out, reference uses pdsh for this role).
+"""
+
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+from shlex import quote
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info_base64):
+        self.args = args
+        self.world_info_base64 = world_info_base64
+        self.user_arguments = list(args.user_args)
+        self.user_script = args.user_script
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self):
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        ...
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = str(var).strip()
+
+    @property
+    def name(self):
+        return self.__class__.__name__.replace("Runner", "").lower()
+
+    def _launch_cmd(self, node_rank_expr):
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={self.world_info_base64}",
+               f"--node_rank={node_rank_expr}",
+               f"--master_addr={self.args.master_addr}",
+               f"--master_port={self.args.master_port}"]
+        if self.args.no_python:
+            cmd.append("--no_python")
+        if self.args.module:
+            cmd.append("--module")
+        if getattr(self.args, "elastic_training", False):
+            cmd.append("--enable_elastic_training")
+        cmd.append(self.user_script)
+        cmd.extend(self.user_arguments)
+        return cmd
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Reference ``multinode_runner.py:51``."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment = dict(environment)
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+        exports = "".join(f"export {quote(k)}={quote(v)}; "
+                          for k, v in {**environment, **self.exports}.items())
+        # %n expands to the pdsh node-index on each host
+        launch = " ".join(
+            map(quote, self._launch_cmd("%n")))
+        return ["pdsh", "-S", "-f", "1024", "-w", active_workers] + \
+            (self.args.launcher_args.split() if self.args.launcher_args
+             else []) + [exports + launch]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain-ssh fan-out (sequential spawn, parallel run)."""
+
+    def backend_exists(self):
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # emitted as a shell script: one ssh per node, backgrounded, wait
+        lines = ["set -e"]
+        exports = "".join(f"export {quote(k)}={quote(v)}; "
+                          for k, v in {**environment, **self.exports}.items())
+        for rank, host in enumerate(active_resources):
+            launch = " ".join(map(quote, self._launch_cmd(str(rank))))
+            lines.append(f"ssh -o StrictHostKeyChecking=no {quote(host)} "
+                         f"{quote(exports + launch)} &")
+        lines.append("wait")
+        return ["bash", "-c", "\n".join(lines)]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """Reference ``multinode_runner.py:118`` — mpirun with one slot per node
+    (the node-local spawner handles devices)."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_nodes = len(active_resources)
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        cmd = ["mpirun", "-n", str(total_nodes), "--host", hosts,
+               "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include",
+               "eth0"]
+        for k, v in {**environment, **self.exports}.items():
+            cmd += ["-x", f"{k}={v}"]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        # under MPI each rank IS the node process: OMPI_COMM_WORLD_RANK
+        # provides node_rank via env in launch.py
+        cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                f"--world_info={self.world_info_base64}",
+                f"--master_addr={self.args.master_addr}",
+                f"--master_port={self.args.master_port}",
+                self.user_script] + self.user_arguments
+        return cmd
+
+
+class SlurmRunner(MultiNodeRunner):
+    """Reference ``multinode_runner.py:336`` — srun."""
+
+    def backend_exists(self):
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_nodes = len(active_resources)
+        cmd = ["srun", "-N", str(total_nodes), "--ntasks-per-node=1"]
+        if getattr(self.args, "include", ""):
+            cmd += ["--include", self.args.include]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        exports = ",".join(f"{k}={v}" for k, v in
+                           {**environment, **self.exports}.items())
+        if exports:
+            cmd += [f"--export=ALL,{exports}"]
+        cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                f"--world_info={self.world_info_base64}",
+                f"--master_addr={self.args.master_addr}",
+                f"--master_port={self.args.master_port}",
+                self.user_script] + self.user_arguments
+        return cmd

@@ -63,17 +63,24 @@ def decode_attention(q, k_full, v_full, start_index, softmax_scale=None):
     ``j <= start_index + s``.  Degenerates to plain causal attention for the
     prefill/init pass (start_index == 0, S == L).
 
-    q: [B, S, H, Dh]; k_full/v_full: [B, L, H, Dh].
+    GQA-native: ``k_full``/``v_full`` keep their Hkv heads — queries are
+    grouped as [B, S, Hkv, rep, Dh] and contracted against the unexpanded
+    cache, so no step materializes an H/Hkv-times larger KV tensor.
+
+    q: [B, S, H, Dh]; k_full/v_full: [B, L, Hkv, Dh] with H % Hkv == 0.
     """
     B, S, H, Dh = q.shape
-    L = k_full.shape[1]
+    L, Hkv = k_full.shape[1], k_full.shape[2]
+    rep = H // Hkv
     scale = softmax_scale if softmax_scale is not None else Dh**-0.5
-    scores = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+    qg = q.reshape(B, S, Hkv, rep, Dh).astype(jnp.float32)
+    scores = jnp.einsum("bsgrd,blgd->bgrsl", qg,
                         k_full.astype(jnp.float32)) * scale
     key_pos = jnp.arange(L)[None, :]
     query_pos = start_index + jnp.arange(S)[:, None]
     mask = key_pos <= query_pos                      # [S, L]
-    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    scores = jnp.where(mask[None, None, None], scores,
+                       jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhsl,blhd->bshd", probs, v_full.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bgrsl,blgd->bsgrd", probs, v_full.astype(jnp.float32))
+    return out.reshape(B, S, H, Dh).astype(q.dtype)

@@ -142,10 +142,7 @@ class LlamaAttention(nn.Module):
             q = apply_rotary(
                 q, cos, sin,
                 positions=start + jnp.arange(S)[None, :])
-            if Hkv != H:
-                rep = H // Hkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA handled inside decode_attention (no cache-wide repeat)
             out = decode_attention(q, k, v, start)
         else:
             q = apply_rotary(q, cos, sin)

@@ -1,0 +1,88 @@
+"""Elasticity tests — reference tests/unit/elasticity/test_elastic.py."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import compute_elastic_config
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfigError, ElasticityError,
+    ElasticityIncompatibleWorldSize, get_valid_gpus)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    batch, valid_gpus = compute_elastic_config(BASE)
+    assert batch <= 10000
+    # every admissible count divides the batch through some micro batch
+    for g in valid_gpus:
+        assert 32 <= g <= 1500
+        assert any(batch % (m * g) == 0
+                   for m in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_world_size_validation():
+    batch, valid_gpus = compute_elastic_config(BASE)
+    ok = valid_gpus[0]
+    b2, v2 = compute_elastic_config(BASE, world_size=ok)
+    assert (b2, v2) == (batch, valid_gpus)
+    bad = max(valid_gpus) + 1
+    while bad in valid_gpus:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE, world_size=bad)
+
+
+def test_disabled_raises():
+    cfg = {"elasticity": {"enabled": False}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg)
+
+
+def test_invalid_micro_batches():
+    cfg = {"elasticity": {**BASE["elasticity"], "micro_batch_sizes": [0, 4]}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_get_valid_gpus():
+    valid = get_valid_gpus(24, [4, 6], 1, 100)
+    # 24/4=6 micros → g ∈ {1,2,3,6}; 24/6=4 → g ∈ {1,2,4}
+    assert valid == [1, 2, 3, 4, 6]
+
+
+def test_return_microbatch():
+    batch, gpus, mbs = compute_elastic_config(BASE, return_microbatch=True)
+    assert mbs in BASE["elasticity"]["micro_batch_sizes"]
+    assert batch % (mbs * gpus[0]) == 0
+
+
+def test_v02_model_parallel():
+    cfg = {
+        "elasticity": {
+            **BASE["elasticity"], "version": 0.2, "model_parallel_size": 4,
+            "num_gpus_per_node": 8, "min_gpus": 1,
+        }
+    }
+    batch, valid_gpus = compute_elastic_config(cfg)
+    for g in valid_gpus:
+        assert g % 8 == 0  # lcm(chips_per_node=8, mp=4)
+
+
+def test_prefer_larger_batch():
+    small = dict(BASE["elasticity"], prefer_larger_batch=False,
+                 min_gpus=1, max_gpus=32)
+    large = dict(BASE["elasticity"], prefer_larger_batch=True,
+                 min_gpus=1, max_gpus=32)
+    b_small, _ = compute_elastic_config({"elasticity": small})
+    b_large, _ = compute_elastic_config({"elasticity": large})
+    assert b_small <= b_large
