@@ -31,6 +31,11 @@ class DSElasticAgent:
         self.restart_count = 0
 
     def _validate_world(self, world_size):
+        if self.ds_config is None:
+            # restart supervision without batch-schedule validation
+            # (launch.py has no parsed DS config; checkpoint+resume
+            # provides the state recovery either way)
+            return True
         try:
             compute_elastic_config(self.ds_config, world_size=world_size)
             return True

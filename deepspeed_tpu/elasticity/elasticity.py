@@ -89,20 +89,23 @@ def get_compatible_gpus(micro_batches, max_acceptable_batch_size, min_gpus=1,
         raise ElasticityConfigError("micro batches must be positive")
 
     if version == 0.2 and (model_parallel_size > 1 or num_gpus_per_node > 1):
-        # data-parallel replicas come in groups of (chips_per_node / mp) —
-        # constrain admissible chip counts to whole-node multiples of mp
+        # batch math runs in DATA-PARALLEL-replica space; min/max_gpus are
+        # CHIP bounds, so map them down by mp before solving and filter the
+        # final chip counts (= dp × mp) to whole-node multiples
         group = int(np.lcm(num_gpus_per_node, model_parallel_size))
+        mp = model_parallel_size
+        min_dp = max(1, -(-min_gpus // mp))   # ceil
+        max_dp = max(1, max_gpus // mp)
         candidates = _candidate_batch_sizes(micro_batches,
                                             max_acceptable_batch_size)
-        batch, gpus = get_best_candidates(candidates, micro_batches,
-                                          min_gpus, max_gpus, prefer_larger)
-        if gpus is None:
+        batch, dp_counts = get_best_candidates(candidates, micro_batches,
+                                               min_dp, max_dp, prefer_larger)
+        if dp_counts is None:
             raise ElasticityConfigError(
                 f"No valid chip counts for max batch "
                 f"{max_acceptable_batch_size} with micros {micro_batches}")
-        gpus = [g * model_parallel_size for g in gpus
-                if (g * model_parallel_size) % group == 0
-                and g * model_parallel_size <= max_gpus]
+        gpus = [dp * mp for dp in dp_counts
+                if (dp * mp) % group == 0 and min_gpus <= dp * mp <= max_gpus]
         if not gpus:
             raise ElasticityConfigError(
                 "model-parallel/node constraints eliminated every chip count")

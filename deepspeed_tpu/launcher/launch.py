@@ -95,10 +95,7 @@ def main(args=None):
     procs_per_node = (len(world_info[hosts[node_rank]])
                       if args.one_proc_per_device else 1)
 
-    processes = []
-    for local_rank in range(procs_per_node):
-        env = build_child_env(args, world_info, node_rank, local_rank,
-                              procs_per_node)
+    def child_cmd():
         cmd = []
         if not args.no_python:
             cmd = [sys.executable, "-u"]
@@ -106,6 +103,24 @@ def main(args=None):
                 cmd.append("-m")
         cmd.append(args.training_script)
         cmd.extend(args.training_script_args)
+        return cmd
+
+    if args.enable_elastic_training:
+        # restart supervision (reference DSElasticAgent via torchelastic,
+        # elasticity/elastic_agent.py:32): relaunch failed workers; state
+        # recovery = checkpoint+resume in the training script
+        from ..elasticity.elastic_agent import DSElasticAgent
+        env = build_child_env(args, world_info, node_rank, 0, 1)
+        agent = DSElasticAgent(child_cmd(), env, ds_config=None,
+                               min_nodes=args.min_elastic_nodes,
+                               max_nodes=args.max_elastic_nodes)
+        sys.exit(agent.run(world_size=len(hosts)))
+
+    processes = []
+    for local_rank in range(procs_per_node):
+        env = build_child_env(args, world_info, node_rank, local_rank,
+                              procs_per_node)
+        cmd = child_cmd()
         logger.info("launching rank %s: %s", env["RANK"], " ".join(cmd))
         processes.append(subprocess.Popen(cmd, env=env))
 

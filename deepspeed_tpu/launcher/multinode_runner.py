@@ -80,15 +80,18 @@ class SSHRunner(MultiNodeRunner):
         return shutil.which("ssh") is not None
 
     def get_cmd(self, environment, active_resources):
-        # emitted as a shell script: one ssh per node, backgrounded, wait
-        lines = ["set -e"]
+        # emitted as a shell script: one ssh per node, backgrounded; collect
+        # each pid and propagate the worst exit code (bare `wait` is always 0)
+        lines = ["pids=()", "rc=0"]
         exports = "".join(f"export {quote(k)}={quote(v)}; "
                           for k, v in {**environment, **self.exports}.items())
         for rank, host in enumerate(active_resources):
             launch = " ".join(map(quote, self._launch_cmd(str(rank))))
             lines.append(f"ssh -o StrictHostKeyChecking=no {quote(host)} "
                          f"{quote(exports + launch)} &")
-        lines.append("wait")
+            lines.append("pids+=($!)")
+        lines.append('for p in "${pids[@]}"; do wait "$p" || rc=$?; done')
+        lines.append("exit $rc")
         return ["bash", "-c", "\n".join(lines)]
 
 
@@ -110,12 +113,10 @@ class OpenMPIRunner(MultiNodeRunner):
         if self.args.launcher_args:
             cmd += self.args.launcher_args.split()
         # under MPI each rank IS the node process: OMPI_COMM_WORLD_RANK
-        # provides node_rank via env in launch.py
-        cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
-                f"--world_info={self.world_info_base64}",
-                f"--master_addr={self.args.master_addr}",
-                f"--master_port={self.args.master_port}",
-                self.user_script] + self.user_arguments
+        # provides node_rank via env in launch.py (no --node_rank flag)
+        launch = self._launch_cmd("0")
+        launch.remove("--node_rank=0")
+        cmd += launch
         return cmd
 
 
@@ -127,18 +128,16 @@ class SlurmRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total_nodes = len(active_resources)
-        cmd = ["srun", "-N", str(total_nodes), "--ntasks-per-node=1"]
-        if getattr(self.args, "include", ""):
-            cmd += ["--include", self.args.include]
+        cmd = ["srun", "-N", str(total_nodes), "--ntasks-per-node=1",
+               "--nodelist", ",".join(active_resources.keys())]
         if self.args.launcher_args:
             cmd += self.args.launcher_args.split()
         exports = ",".join(f"{k}={v}" for k, v in
                            {**environment, **self.exports}.items())
         if exports:
             cmd += [f"--export=ALL,{exports}"]
-        cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
-                f"--world_info={self.world_info_base64}",
-                f"--master_addr={self.args.master_addr}",
-                f"--master_port={self.args.master_port}",
-                self.user_script] + self.user_arguments
+        # SLURM_PROCID supplies node_rank via env in launch.py
+        launch = self._launch_cmd("0")
+        launch.remove("--node_rank=0")
+        cmd += launch
         return cmd

@@ -1,0 +1,1 @@
+from .perf_sweep import run_sweep, sweep_main

@@ -1,0 +1,71 @@
+"""``ds_io`` / ``ds_nvme_tune`` — aio parameter sweep.
+
+Reference ``deepspeed/nvme/perf_run_sweep.py``: benchmark read/write GB/s
+across (block_size, queue_depth, thread_count) and report the best config
+for the swap subsystem.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def _bench_config(path, size_mb, block_size, queue_depth, threads):
+    from ..ops.aio import AIOHandle
+    h = AIOHandle(block_size=block_size, queue_depth=queue_depth,
+                  thread_count=threads)
+    data = np.random.default_rng(0).integers(
+        0, 255, size_mb << 20, dtype=np.uint8)
+    t0 = time.perf_counter()
+    h.write(data, path)
+    t_write = time.perf_counter() - t0
+    buf = np.empty_like(data)
+    t0 = time.perf_counter()
+    h.read(buf, path)
+    t_read = time.perf_counter() - t0
+    assert (buf[:1024] == data[:1024]).all()
+    gb = size_mb / 1024
+    return {"block_size": block_size, "queue_depth": queue_depth,
+            "threads": threads, "write_gbps": gb / t_write,
+            "read_gbps": gb / t_read}
+
+
+def run_sweep(nvme_dir=None, size_mb=64,
+              block_sizes=(256 << 10, 1 << 20, 8 << 20),
+              queue_depths=(8, 32), thread_counts=(2, 4, 8)):
+    nvme_dir = nvme_dir or tempfile.gettempdir()
+    path = os.path.join(nvme_dir, "ds_io_sweep.bin")
+    results = []
+    try:
+        for bs, qd, tc in itertools.product(block_sizes, queue_depths,
+                                            thread_counts):
+            r = _bench_config(path, size_mb, bs, qd, tc)
+            results.append(r)
+            logger.info("aio sweep: %s", r)
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    best = max(results, key=lambda r: r["read_gbps"] + r["write_gbps"])
+    return {"results": results, "best": best}
+
+
+def sweep_main():
+    parser = argparse.ArgumentParser(description="aio/NVMe perf sweep")
+    parser.add_argument("--nvme_dir", default=None)
+    parser.add_argument("--size_mb", type=int, default=64)
+    args = parser.parse_args()
+    out = run_sweep(args.nvme_dir, args.size_mb)
+    print(json.dumps(out["best"], indent=2))
+
+
+if __name__ == "__main__":
+    sweep_main()

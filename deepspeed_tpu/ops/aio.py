@@ -1,0 +1,101 @@
+"""Async I/O op — Python binding for the native ds_aio library.
+
+Reference: ``csrc/aio/py_lib/deepspeed_py_io_handle.cpp`` (``aio_handle``
+with pread/pwrite/async variants) + ``op_builder/async_io.py``.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .op_builder import NativeOpBuilder, register_op_builder
+
+
+@register_op_builder
+class AsyncIOBuilder(NativeOpBuilder):
+    NAME = "async_io"
+    SOURCES = ("csrc/aio/ds_aio.cpp", )
+    EXTRA_CFLAGS = ("-pthread", )
+    EXTRA_LDFLAGS = ("-pthread", )
+
+    def _load_impl(self):
+        lib = super()._load_impl()
+        lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        lib.ds_aio_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+        for fn in (lib.ds_aio_submit_read, lib.ds_aio_submit_write):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+        lib.ds_aio_wait.restype = ctypes.c_int
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_pending.restype = ctypes.c_int64
+        lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
+        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+        return lib
+
+
+class AIOHandle:
+    """The reference's ``aio_handle`` (queue_depth × block_size parallel
+    submission, single/submit/wait API) over the native thread pool."""
+
+    def __init__(self, block_size=1 << 20, queue_depth=32, thread_count=4,
+                 single_submit=False, overlap_events=True):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.ds_aio_handle_new(block_size, queue_depth,
+                                              thread_count, 0)
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_handle_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @staticmethod
+    def _buf(arr):
+        if not (arr.flags["C_CONTIGUOUS"]):
+            raise ValueError("aio buffers must be C-contiguous")
+        return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+    # --- synchronous
+    def read(self, arr: np.ndarray, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        rc = self._lib.ds_aio_pread(self._h, str(path).encode(), ptr, nbytes,
+                                    offset)
+        if rc != 0:
+            raise IOError(f"aio read failed: {path}")
+
+    def write(self, arr: np.ndarray, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        rc = self._lib.ds_aio_pwrite(self._h, str(path).encode(), ptr, nbytes,
+                                     offset)
+        if rc != 0:
+            raise IOError(f"aio write failed: {path}")
+
+    # --- asynchronous
+    def async_read(self, arr: np.ndarray, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        return self._lib.ds_aio_submit_read(self._h, str(path).encode(), ptr,
+                                            nbytes, offset)
+
+    def async_write(self, arr: np.ndarray, path, offset=0):
+        ptr, nbytes = self._buf(arr)
+        return self._lib.ds_aio_submit_write(self._h, str(path).encode(),
+                                             ptr, nbytes, offset)
+
+    def wait(self, request_id):
+        rc = self._lib.ds_aio_wait(self._h, request_id)
+        if rc != 0:
+            raise IOError(f"aio request {request_id} failed (rc={rc})")
+
+    def pending(self):
+        return self._lib.ds_aio_pending(self._h)

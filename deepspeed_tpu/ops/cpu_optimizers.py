@@ -1,0 +1,140 @@
+"""Host (CPU) optimizers for ZeRO-Offload — bindings for the native SIMD
+kernels (``csrc/optimizers/cpu_optimizers.cpp``).
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``) backed
+by ``csrc/adam/cpu_adam_impl.cpp``; same for adagrad/lion.  These operate
+in-place on numpy fp32 master state living in host RAM, optionally emitting
+a bf16 shadow for the device copy-back.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .op_builder import NativeOpBuilder, register_op_builder
+
+
+@register_op_builder
+class CPUAdamBuilder(NativeOpBuilder):
+    NAME = "cpu_adam"
+    SOURCES = ("csrc/optimizers/cpu_optimizers.cpp", )
+    EXTRA_CFLAGS = ("-fopenmp", "-march=native", "-funroll-loops")
+    EXTRA_LDFLAGS = ("-fopenmp", )
+
+    def _load_impl(self):
+        lib = super()._load_impl()
+        lib.ds_cpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p
+        ]
+        lib.ds_cpu_adagrad_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_void_p
+        ]
+        lib.ds_cpu_lion_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_void_p
+        ]
+        lib.ds_cpu_sq_norm.restype = ctypes.c_double
+        lib.ds_cpu_sq_norm.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        return lib
+
+
+# alias builders so the reference names resolve in ds_report
+@register_op_builder
+class CPUAdagradBuilder(CPUAdamBuilder):
+    NAME = "cpu_adagrad"
+
+
+@register_op_builder
+class CPULionBuilder(CPUAdamBuilder):
+    NAME = "cpu_lion"
+
+
+def _ptr(arr):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _check(name, arr, n, dtype=np.float32):
+    if arr.dtype != dtype or not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError(f"{name} must be C-contiguous {dtype}")
+    if arr.size != n:
+        raise ValueError(f"{name} size {arr.size} != {n}")
+
+
+class DeepSpeedCPUAdam:
+    """In-place host Adam/AdamW (reference ``ops/adam/cpu_adam.py:18``)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True):
+        self._lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+    def step(self, param, grad, exp_avg, exp_avg_sq, bf16_out=None, lr=None):
+        n = param.size
+        _check("param", param, n)
+        _check("grad", grad, n)
+        _check("exp_avg", exp_avg, n)
+        _check("exp_avg_sq", exp_avg_sq, n)
+        if bf16_out is not None:
+            _check("bf16_out", bf16_out, n, np.uint16)
+        self.step_count += 1
+        self._lib.ds_cpu_adam_step(
+            _ptr(param), _ptr(grad), _ptr(exp_avg), _ptr(exp_avg_sq), n,
+            float(lr if lr is not None else self.lr), float(self.betas[0]),
+            float(self.betas[1]), float(self.eps), float(self.weight_decay),
+            self.step_count, int(self.adamw_mode),
+            _ptr(bf16_out) if bf16_out is not None else None)
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self._lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def step(self, param, grad, state_sum, bf16_out=None, lr=None):
+        n = param.size
+        _check("param", param, n)
+        _check("grad", grad, n)
+        _check("state_sum", state_sum, n)
+        self._lib.ds_cpu_adagrad_step(
+            _ptr(param), _ptr(grad), _ptr(state_sum), n,
+            float(lr if lr is not None else self.lr), float(self.eps),
+            float(self.weight_decay),
+            _ptr(bf16_out) if bf16_out is not None else None)
+
+
+class DeepSpeedCPULion:
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self._lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.betas = betas
+        self.weight_decay = weight_decay
+
+    def step(self, param, grad, exp_avg, bf16_out=None, lr=None):
+        n = param.size
+        _check("param", param, n)
+        _check("grad", grad, n)
+        _check("exp_avg", exp_avg, n)
+        self._lib.ds_cpu_lion_step(
+            _ptr(param), _ptr(grad), _ptr(exp_avg), n,
+            float(lr if lr is not None else self.lr), float(self.betas[0]),
+            float(self.betas[1]), float(self.weight_decay),
+            _ptr(bf16_out) if bf16_out is not None else None)
+
+
+def cpu_sq_norm(grad):
+    lib = CPUAdamBuilder().load()
+    _check("grad", grad, grad.size)
+    return float(lib.ds_cpu_sq_norm(_ptr(grad), grad.size))

@@ -130,7 +130,9 @@ def _ensure_registered():
     if not _registered:
         _registered = True
         for mod in ("deepspeed_tpu.ops.adam", "deepspeed_tpu.ops.lamb",
-                    "deepspeed_tpu.ops.lion", "deepspeed_tpu.ops.quantizer"):
+                    "deepspeed_tpu.ops.lion", "deepspeed_tpu.ops.quantizer",
+                    "deepspeed_tpu.ops.aio",
+                    "deepspeed_tpu.ops.cpu_optimizers"):
             try:
                 importlib.import_module(mod)
             except ImportError:

@@ -132,10 +132,11 @@ def test_end_to_end_local_launch(tmp_path):
     script.write_text("import os\n"
                       "print('RANK', os.environ.get('RANK'))\n"
                       "print('WS', os.environ.get('WORLD_SIZE'))\n")
+    repo_root = str(__import__("pathlib").Path(__file__).parents[3])
     out = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "--num_gpus", "1", str(script)],
-        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        capture_output=True, text=True, timeout=120, cwd=repo_root)
     assert out.returncode == 0, out.stderr
     assert "RANK 0" in out.stdout
     assert "WS 1" in out.stdout
