@@ -1,0 +1,15 @@
+"""Universal checkpointing (reference ``deepspeed/checkpoint/``).
+
+DP/TP/PP-degree-independent resume: a converter turns an engine checkpoint
+into per-parameter fp32 "hp" slices (reference ``checkpoint/ds_to_universal.py``),
+and a loader repartitions them under a new mesh topology (reference
+``checkpoint/universal_checkpoint.py:22``).
+"""
+
+from .constants import (EXP_AVG, EXP_AVG_SQ, FP32, STEP, UNIVERSAL_META,
+                        ZERO_FILE_PREFIX)
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
+from .ds_to_universal import convert_to_universal
+from .universal_checkpoint import load_universal_checkpoint
+from .zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict,
+                           get_fp32_state_dict_from_zero_checkpoint)

@@ -1,0 +1,30 @@
+"""Universal-checkpoint key names (reference ``deepspeed/checkpoint/constants.py``).
+
+The per-parameter state files keep the reference's key vocabulary (``fp32``,
+``exp_avg``, ``exp_avg_sq``, ``step``) so tooling written against DeepSpeed's
+universal layout maps 1:1.
+"""
+
+FP32 = "fp32"
+EXP_AVG = "exp_avg"
+EXP_AVG_SQ = "exp_avg_sq"
+STEP = "step"
+
+# dir layout
+ZERO_FILE_PREFIX = "zero"
+UNIVERSAL_META = "universal_meta.json"
+DS_VERSION = "ds_version"
+
+# mapping from this framework's optimizer-state field names to the universal
+# (torch-style) names the reference writes (ds_to_universal.py:232 merges
+# "exp_avg"/"exp_avg_sq" slices).
+STATE_FIELD_TO_UNIVERSAL = {
+    "mu": EXP_AVG,
+    "nu": EXP_AVG_SQ,
+    "m": EXP_AVG,
+    "v": EXP_AVG_SQ,
+    "momentum": EXP_AVG,
+    "exp_avg": EXP_AVG,
+    "exp_avg_sq": EXP_AVG_SQ,
+}
+UNIVERSAL_TO_STATE_FIELD = {EXP_AVG: "mu", EXP_AVG_SQ: "nu"}

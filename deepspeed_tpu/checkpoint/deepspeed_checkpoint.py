@@ -1,0 +1,92 @@
+"""Checkpoint inspection / reshaping helper.
+
+Reference ``checkpoint/deepspeed_checkpoint.py`` (``DeepSpeedCheckpoint``) —
+used by the universal converter and by migration tooling to enumerate a
+checkpoint's parameters, topology, and iteration without a live engine.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .constants import UNIVERSAL_META, ZERO_FILE_PREFIX
+
+
+class DeepSpeedCheckpoint:
+    """Read-only view over either an engine checkpoint or a universal one."""
+
+    def __init__(self, ckpt_dir, tag=None):
+        self.dir = ckpt_dir
+        if tag is None:
+            latest = os.path.join(ckpt_dir, "latest")
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+        self.tag = tag
+        self.root = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+        self._universal = os.path.exists(os.path.join(self.root, UNIVERSAL_META))
+        self._meta = None
+        self._state = None
+        self._model_flat = None  # lazy cache: one orbax read serves all queries
+        if self._universal:
+            with open(os.path.join(self.root, UNIVERSAL_META)) as f:
+                self._meta = json.load(f)
+            self._state = self._meta.get("engine_state", {})
+        else:
+            es = os.path.join(self.root, "engine_state.json")
+            if os.path.exists(es):
+                with open(es) as f:
+                    self._state = json.load(f)
+
+    @property
+    def is_universal(self):
+        return self._universal
+
+    def get_iteration(self):
+        return (self._state or {}).get("global_steps", 0)
+
+    @property
+    def zero_stage(self):
+        return (self._state or {}).get("zero_stage", 0)
+
+    @property
+    def dp_degree(self):
+        return (self._state or {}).get("dp_world_size", 1)
+
+    def _model(self):
+        if self._model_flat is None:
+            from .zero_to_fp32 import _restore_flat
+            self._model_flat = _restore_flat(os.path.join(self.root, "model"))
+        return self._model_flat
+
+    def parameter_names(self):
+        if self._universal:
+            return sorted(self._meta.get("params", {}).keys())
+        return sorted(self._model().keys())
+
+    def parameter_shapes(self):
+        if self._universal:
+            return {k: tuple(v["shape"])
+                    for k, v in self._meta.get("params", {}).items()}
+        return {k: v.shape for k, v in self._model().items()}
+
+    def get_parameter(self, name, key="fp32"):
+        """Fetch one tensor. ``key`` ∈ {fp32, exp_avg, exp_avg_sq} for
+        universal checkpoints."""
+        if self._universal:
+            path = os.path.join(self.root, ZERO_FILE_PREFIX, name, f"{key}.npy")
+            if not os.path.exists(path):
+                raise KeyError(f"{name}/{key} not in checkpoint")
+            return np.load(path)
+        flat = self._model()
+        if name not in flat:
+            raise KeyError(name)
+        return np.asarray(flat[name])
+
+    def show(self):
+        names = self.parameter_names()
+        print(f"checkpoint {self.root} (universal={self._universal}) "
+              f"iteration={self.get_iteration()} params={len(names)}")
+        for n in names:
+            print(f"  {n}")

@@ -1,0 +1,125 @@
+"""Engine checkpoint → universal checkpoint converter.
+
+Reference ``checkpoint/ds_to_universal.py`` (``extract_zero_shards`` :112,
+``merge_tp_slices`` :232) walks every rank's zero shard files and merges the
+flat fp32 fragments back into full per-parameter tensors.  Here the engine
+checkpoint already stores *global* arrays (orbax/tensorstore), so conversion
+is a relayout, not a merge: read the global fp32 master (or model) tree and
+the optimizer moments, write one directory per parameter:
+
+    {out}/universal_meta.json
+    {out}/ds_version
+    {out}/zero/{param_name}/fp32.npy
+    {out}/zero/{param_name}/exp_avg.npy
+    {out}/zero/{param_name}/exp_avg_sq.npy
+
+Runs offline on host (CPU), no mesh required.
+"""
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from .constants import (DS_VERSION, STATE_FIELD_TO_UNIVERSAL, UNIVERSAL_META,
+                        ZERO_FILE_PREFIX)
+
+
+def _restore_raw(path):
+    """Orbax restore without a template → nested dicts of np arrays."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path)
+    import jax
+    return jax.tree_util.tree_map(np.asarray, restored)
+
+
+from .zero_to_fp32 import _flatten  # noqa: E402 — shared key-path flattener
+
+
+def _resolve_tag(ckpt_dir, tag):
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    return tag
+
+
+def convert_to_universal(checkpoint_dir, output_dir, tag=None):
+    """Convert an engine checkpoint at ``checkpoint_dir`` (optionally
+    ``tag``-selected) into universal layout at ``output_dir``."""
+    tag = _resolve_tag(checkpoint_dir, tag)
+    root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no checkpoint at {root}")
+
+    with open(os.path.join(root, "engine_state.json")) as f:
+        engine_state = json.load(f)
+
+    # fp32 source of truth: master if present, else the model params.
+    master_dir = os.path.join(root, "master")
+    model_dir = os.path.join(root, "model")
+    src = master_dir if os.path.isdir(master_dir) else model_dir
+    params = _flatten(_restore_raw(src))
+
+    zero_root = os.path.join(output_dir, ZERO_FILE_PREFIX)
+    os.makedirs(zero_root, exist_ok=True)
+
+    param_meta = {}
+    for name, arr in params.items():
+        pdir = os.path.join(zero_root, name)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(arr, dtype=np.float32))
+        param_meta[name] = {"shape": list(arr.shape), "dtype": "float32"}
+
+    # optimizer moments: state fields whose subtree mirrors the param tree.
+    step = None
+    optim_dir = os.path.join(root, "optim")
+    if os.path.isdir(optim_dir):
+        opt = _restore_raw(optim_dir)
+        flat_opt = _flatten(opt)
+        for key, arr in flat_opt.items():
+            parts = key.split("/")
+            field = parts[0]
+            if field == "count" or parts[-1] == "count":
+                step = int(np.asarray(arr))
+                continue
+            uni = STATE_FIELD_TO_UNIVERSAL.get(field)
+            if uni is None or len(parts) < 2:
+                continue
+            pname = "/".join(parts[1:])
+            if pname not in param_meta:
+                continue
+            np.save(os.path.join(zero_root, pname, f"{uni}.npy"),
+                    np.asarray(arr, dtype=np.float32))
+
+    meta = {
+        "engine_state": engine_state,
+        "step": step if step is not None else engine_state.get("global_steps", 0),
+        "params": param_meta,
+    }
+    with open(os.path.join(output_dir, UNIVERSAL_META), "w") as f:
+        json.dump(meta, f, indent=2)
+    from .. import __version__
+    with open(os.path.join(output_dir, DS_VERSION), "w") as f:
+        f.write(__version__)
+    return output_dir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Convert an engine checkpoint to universal format "
+        "(reference ds_to_universal.py CLI)")
+    p.add_argument("--input_folder", required=True)
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_to_universal(args.input_folder, args.output_folder, tag=args.tag)
+    print(f"universal checkpoint written to {args.output_folder}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Load a universal checkpoint into a live engine under any mesh topology.
+
+Reference ``checkpoint/universal_checkpoint.py:22 load_hp_checkpoint_state``:
+each rank loads its fragment of the merged fp32 slices.  Here the repartition
+is a ``jax.device_put`` with the engine's current shardings — GSPMD splits the
+global array across whatever mesh the engine was built with, so resume at a
+different dp/tp/pp/sp degree needs no special-case code.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .constants import STATE_FIELD_TO_UNIVERSAL, UNIVERSAL_META, ZERO_FILE_PREFIX
+
+
+def _load_param_file(zero_root, name, key):
+    path = os.path.join(zero_root, name, f"{key}.npy")
+    if not os.path.exists(path):
+        return None
+    return np.load(path)
+
+
+def load_universal_checkpoint(engine, load_dir, tag=None,
+                              load_optimizer_states=True):
+    """Populate ``engine.params`` / ``engine.master`` / ``engine.opt_state``
+    from a universal checkpoint directory."""
+    root = os.path.join(load_dir, tag) if tag else load_dir
+    meta_path = os.path.join(root, UNIVERSAL_META)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"not a universal checkpoint: {meta_path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    zero_root = os.path.join(root, ZERO_FILE_PREFIX)
+
+    from ..runtime.zero.partition import path_str
+
+    # ---- parameters (and fp32 master when the engine keeps one)
+    def build(template_tree, shardings, dtype=None):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template_tree)
+        shard_flat = jax.tree_util.tree_leaves(shardings)
+        leaves = []
+        for (kp, leaf), sh in zip(flat, shard_flat):
+            name = path_str(kp)
+            arr = _load_param_file(zero_root, name, "fp32")
+            if arr is None:
+                logger.warning(f"universal checkpoint missing param {name}; "
+                               "keeping current value")
+                leaves.append(leaf)
+                continue
+            arr = arr.astype(dtype or leaf.dtype)
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"universal checkpoint shape mismatch for {name}: "
+                    f"{arr.shape} vs {leaf.shape}")
+            leaves.append(jax.device_put(arr, sh))
+        return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(template_tree), leaves)
+
+    engine.params = build(engine.params, engine.plan.param_shardings(engine.params),
+                          dtype=engine.compute_dtype)
+    if engine.master is not None:
+        engine.master = build(engine.master,
+                              engine.plan.master_shardings(engine.master),
+                              dtype=jnp.float32)
+
+    # ---- optimizer state: walk fields whose subtree mirrors the param tree
+    if load_optimizer_states and engine.opt_state is not None:
+        target = engine.master if engine.master is not None else engine.params
+        shardings = engine._opt_state_shardings(target)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(engine.opt_state)
+        shard_flat = jax.tree_util.tree_leaves(shardings)
+        leaves = []
+        for (kp, leaf), sh in zip(flat, shard_flat):
+            parts = path_str(kp).split("/")
+            field = parts[0]
+            if field == "count" or parts[-1] == "count":
+                leaves.append(jnp.asarray(meta.get("step", 0),
+                                          dtype=leaf.dtype))
+                continue
+            uni = STATE_FIELD_TO_UNIVERSAL.get(field)
+            arr = None
+            if uni is not None and len(parts) > 1:
+                arr = _load_param_file(zero_root, "/".join(parts[1:]), uni)
+            if arr is None:
+                leaves.append(leaf)
+                continue
+            leaves.append(jax.device_put(arr.astype(leaf.dtype), sh))
+        engine.opt_state = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(engine.opt_state), leaves)
+
+    # ---- counters + loss scale + lr scheduler (same set the regular load
+    # path restores, checkpoint_engine.load_engine_checkpoint)
+    es = meta.get("engine_state", {})
+    engine.global_steps = es.get("global_steps", engine.global_steps)
+    engine.global_samples = es.get("global_samples", engine.global_samples)
+    engine.micro_steps = es.get("micro_steps", engine.micro_steps)
+    engine.skipped_steps = es.get("skipped_steps", engine.skipped_steps)
+    if engine.scale_state is not None and "loss_scale" in es:
+        engine.scale_state = engine.scale_state._replace(
+            scale=jnp.asarray(es["loss_scale"],
+                              dtype=engine.scale_state.scale.dtype))
+    if engine.lr_scheduler is not None and "lr_scheduler" in es and \
+            hasattr(engine.lr_scheduler, "load_state_dict"):
+        engine.lr_scheduler.load_state_dict(es["lr_scheduler"])
+    log_dist(f"loaded universal checkpoint from {root} "
+             f"(step {engine.global_steps})", ranks=[0])
+    return tag, es.get("client_state", {})

@@ -1,0 +1,83 @@
+"""Consolidate a checkpoint into a single fp32 state dict.
+
+Reference ``deepspeed/utils/zero_to_fp32.py`` — the offline recovery script
+DeepSpeed copies into every checkpoint directory (``engine.py:3540``) so a
+user can always extract full fp32 weights from ZeRO shards without the
+training stack.  Here shards are orbax global arrays, so "merging" is a plain
+host read; the public helpers keep the reference names.
+"""
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def _flatten(tree, prefix=""):
+    """Nested dict/list → {'a/b/c': leaf}.  Shared with ds_to_universal
+    (this file must stay standalone-copyable, so the helper lives here)."""
+    out = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = enumerate(tree)
+    else:
+        out[prefix.rstrip("/")] = tree
+        return out
+    for k, v in items:
+        out.update(_flatten(v, f"{prefix}{k}/"))
+    return out
+
+
+def _restore_flat(path):
+    import jax
+    import orbax.checkpoint as ocp
+    restored = ocp.PyTreeCheckpointer().restore(path)
+    restored = jax.tree_util.tree_map(np.asarray, restored)
+    return _flatten(restored)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Return ``{param_name: np.float32 array}`` (reference function of the
+    same name, zero_to_fp32.py)."""
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no checkpoint found at {root}")
+    master = os.path.join(root, "master")
+    model = os.path.join(root, "model")
+    src = master if os.path.isdir(master) else model
+    flat = _restore_flat(src)
+    return {k: np.asarray(v, dtype=np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    """Write the consolidated fp32 state dict to ``output_file`` (.npz)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    out = output_file if output_file.endswith(".npz") else output_file + ".npz"
+    np.savez(out, **{k.replace("/", "."): v for k, v in sd.items()})
+    total = sum(v.size for v in sd.values())
+    print(f"saved {len(sd)} tensors / {total:,} elements to {out}")
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Extract fp32 weights from a deepspeed_tpu checkpoint "
+        "(reference zero_to_fp32.py)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    args = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

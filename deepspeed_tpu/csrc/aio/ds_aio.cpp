@@ -75,16 +75,27 @@ class AioHandle {
         req->pending_chunks.store(n_chunks);
         int64_t id;
         {
-            std::lock_guard<std::mutex> lk(mu_);
+            std::unique_lock<std::mutex> lk(mu_);
             id = next_id_++;
             requests_[id] = req;
             for (int64_t c = 0; c < n_chunks; ++c) {
+                // backpressure: queue_depth bounds in-flight chunks; workers
+                // notify as they drain
+                cv_.wait(lk, [&] {
+                    return stop_ ||
+                           static_cast<int>(queue_.size()) < queue_depth_;
+                });
+                if (stop_) {  // shutting down: unqueued chunks won't run
+                    req->pending_chunks.fetch_sub(n_chunks - c);
+                    break;
+                }
                 int64_t chunk_off = c * block_size_;
                 int64_t chunk_len = std::min(block_size_, count - chunk_off);
                 if (chunk_len <= 0) chunk_len = 0;
                 queue_.push_back(Chunk{req, path,
                                        static_cast<char*>(buf) + chunk_off,
                                        chunk_len, offset + chunk_off, write});
+                cv_.notify_one();
             }
         }
         cv_.notify_all();
@@ -131,6 +142,7 @@ class AioHandle {
                 if (stop_ && queue_.empty()) return;
                 chunk = std::move(queue_.front());
                 queue_.pop_front();
+                cv_.notify_all();  // wake submitters waiting for queue space
             }
             run_chunk(chunk);
         }

@@ -21,6 +21,10 @@ namespace {
 inline uint16_t fp32_to_bf16(float f) {
     uint32_t x;
     std::memcpy(&x, &f, 4);
+    // NaN must not round into inf: quiet it before the bias addition
+    if ((x & 0x7FFFFFFFu) > 0x7F800000u) {
+        return static_cast<uint16_t>((x >> 16) | 0x0040u);
+    }
     // round-to-nearest-even
     uint32_t rounding_bias = 0x7FFF + ((x >> 16) & 1);
     return static_cast<uint16_t>((x + rounding_bias) >> 16);

@@ -110,6 +110,12 @@ def main(args=None):
         # elasticity/elastic_agent.py:32): relaunch failed workers; state
         # recovery = checkpoint+resume in the training script
         from ..elasticity.elastic_agent import DSElasticAgent
+        if procs_per_node != 1:
+            logger.warning(
+                "elastic training supervises one worker per node; "
+                "--one_proc_per_device (%d local devices) is ignored — the "
+                "worker owns all local chips via jax.local_devices()",
+                procs_per_node)
         env = build_child_env(args, world_info, node_rank, 0, 1)
         agent = DSElasticAgent(child_cmd(), env, ds_config=None,
                                min_nodes=args.min_elastic_nodes,

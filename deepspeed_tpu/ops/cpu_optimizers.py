@@ -108,6 +108,8 @@ class DeepSpeedCPUAdagrad:
         _check("param", param, n)
         _check("grad", grad, n)
         _check("state_sum", state_sum, n)
+        if bf16_out is not None:
+            _check("bf16_out", bf16_out, n, np.uint16)
         self._lib.ds_cpu_adagrad_step(
             _ptr(param), _ptr(grad), _ptr(state_sum), n,
             float(lr if lr is not None else self.lr), float(self.eps),
@@ -127,6 +129,8 @@ class DeepSpeedCPULion:
         _check("param", param, n)
         _check("grad", grad, n)
         _check("exp_avg", exp_avg, n)
+        if bf16_out is not None:
+            _check("bf16_out", bf16_out, n, np.uint16)
         self._lib.ds_cpu_lion_step(
             _ptr(param), _ptr(grad), _ptr(exp_avg), n,
             float(lr if lr is not None else self.lr), float(self.betas[0]),

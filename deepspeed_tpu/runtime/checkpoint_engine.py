@@ -75,6 +75,16 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     if save_latest:
         with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
             f.write(str(tag))
+
+    # ship the recovery script into the checkpoint (reference engine.py:3540
+    # _copy_recovery_script copies zero_to_fp32.py next to the shards)
+    try:
+        import shutil
+        from ..checkpoint import zero_to_fp32 as _z2f
+        shutil.copy2(_z2f.__file__,
+                     os.path.join(os.path.abspath(save_dir), "zero_to_fp32.py"))
+    except Exception:  # non-fatal: checkpoint itself is complete
+        pass
     log_dist(f"saved checkpoint {root}", ranks=[0])
     return True
 

@@ -629,6 +629,11 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
+        if self._config.checkpoint_config.load_universal:
+            from ..checkpoint.universal_checkpoint import load_universal_checkpoint
+            return load_universal_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states)
         from .checkpoint_engine import load_engine_checkpoint
         return load_engine_checkpoint(
             self, load_dir, tag=tag,
@@ -661,3 +666,8 @@ class DeepSpeedEngine:
 
     def empty_partition_cache(self):
         pass  # XLA owns buffers; parity no-op (reference engine.py:3747 area)
+
+    def parameter_names(self):
+        """path_str names of every parameter, for the tensor-fragment API."""
+        from ..utils.tensor_fragment import parameter_names
+        return parameter_names(self)

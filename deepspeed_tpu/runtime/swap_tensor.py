@@ -57,7 +57,11 @@ class AsyncTensorSwapper:
         self._inflight = []
 
     def _path(self, key):
-        safe = str(key).replace("/", "_").replace(os.sep, "_")
+        # injective encoding: '/' and '_' collide under plain replacement
+        # ('a/b' vs 'a_b'), which would silently alias swap files
+        safe = str(key).replace("_", "__").replace("/", "_s_")
+        if os.sep != "/":
+            safe = safe.replace(os.sep, "_s_")
         return os.path.join(self.swap_dir, f"{safe}.swp")
 
     # ---- write path

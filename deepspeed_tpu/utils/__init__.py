@@ -1,3 +1,9 @@
 from .logging import log_dist, logger, print_json_dist, warning_once
 from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
 from . import groups
+from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_grad,
+                              safe_get_full_optimizer_state,
+                              safe_get_local_fp32_param, safe_get_local_grad,
+                              safe_get_local_optimizer_state,
+                              safe_set_full_fp32_param,
+                              safe_set_full_optimizer_state)

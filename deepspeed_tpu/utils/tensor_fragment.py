@@ -1,0 +1,154 @@
+"""Tensor-fragment API — safe access to sharded / high-precision state.
+
+Reference ``deepspeed/utils/tensor_fragment.py:132-299``:
+``safe_get_full_fp32_param`` etc. let user code read/modify the fp32 master
+weights, gradients, and optimizer states regardless of ZeRO stage, because
+under ZeRO the torch ``param.data`` is a shard or empty.  Here parameters are
+jax global arrays, so "full" access is a host gather (``np.asarray`` of the
+global array triggers the all-gather) and "local" access reads the
+addressable shard; setters re-``device_put`` with the engine's sharding so
+the partitioned layout is preserved.
+
+All functions take ``(engine, name)`` where ``name`` is the ``path_str`` of
+the parameter ('layer/kernel' style); pass ``engine.parameter_names()`` to
+enumerate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flat_with_names(tree):
+    from ..runtime.zero.partition import path_str
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {path_str(kp): leaf for kp, leaf in flat}
+
+
+def _lookup(tree, name):
+    if tree is None:
+        return None
+    return _flat_with_names(tree).get(name)
+
+
+def _set_leaf(tree, name, value):
+    from ..runtime.zero.partition import path_str
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = []
+    found = False
+    for kp, leaf in flat:
+        if path_str(kp) == name:
+            found = True
+            leaves.append(value)
+        else:
+            leaves.append(leaf)
+    if not found:
+        raise KeyError(name)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(tree), leaves)
+
+
+def parameter_names(engine):
+    return sorted(_flat_with_names(engine.params).keys())
+
+
+# ------------------------------------------------------------------ getters
+def safe_get_full_fp32_param(engine, name):
+    """Full fp32 master weight (reference tensor_fragment.py:187)."""
+    src = engine.master if engine.master is not None else engine.params
+    leaf = _lookup(src, name)
+    if leaf is None:
+        return None
+    return np.asarray(leaf, dtype=np.float32)
+
+
+def safe_get_full_grad(engine, name):
+    """Full accumulated gradient, unscaled (reference :158)."""
+    leaf = _lookup(engine.grad_acc, name)
+    if leaf is None:
+        return None
+    g = np.asarray(leaf, dtype=np.float32)
+    scale = float(engine.scale_state.scale) if engine.scale_state is not None else 1.0
+    return g / scale
+
+
+def safe_get_full_optimizer_state(engine, name, state_key):
+    """Full optimizer state tensor, e.g. ``exp_avg`` (reference :214)."""
+    from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
+    field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
+    sub = getattr(engine.opt_state, field, None)
+    if sub is None and isinstance(engine.opt_state, dict):
+        sub = engine.opt_state.get(field)
+    leaf = _lookup(sub, name)
+    if leaf is None:
+        return None
+    return np.asarray(leaf, dtype=np.float32)
+
+
+# ------------------------------------------------------------------ setters
+def safe_set_full_fp32_param(engine, name, value):
+    """Overwrite the fp32 master weight (and refresh the compute-dtype copy)
+    preserving sharding (reference :241)."""
+    plan = engine.plan
+    if engine.master is not None:
+        old = _lookup(engine.master, name)
+        sh = _flat_with_names(plan.master_shardings(engine.master))[name]
+        new = jax.device_put(jnp.asarray(value, dtype=old.dtype), sh)
+        engine.master = _set_leaf(engine.master, name, new)
+    # refresh compute copy
+    oldp = _lookup(engine.params, name)
+    shp = _flat_with_names(plan.param_shardings(engine.params))[name]
+    newp = jax.device_put(jnp.asarray(value, dtype=oldp.dtype), shp)
+    engine.params = _set_leaf(engine.params, name, newp)
+
+
+def safe_set_full_optimizer_state(engine, name, state_key, value):
+    """Overwrite one optimizer-state tensor (reference :262)."""
+    from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
+    field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
+    sub = getattr(engine.opt_state, field, None)
+    if sub is None:
+        raise KeyError(state_key)
+    old = _lookup(sub, name)
+    if old is None:
+        raise KeyError(name)
+    new = jax.device_put(jnp.asarray(value, dtype=old.dtype), old.sharding)
+    new_sub = _set_leaf(sub, name, new)
+    engine.opt_state = engine.opt_state._replace(**{field: new_sub})
+
+
+# ------------------------------------------------------- local (shard) view
+def safe_get_local_fp32_param(engine, name):
+    """This host's shard of the fp32 master (reference ZeRO-3 local API :280)."""
+    src = engine.master if engine.master is not None else engine.params
+    leaf = _lookup(src, name)
+    if leaf is None:
+        return None
+    shards = [s for s in leaf.addressable_shards]
+    if not shards:
+        return None
+    return np.asarray(shards[0].data, dtype=np.float32)
+
+
+def safe_get_local_grad(engine, name):
+    leaf = _lookup(engine.grad_acc, name)
+    if leaf is None:
+        return None
+    shards = leaf.addressable_shards
+    if not shards:
+        return None
+    scale = float(engine.scale_state.scale) if engine.scale_state is not None else 1.0
+    return np.asarray(shards[0].data, dtype=np.float32) / scale
+
+
+def safe_get_local_optimizer_state(engine, name, state_key):
+    from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
+    field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
+    sub = getattr(engine.opt_state, field, None)
+    leaf = _lookup(sub, name)
+    if leaf is None:
+        return None
+    shards = leaf.addressable_shards
+    if not shards:
+        return None
+    return np.asarray(shards[0].data, dtype=np.float32)
