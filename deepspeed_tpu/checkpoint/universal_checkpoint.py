@@ -26,7 +26,9 @@ def _load_param_file(zero_root, name, key):
 
 
 def load_universal_checkpoint(engine, load_dir, tag=None,
-                              load_optimizer_states=True):
+                              load_optimizer_states=True,
+                              load_lr_scheduler_states=True,
+                              load_module_only=False):
     """Populate ``engine.params`` / ``engine.master`` / ``engine.opt_state``
     from a universal checkpoint directory."""
     root = os.path.join(load_dir, tag) if tag else load_dir
@@ -67,6 +69,11 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
                               engine.plan.master_shardings(engine.master),
                               dtype=jnp.float32)
 
+    if load_module_only:
+        log_dist(f"loaded module weights from universal checkpoint {root}",
+                 ranks=[0])
+        return tag, meta.get("engine_state", {}).get("client_state", {})
+
     # ---- optimizer state: walk fields whose subtree mirrors the param tree
     if load_optimizer_states and engine.opt_state is not None:
         target = engine.master if engine.master is not None else engine.params
@@ -103,7 +110,8 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
         engine.scale_state = engine.scale_state._replace(
             scale=jnp.asarray(es["loss_scale"],
                               dtype=engine.scale_state.scale.dtype))
-    if engine.lr_scheduler is not None and "lr_scheduler" in es and \
+    if load_lr_scheduler_states and engine.lr_scheduler is not None and \
+            "lr_scheduler" in es and \
             hasattr(engine.lr_scheduler, "load_state_dict"):
         engine.lr_scheduler.load_state_dict(es["lr_scheduler"])
     log_dist(f"loaded universal checkpoint from {root} "

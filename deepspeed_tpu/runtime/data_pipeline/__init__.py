@@ -1,0 +1,11 @@
+"""Data efficiency pipeline (reference ``runtime/data_pipeline/``):
+curriculum learning, efficient sampling, offline data analysis, mmap indexed
+datasets, and random-LTD token dropping."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_analyzer import DataAnalyzer
+from .data_sampler import DeepSpeedDataSampler, DistributedSampler
+from .data_routing import (RandomLTDScheduler, random_ltd_gather,
+                           random_ltd_scatter, random_ltd_select)
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                              make_indexed_dataset)

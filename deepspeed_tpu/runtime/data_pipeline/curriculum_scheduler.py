@@ -1,0 +1,96 @@
+"""Curriculum learning scheduler — reference
+``runtime/data_pipeline/curriculum_scheduler.py:158`` (CurriculumScheduler).
+
+Maps global step → difficulty (e.g. sequence length).  Schedule types match
+the reference config schema: ``fixed_linear``, ``fixed_root``,
+``fixed_discrete``, ``custom``.
+"""
+
+import math
+
+CURRICULUM_LEARNING_MIN_DIFFICULTY = "min_difficulty"
+CURRICULUM_LEARNING_MAX_DIFFICULTY = "max_difficulty"
+CURRICULUM_LEARNING_SCHEDULE_TYPE = "schedule_type"
+CURRICULUM_LEARNING_SCHEDULE_CONFIG = "schedule_config"
+
+
+class CurriculumScheduler:
+    def __init__(self, config):
+        self.state = {}
+        assert CURRICULUM_LEARNING_MIN_DIFFICULTY in config, \
+            f"curriculum config must define {CURRICULUM_LEARNING_MIN_DIFFICULTY}"
+        assert CURRICULUM_LEARNING_MAX_DIFFICULTY in config, \
+            f"curriculum config must define {CURRICULUM_LEARNING_MAX_DIFFICULTY}"
+        assert CURRICULUM_LEARNING_SCHEDULE_TYPE in config, \
+            f"curriculum config must define {CURRICULUM_LEARNING_SCHEDULE_TYPE}"
+        self.min_difficulty = config[CURRICULUM_LEARNING_MIN_DIFFICULTY]
+        self.max_difficulty = config[CURRICULUM_LEARNING_MAX_DIFFICULTY]
+        self.schedule_type = config[CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        self.schedule_config = config.get(CURRICULUM_LEARNING_SCHEDULE_CONFIG, {})
+        self.current_difficulty = self.min_difficulty
+        self.custom_get_difficulty = None
+        self.first_step = True
+
+        if self.schedule_type == "fixed_linear":
+            assert "total_curriculum_step" in self.schedule_config
+            assert "difficulty_step" in self.schedule_config
+        elif self.schedule_type == "fixed_root":
+            assert "total_curriculum_step" in self.schedule_config
+            assert "difficulty_step" in self.schedule_config
+            assert "root_degree" in self.schedule_config
+        elif self.schedule_type == "fixed_discrete":
+            assert "difficulty" in self.schedule_config
+            assert "max_step" in self.schedule_config
+            assert len(self.schedule_config["difficulty"]) == \
+                len(self.schedule_config["max_step"]) + 1
+        elif self.schedule_type == "custom":
+            pass
+        else:
+            raise ValueError(f"unknown curriculum schedule {self.schedule_type}")
+
+    def get_current_difficulty(self):
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty):
+        self.current_difficulty = difficulty
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_get_difficulty = fn
+
+    def _fixed_root_difficulty(self, global_steps, root_degree):
+        sc = self.schedule_config
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        diff = self.min_difficulty + (self.max_difficulty -
+                                      self.min_difficulty) * \
+            (frac ** (1.0 / root_degree))
+        step = sc["difficulty_step"]
+        diff = int(diff / step) * step
+        return min(self.max_difficulty, max(self.min_difficulty, diff))
+
+    def get_difficulty(self, global_steps):
+        if self.schedule_type == "fixed_linear":
+            return self._fixed_root_difficulty(global_steps, 1.0)
+        if self.schedule_type == "fixed_root":
+            return self._fixed_root_difficulty(
+                global_steps, self.schedule_config["root_degree"])
+        if self.schedule_type == "fixed_discrete":
+            sc = self.schedule_config
+            for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+                if global_steps <= max_step:
+                    return diff
+            return sc["difficulty"][-1]
+        if self.schedule_type == "custom":
+            assert self.custom_get_difficulty is not None, \
+                "custom schedule requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise ValueError(self.schedule_type)
+
+    def update_difficulty(self, global_steps):
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

@@ -1,0 +1,87 @@
+"""Offline data analysis — reference
+``runtime/data_pipeline/data_sampling/data_analyzer.py:22`` (DataAnalyzer).
+
+Map-reduce over a dataset: worker i analyzes its contiguous shard with
+user-supplied metric functions, writes per-shard results, and ``merge``
+produces the final per-sample metric array + sample buckets that
+``DeepSpeedDataSampler`` consumes for curriculum learning.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+class DataAnalyzer:
+    def __init__(self, dataset, output_path, metric_names=None,
+                 metric_functions=None, num_workers=1, worker_id=0,
+                 batch_size=64):
+        """``metric_functions``: list of callables sample → scalar."""
+        self.dataset = dataset
+        self.output_path = os.path.abspath(output_path)
+        self.metric_names = metric_names or ["metric"]
+        self.metric_functions = metric_functions or []
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+        os.makedirs(self.output_path, exist_ok=True)
+
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def _shard_file(self, name, worker_id=None):
+        wid = self.worker_id if worker_id is None else worker_id
+        return os.path.join(self.output_path,
+                            f"{name}_worker{wid}.npy")
+
+    def run_map(self):
+        """Analyze this worker's shard; write {metric}_worker{i}.npy."""
+        lo, hi = self._shard_range()
+        results = {name: [] for name in self.metric_names}
+        for i in range(lo, hi):
+            sample = self.dataset[i]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                results[name].append(float(fn(sample)))
+        for name in self.metric_names:
+            np.save(self._shard_file(name),
+                    np.asarray(results[name], dtype=np.float64))
+        with open(os.path.join(self.output_path,
+                               f"shard_worker{self.worker_id}.json"), "w") as f:
+            json.dump({"lo": lo, "hi": hi}, f)
+        return {k: np.asarray(v) for k, v in results.items()}
+
+    def run_reduce(self):
+        """Merge all worker shards → {metric}_values.npy + index maps."""
+        merged = {}
+        for name in self.metric_names:
+            parts = []
+            for w in range(self.num_workers):
+                path = self._shard_file(name, w)
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"worker {w} shard missing for metric {name}: {path}")
+                parts.append(np.load(path))
+            values = np.concatenate(parts)
+            np.save(os.path.join(self.output_path, f"{name}_values.npy"),
+                    values)
+            # sample index sorted by metric (easy→hard), the curriculum
+            # consumption order (reference index_to_sample files)
+            order = np.argsort(values, kind="stable")
+            np.save(os.path.join(self.output_path,
+                                 f"{name}_index_to_sample.npy"), order)
+            merged[name] = values
+        return merged
+
+    def run(self):
+        self.run_map()
+        if self.worker_id == 0 and self.num_workers == 1:
+            return self.run_reduce()
+        return None
+
+    @staticmethod
+    def load_metric(output_path, metric_name="metric"):
+        return np.load(os.path.join(output_path, f"{metric_name}_values.npy"))

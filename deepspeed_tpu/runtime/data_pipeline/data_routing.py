@@ -1,0 +1,91 @@
+"""Random layerwise token dropping (random-LTD) — reference
+``runtime/data_pipeline/data_routing/basic_layer.py:113`` + the
+``csrc/random_ltd`` token_sort/gather_scatter CUDA kernels.
+
+Each wrapped layer processes only a random subset of tokens; dropped tokens
+bypass the layer (identity) and are scattered back in position.  On TPU the
+sort/gather/scatter kernels are ``jax.random.permutation`` +
+``jnp.take_along_axis``/``.at[].set`` — XLA lowers these to efficient
+dynamic-gather ops, no custom kernel needed (SURVEY.md §2.2 random-LTD row).
+
+The token budget follows a linear schedule from ``start`` to ``seq_len``
+over ``total_steps`` (reference scheduler.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def random_ltd_select(key, seq_len, keep):
+    """Sorted indices of ``keep`` kept tokens and the complementary dropped
+    set (reference token_sort.cu)."""
+    perm = jax.random.permutation(key, seq_len)
+    kept = jnp.sort(perm[:keep])
+    dropped = jnp.sort(perm[keep:])
+    return kept, dropped
+
+
+def random_ltd_gather(x, indices):
+    """Gather tokens along the sequence axis (axis=1; [B, S, H])."""
+    return jnp.take(x, indices, axis=1)
+
+
+def random_ltd_scatter(full, part, indices):
+    """Scatter layer outputs back into the full sequence (gather_scatter.cu)."""
+    return full.at[:, indices, :].set(part)
+
+
+def apply_random_ltd(layer_fn, x, key, keep, mask=None):
+    """Run ``layer_fn`` on a random ``keep``-token subset of ``x`` [B,S,H];
+    dropped tokens pass through unchanged (reference basic_layer forward)."""
+    seq_len = x.shape[1]
+    kept, _ = random_ltd_select(key, seq_len, keep)
+    sub = random_ltd_gather(x, kept)
+    sub_mask = None
+    if mask is not None:
+        # slice attention mask rows+cols to the kept tokens
+        # (slice_attn_masks.cu)
+        sub_mask = jnp.take(jnp.take(mask, kept, axis=-1), kept, axis=-2)
+    out = layer_fn(sub, sub_mask) if mask is not None else layer_fn(sub)
+    return random_ltd_scatter(x, out, kept)
+
+
+class RandomLTDScheduler:
+    """Token-budget schedule (reference data_routing/scheduler.py):
+    linear increase from ``start_token`` to ``seq_len`` over
+    ``token_lr_steps``."""
+
+    def __init__(self, seq_len, start_token, token_lr_steps,
+                 layer_ids=None):
+        self.seq_len = int(seq_len)
+        self.start_token = int(start_token)
+        self.token_lr_steps = int(token_lr_steps)
+        self.layer_ids = layer_ids
+        self.current_step = 0
+
+    def get_current_seq(self, step=None):
+        step = self.current_step if step is None else step
+        if step >= self.token_lr_steps:
+            return self.seq_len
+        frac = step / max(1, self.token_lr_steps)
+        keep = self.start_token + frac * (self.seq_len - self.start_token)
+        # keep a multiple of 128 when possible (TPU lane alignment — dynamic
+        # gather shapes must still tile onto the MXU)
+        keep = int(keep)
+        if keep >= 256:
+            keep = (keep // 128) * 128
+        return min(self.seq_len, max(1, keep))
+
+    def update_seq(self, step=None):
+        if step is not None:
+            self.current_step = step
+        else:
+            self.current_step += 1
+        return self.get_current_seq()
+
+    def state_dict(self):
+        return {"current_step": self.current_step}
+
+    def load_state_dict(self, sd):
+        self.current_step = sd["current_step"]

@@ -1,0 +1,151 @@
+"""Samplers — reference
+``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(DeepSpeedDataSampler) + torch ``DistributedSampler`` semantics that the
+plain dataloader path uses.
+
+``DeepSpeedDataSampler`` implements curriculum-aware sampling: given a
+per-sample difficulty metric (from ``DataAnalyzer``), each global batch draws
+only samples whose difficulty ≤ the CurriculumScheduler's current value,
+consuming easier buckets first — reference behavior, re-expressed without
+torch generators (numpy PCG with a seed+epoch stream, identical across ranks
+so every rank derives the same global batch; the engine shards it over dp).
+"""
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DistributedSampler:
+    """Rank-sharded epoch permutation (torch DistributedSampler parity —
+    used when one process per chip feeds its own dataloader)."""
+
+    def __init__(self, dataset_len, num_replicas=1, rank=0, shuffle=True,
+                 seed=0, drop_last=False):
+        if isinstance(dataset_len, (list, tuple)) or hasattr(dataset_len, "__len__"):
+            dataset_len = len(dataset_len)
+        self.n = int(dataset_len)
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = self.n // num_replicas
+        else:
+            self.num_samples = (self.n + num_replicas - 1) // num_replicas
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.num_samples
+
+    def __iter__(self):
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.n).tolist()
+        else:
+            indices = list(range(self.n))
+        if not self.drop_last:
+            pad = self.total_size - len(indices)
+            indices += indices[:pad]
+        else:
+            indices = indices[:self.total_size]
+        return iter(indices[self.rank:self.total_size:self.num_replicas])
+
+
+class DeepSpeedDataSampler:
+    """Curriculum-learning batch sampler.
+
+    Args:
+      total_samples: dataset length
+      metric_values: per-sample difficulty (np array, e.g. seqlen) — the
+        output of ``DataAnalyzer``; None disables filtering (plain shuffle)
+      curriculum_config: dict for CurriculumScheduler (or a scheduler)
+      global_batch_size: samples per global batch
+    """
+
+    def __init__(self, total_samples, global_batch_size, metric_values=None,
+                 curriculum_config=None, shuffle=True, seed=1234,
+                 drop_last=True, gradient_accumulation_steps=1,
+                 data_parallel_rank=0, data_parallel_size=1):
+        self.total_samples = int(total_samples)
+        self.global_batch_size = int(global_batch_size)
+        self.metric_values = (np.asarray(metric_values)
+                              if metric_values is not None else None)
+        if isinstance(curriculum_config, CurriculumScheduler):
+            self.curriculum_scheduler = curriculum_config
+        elif curriculum_config:
+            self.curriculum_scheduler = CurriculumScheduler(curriculum_config)
+        else:
+            self.curriculum_scheduler = None
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.batch_step = 0
+        self.consumed_samples = 0
+
+    def __len__(self):
+        return self.total_samples // self.global_batch_size
+
+    def state_dict(self):
+        return {"batch_step": self.batch_step,
+                "consumed_samples": self.consumed_samples,
+                "curriculum": (self.curriculum_scheduler.state_dict()
+                               if self.curriculum_scheduler else None)}
+
+    def load_state_dict(self, sd):
+        self.batch_step = sd["batch_step"]
+        self.consumed_samples = sd["consumed_samples"]
+        if self.curriculum_scheduler and sd.get("curriculum"):
+            self.curriculum_scheduler.load_state_dict(sd["curriculum"])
+
+    def __iter__(self):
+        """One epoch: every sample drawn at most once (no replacement across
+        batches — reference sampler consumption semantics), with the
+        curriculum filter applied to the not-yet-consumed pool.  Every rank
+        derives the same stream (seeded by batch_step), so the global batch
+        is consistent without communication."""
+        remaining = np.ones(self.total_samples, dtype=bool)
+        if self.total_samples < self.global_batch_size:
+            return  # not even one full batch (drop_last semantics)
+        while remaining.sum() >= self.global_batch_size and \
+                self.batch_step < len(self):
+            difficulty = None
+            if self.curriculum_scheduler is not None:
+                difficulty = self.curriculum_scheduler.update_difficulty(
+                    self.batch_step)
+            if self.metric_values is not None and difficulty is not None:
+                pool = np.nonzero(remaining &
+                                  (self.metric_values <= difficulty))[0]
+            else:
+                pool = np.nonzero(remaining)[0]
+            if len(pool) < self.global_batch_size:
+                # curriculum floor thinner than a batch: top up with the
+                # easiest unconsumed samples
+                rest = np.nonzero(remaining)[0]
+                rest = rest[np.argsort(self.metric_values[rest],
+                                       kind="stable")] \
+                    if self.metric_values is not None else rest
+                extra = rest[~np.isin(rest, pool)]
+                pool = np.concatenate(
+                    [pool, extra[:self.global_batch_size - len(pool)]])
+            rng = np.random.default_rng(self.seed + self.batch_step)
+            if self.shuffle:
+                batch = rng.choice(pool, size=self.global_batch_size,
+                                   replace=False)
+            else:
+                batch = pool[:self.global_batch_size]
+            remaining[batch] = False
+            self.batch_step += 1
+            self.consumed_samples += self.global_batch_size
+            # per-dp-rank slice (engine path passes dp_size=1 and shards
+            # the assembled batch itself)
+            per_rank = self.global_batch_size // self.dp_size
+            lo = self.dp_rank * per_rank
+            yield batch[lo:lo + per_rank].tolist()

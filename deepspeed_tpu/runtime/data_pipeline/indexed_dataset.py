@@ -1,0 +1,129 @@
+"""Memory-mapped indexed dataset — reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` (627 LoC,
+Megatron-style .bin/.idx pair).
+
+Format (little-endian):
+
+    {path}.idx : magic b'DSTPUIDX' | version u64 | dtype_code u8 |
+                 n_sequences u64 | sizes u32[n] | pointers u64[n]
+    {path}.bin : raw sample data back-to-back
+
+Reading is ``np.memmap`` — no deserialization, page-cache backed, safe to
+share across dataloader workers; this is the property the reference's mmap
+implementation exists for.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+    5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, out_prefix, dtype=np.int32):
+        self.prefix = out_prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(out_prefix), "wb")
+        self._sizes = []
+
+    def add_item(self, array):
+        arr = np.asarray(array, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def add_document(self, array):
+        self.add_item(array)
+
+    def finalize(self):
+        self._bin.close()
+        sizes = np.asarray(self._sizes, dtype=np.uint32)
+        pointers = np.zeros(len(sizes), dtype=np.uint64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1].astype(np.uint64) * self.dtype.itemsize,
+                      out=pointers[1:])
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(sizes.tobytes())
+            f.write(pointers.tobytes())
+        return self.prefix
+
+
+class MMapIndexedDataset:
+    """Map-style dataset over the .bin/.idx pair."""
+
+    def __init__(self, prefix):
+        idx_path = index_file_path(prefix)
+        with open(idx_path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{idx_path}: bad magic {magic!r}")
+            version, = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"{idx_path}: unsupported version {version}")
+            code, = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            self._len, = struct.unpack("<Q", f.read(8))
+            header = f.tell()
+        self._sizes = np.memmap(idx_path, dtype=np.uint32, mode="r",
+                                offset=header, shape=(self._len, ))
+        self._pointers = np.memmap(idx_path, dtype=np.uint64, mode="r",
+                                   offset=header + 4 * self._len,
+                                   shape=(self._len, ))
+        self._data = np.memmap(data_file_path(prefix), dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self):
+        return self._len
+
+    @property
+    def sizes(self):
+        return self._sizes
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._len))]
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        start = int(self._pointers[i]) // self.dtype.itemsize
+        size = int(self._sizes[i])
+        return np.asarray(self._data[start:start + size])
+
+    def get(self, idx, offset=0, length=None):
+        """Partial read (reference ``MMapIndexedDataset.get``)."""
+        start = int(self._pointers[idx]) // self.dtype.itemsize + offset
+        size = int(self._sizes[idx]) - offset
+        if length is not None:
+            size = min(size, length)
+        return np.asarray(self._data[start:start + size])
+
+    @staticmethod
+    def exists(prefix):
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))
+
+
+def make_indexed_dataset(prefix, impl="mmap", skip_warmup=True):
+    """Reference factory name."""
+    return MMapIndexedDataset(prefix)

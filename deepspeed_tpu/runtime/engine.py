@@ -110,6 +110,8 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.skipped_steps = 0
         self._stashed_grads = None
+        self._flops_profiled = False
+        self.flops_profiler = None
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_train_batch = {}
@@ -178,6 +180,22 @@ class DeepSpeedEngine:
                                and zc.offload_optimizer.device != "none"),
             offload_param=(zc.offload_param is not None
                            and zc.offload_param.device != "none"))
+
+        # legacy curriculum learning (reference engine exposes a
+        # CurriculumScheduler when "curriculum_learning" is configured)
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled_legacy:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            params = {k: v for k, v in
+                      self._config.curriculum_params_legacy.items()
+                      if k != "enabled"}
+            self.curriculum_scheduler = CurriculumScheduler(params)
+
+        ac = self._config.activation_checkpointing_config
+        if ac.partition_activations or ac.cpu_checkpointing or \
+                ac.contiguous_memory_optimization or ac.number_checkpoints:
+            from .activation_checkpointing import configure as ac_configure
+            ac_configure(deepspeed_config=self._config)
 
         # ------------------------------------------------------- parameters
         self.params = None
@@ -534,7 +552,37 @@ class DeepSpeedEngine:
         loss, grads = micro(self.params, self.scale_state.scale, inputs)
         self._stashed_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
+        self._maybe_profile_flops(inputs)
         return loss
+
+    def _maybe_profile_flops(self, inputs):
+        """Flops profiler hook (reference engine wires FlopsProfiler at
+        ``flops_profiler.profile_step``, profiler.py:30)."""
+        fp = self._config.flops_profiler_config
+        if not fp.enabled or self._flops_profiled or \
+                self.micro_steps + 1 < fp.profile_step:
+            return
+        self._flops_profiled = True
+        from ..profiling.flops_profiler import FlopsProfiler, jaxpr_flops
+        prof = FlopsProfiler(self)
+        apply_fn = self._apply_fn
+
+        def fwd(params, inputs):
+            out = apply_fn(params, *inputs)
+            return out[0] if isinstance(out, (tuple, list)) else out
+
+        # analytic only (trace, no compile — the train step is already
+        # compiled in _compiled_micro; recompiling here would double the
+        # XLA compile time/memory for large models)
+        prof.profile(fwd, self.params, inputs, compile_xla=False)
+        prof.step_flops = jaxpr_flops(self._micro_step_fn(), self.params,
+                                      self.scale_state.scale, inputs)[0]
+        if dist.get_rank() == 0:
+            prof.print_model_profile(profile_step=self.micro_steps + 1,
+                                     top_modules=fp.top_modules,
+                                     detailed=fp.detailed,
+                                     output_file=fp.output_file)
+        self.flops_profiler = prof
 
     def __call__(self, *inputs, **kwargs):
         return self.forward(*inputs, **kwargs)
@@ -577,6 +625,8 @@ class DeepSpeedEngine:
                          f"scale → {self.cur_scale}", ranks=[0])
             if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
                 self.lr_scheduler.step()
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
             self._report_step_metrics(gnorm)
         self.micro_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -633,7 +683,9 @@ class DeepSpeedEngine:
             from ..checkpoint.universal_checkpoint import load_universal_checkpoint
             return load_universal_checkpoint(
                 self, load_dir, tag=tag,
-                load_optimizer_states=load_optimizer_states)
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only)
         from .checkpoint_engine import load_engine_checkpoint
         return load_engine_checkpoint(
             self, load_dir, tag=tag,

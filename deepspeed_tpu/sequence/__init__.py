@@ -1,2 +1,5 @@
 from .layer import DistributedAttention, UlyssesAttention, single_all_to_all
 from .cross_entropy import vocab_sequence_parallel_cross_entropy
+from .fpdt_layer import (FPDT_Attention, FPDTHostOffloadAttention,
+                         SequenceChunk, chunked_attention, fpdt_ffn,
+                         fpdt_logits_loss, update_out_and_lse)

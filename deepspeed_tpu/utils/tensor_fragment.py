@@ -118,27 +118,46 @@ def safe_set_full_optimizer_state(engine, name, state_key, value):
 
 
 # ------------------------------------------------------- local (shard) view
+def _local_block(leaf, dtype=np.float32):
+    """Stitch this host's addressable shards into one array covering their
+    union bounding box (a host driving several chips owns several shards)."""
+    shards = list(leaf.addressable_shards)
+    if not shards:
+        return None
+    if len(shards) == 1:
+        return np.asarray(shards[0].data, dtype=dtype)
+    nd = leaf.ndim
+    starts = [min((s.index[d].start or 0) for s in shards) for d in range(nd)]
+    stops = [max((s.index[d].stop if s.index[d].stop is not None
+                  else leaf.shape[d]) for s in shards) for d in range(nd)]
+    out = np.zeros([hi - lo for lo, hi in zip(starts, stops)], dtype=dtype)
+    for s in shards:
+        sl = tuple(
+            slice((ix.start or 0) - lo,
+                  (ix.stop if ix.stop is not None else dim) - lo)
+            for ix, lo, dim in zip(s.index, starts, leaf.shape))
+        out[sl] = np.asarray(s.data, dtype=dtype)
+    return out
+
+
 def safe_get_local_fp32_param(engine, name):
     """This host's shard of the fp32 master (reference ZeRO-3 local API :280)."""
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
         return None
-    shards = [s for s in leaf.addressable_shards]
-    if not shards:
-        return None
-    return np.asarray(shards[0].data, dtype=np.float32)
+    return _local_block(leaf)
 
 
 def safe_get_local_grad(engine, name):
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
-    shards = leaf.addressable_shards
-    if not shards:
+    blk = _local_block(leaf)
+    if blk is None:
         return None
     scale = float(engine.scale_state.scale) if engine.scale_state is not None else 1.0
-    return np.asarray(shards[0].data, dtype=np.float32) / scale
+    return blk / scale
 
 
 def safe_get_local_optimizer_state(engine, name, state_key):
@@ -148,7 +167,4 @@ def safe_get_local_optimizer_state(engine, name, state_key):
     leaf = _lookup(sub, name)
     if leaf is None:
         return None
-    shards = leaf.addressable_shards
-    if not shards:
-        return None
-    return np.asarray(shards[0].data, dtype=np.float32)
+    return _local_block(leaf)

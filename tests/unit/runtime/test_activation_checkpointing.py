@@ -1,0 +1,113 @@
+"""Activation checkpointing tests — analog of reference
+``tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py``:
+remat must not change values or gradients."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.activation_checkpointing import (
+    RNGStatesTracker, checkpoint, configure, get_policy, get_rng_tracker,
+    is_configured, model_parallel_rng_seed, non_reentrant_checkpoint, reset)
+
+
+@pytest.fixture(autouse=True)
+def _reset_cfg():
+    yield
+    reset()
+
+
+def _block(w):
+    def f(x):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(h * h)
+    return f
+
+
+def test_checkpoint_preserves_values_and_grads():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    f = _block(w)
+
+    ref_val = f(x)
+    ref_grad = jax.grad(f)(x)
+
+    ck_val = checkpoint(f, x)
+    ck_grad = jax.grad(lambda x_: checkpoint(f, x_))(x)
+
+    np.testing.assert_allclose(ref_val, ck_val, rtol=1e-6)
+    np.testing.assert_allclose(ref_grad, ck_grad, rtol=1e-6)
+
+    nr_val = non_reentrant_checkpoint(f, x)
+    np.testing.assert_allclose(ref_val, nr_val, rtol=1e-6)
+
+
+@pytest.mark.parametrize("flags", [
+    {"partition_activations": True},
+    {"checkpoint_in_cpu": True},
+    {"contiguous_checkpointing": True},
+])
+def test_configured_policies_still_correct(flags):
+    configure(**flags)
+    assert is_configured()
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    f = _block(w)
+    np.testing.assert_allclose(f(x), checkpoint(f, x), rtol=1e-6)
+    g_ref = jax.grad(f)(x)
+    g_ck = jax.grad(lambda x_: checkpoint(f, x_))(x)
+    np.testing.assert_allclose(g_ref, g_ck, rtol=1e-6)
+
+
+def test_checkpoint_inside_jit_and_scan():
+    """remat must compose with jit + scan (the PP/long-context path)."""
+    w = jnp.eye(8) * 0.5
+
+    def layer(x):
+        return jnp.tanh(x @ w)
+
+    @jax.jit
+    def stacked(x):
+        def body(c, _):
+            return checkpoint(layer, c), None
+        out, _ = jax.lax.scan(body, x, None, length=4)
+        return jnp.sum(out)
+
+    x = jnp.ones((2, 8))
+    val = stacked(x)
+    g = jax.jit(jax.grad(stacked))(x)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_rng_tracker_fork_deterministic():
+    tr = RNGStatesTracker()
+    tr.add("model-parallel-rng", 42)
+    with tr.fork() as k1:
+        a = jax.random.normal(k1, (4, ))
+    tr2 = RNGStatesTracker()
+    tr2.add("model-parallel-rng", 42)
+    with tr2.fork() as k2:
+        b = jax.random.normal(k2, (4, ))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # second fork draws a different stream
+    with tr.fork() as k3:
+        c = jax.random.normal(k3, (4, ))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    with pytest.raises(Exception):
+        tr.add("model-parallel-rng", 1)  # duplicate
+    with pytest.raises(Exception):
+        with tr.fork("missing"):
+            pass
+
+
+def test_model_parallel_rng_seed():
+    tr = model_parallel_rng_seed(1234)
+    assert tr is get_rng_tracker()
+    states = tr.get_states()
+    assert "default" in states and "model-parallel-rng" in states

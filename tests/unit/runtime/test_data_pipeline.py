@@ -1,0 +1,189 @@
+"""Data pipeline tests — analog of reference
+``tests/unit/runtime/test_data_efficiency.py`` + data_sampling suites."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    DistributedSampler, MMapIndexedDataset, MMapIndexedDatasetBuilder,
+    RandomLTDScheduler, make_indexed_dataset)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    apply_random_ltd, random_ltd_gather, random_ltd_scatter,
+    random_ltd_select)
+from tests.unit.simple_model import (batches, make_simple_mlp_params,
+                                     random_dataset, simple_mlp_apply)
+
+
+# ---------------------------------------------------------------- curriculum
+def test_curriculum_fixed_linear():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    })
+    assert sched.update_difficulty(0) == 8
+    mid = sched.update_difficulty(5)
+    assert 8 < mid < 64 and mid % 8 == 0
+    assert sched.update_difficulty(10) == 64
+    assert sched.update_difficulty(100) == 64
+
+
+def test_curriculum_fixed_root_and_discrete():
+    root = CurriculumScheduler({
+        "min_difficulty": 4, "max_difficulty": 100,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 1, "root_degree": 2},
+    })
+    # sqrt schedule grows fast early
+    assert root.get_difficulty(25) >= 4 + (100 - 4) * 0.5 - 1
+
+    disc = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 3,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]},
+    })
+    assert disc.get_difficulty(3) == 1
+    assert disc.get_difficulty(7) == 2
+    assert disc.get_difficulty(50) == 3
+
+
+def test_curriculum_state_roundtrip():
+    cfg = {"min_difficulty": 2, "max_difficulty": 10,
+           "schedule_type": "fixed_linear",
+           "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 2}}
+    a = CurriculumScheduler(cfg)
+    a.update_difficulty(3)
+    b = CurriculumScheduler(cfg)
+    b.load_state_dict(a.state_dict())
+    assert b.get_current_difficulty() == a.get_current_difficulty()
+
+
+# ------------------------------------------------------------- indexed data
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ds")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    samples = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+    for s in samples:
+        builder.add_item(s)
+    builder.finalize()
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = make_indexed_dataset(prefix)
+    assert len(ds) == 4
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s)
+    np.testing.assert_array_equal(ds.sizes, [3, 7, 1, 12])
+    # partial read
+    np.testing.assert_array_equal(ds.get(3, offset=2, length=4),
+                                  np.arange(2, 6, dtype=np.int32))
+
+
+# ----------------------------------------------------------------- samplers
+def test_distributed_sampler_partitions():
+    n = 20
+    seen = []
+    for rank in range(4):
+        s = DistributedSampler(n, num_replicas=4, rank=rank, shuffle=True,
+                               seed=7, drop_last=True)
+        idx = list(s)
+        assert len(idx) == 5
+        seen.extend(idx)
+    assert sorted(seen) == sorted(set(seen))  # disjoint
+
+
+def test_curriculum_sampler_respects_difficulty():
+    n = 100
+    metric = np.arange(n)  # sample i has difficulty i
+    sampler = DeepSpeedDataSampler(
+        total_samples=n, global_batch_size=8, metric_values=metric,
+        curriculum_config={
+            "min_difficulty": 16, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1},
+        })
+    it = iter(sampler)
+    first = next(it)
+    assert max(first) <= 16  # step-0 difficulty floor
+    later = None
+    for _ in range(9):
+        later = next(it)
+    assert max(later) > 16  # difficulty grew
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    data = [np.arange(n) for n in np.random.default_rng(0).integers(1, 50, 32)]
+    # 2 workers then merge
+    for w in range(2):
+        DataAnalyzer(data, str(tmp_path), metric_names=["seqlen"],
+                     metric_functions=[len], num_workers=2,
+                     worker_id=w).run_map()
+    merged = DataAnalyzer(data, str(tmp_path), metric_names=["seqlen"],
+                          metric_functions=[len], num_workers=2,
+                          worker_id=0).run_reduce()
+    np.testing.assert_array_equal(merged["seqlen"],
+                                  [len(d) for d in data])
+    order = np.load(tmp_path / "seqlen_index_to_sample.npy")
+    sorted_lens = np.asarray([len(data[i]) for i in order])
+    assert (np.diff(sorted_lens) >= 0).all()
+
+
+# ---------------------------------------------------------------- random-LTD
+def test_random_ltd_gather_scatter_inverse():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 8)),
+                    jnp.float32)
+    kept, dropped = random_ltd_select(jax.random.key(0), 16, 10)
+    assert kept.shape == (10, ) and dropped.shape == (6, )
+    assert len(np.intersect1d(np.asarray(kept), np.asarray(dropped))) == 0
+    sub = random_ltd_gather(x, kept)
+    back = random_ltd_scatter(x, sub, kept)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_apply_random_ltd_passthrough_semantics():
+    x = jnp.ones((2, 12, 4))
+    out = apply_random_ltd(lambda t: t * 2.0, x, jax.random.key(1), keep=5)
+    # exactly 5 tokens doubled, 7 untouched
+    doubled = np.isclose(np.asarray(out)[0, :, 0], 2.0).sum()
+    assert doubled == 5
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler(seq_len=1024, start_token=128, token_lr_steps=100)
+    assert s.get_current_seq(0) == 128
+    assert s.get_current_seq(100) == 1024
+    mid = s.get_current_seq(50)
+    assert 128 < mid < 1024
+    assert mid % 128 == 0  # TPU lane alignment
+
+
+def test_engine_curriculum_legacy_wiring():
+    params = make_simple_mlp_params(16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 2, "max_difficulty": 10,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 5,
+                                    "difficulty_step": 2},
+            },
+        })
+    assert engine.curriculum_scheduler is not None
+    data = batches(random_dataset(32, 16), 4 * engine.dp_world_size)
+    it = iter(data * 10)
+    for _ in range(6):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    assert engine.curriculum_scheduler.get_current_difficulty() == 10

@@ -1,0 +1,154 @@
+"""FPDT long-context tests — analog of reference FPDT coverage
+(``tests/unit/sequence_parallelism``): chunked attention must match dense
+attention exactly, gradients must flow, host-offload streaming must agree."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import _xla_attention
+from deepspeed_tpu.sequence import (FPDT_Attention, FPDTHostOffloadAttention,
+                                    chunked_attention, fpdt_ffn,
+                                    fpdt_logits_loss, update_out_and_lse)
+from deepspeed_tpu.utils import groups
+
+B, S, H, D = 2, 64, 4, 8
+
+
+def _qkv(seed=0, s=S):
+    rng = np.random.default_rng(seed)
+    shape = (B, s, H, D)
+    return tuple(jnp.asarray(rng.standard_normal(shape), jnp.float32) * 0.3
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("q_chunk,kv_chunk", [(16, 16), (32, 8), (64, 64)])
+def test_chunked_matches_dense(causal, q_chunk, kv_chunk):
+    q, k, v = _qkv()
+    ref = _xla_attention(q, k, v, causal=causal)
+    got = chunked_attention(q, k, v, q_chunk=q_chunk, kv_chunk=kv_chunk,
+                            causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_attention_grads_match():
+    q, k, v = _qkv(1)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True) ** 2)
+
+    def loss_chunk(q, k, v):
+        return jnp.sum(chunked_attention(q, k, v, q_chunk=16, kv_chunk=16,
+                                         causal=True) ** 2)
+
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss_chunk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_online_softmax_merge_identity():
+    """Merging two half-splits must equal one full softmax."""
+    q, k, v = _qkv(2)
+    full_out, full_lse = None, None
+    ref = _xla_attention(q, k, v, causal=False)
+
+    from deepspeed_tpu.sequence.fpdt_layer import _chunk_attend, NEG_INF
+    out = jnp.zeros((B, S, H, D), jnp.float32)
+    lse = jnp.full((B, S, H), NEG_INF, jnp.float32)
+    for lo, hi in ((0, S // 2), (S // 2, S)):
+        o, l = _chunk_attend(q, k[:, lo:hi], v[:, lo:hi])
+        out, lse = update_out_and_lse(out, lse, o, l)
+    np.testing.assert_allclose(np.asarray(out.astype(q.dtype)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_host_offload_streaming_matches_dense():
+    q, k, v = _qkv(3)
+    attn = FPDTHostOffloadAttention(chunk_size=16)
+    # stream the KV in 4 chunks as "history", then attend non-causally
+    for lo in range(0, S, 16):
+        attn.append_kv(k[:, lo:lo + 16], v[:, lo:lo + 16])
+    assert attn.context_length == S
+    out = attn.attend(q)
+    ref = _xla_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_host_offload_decode_style():
+    """Block-by-block decode: each new block attends to history + itself."""
+    q, k, v = _qkv(4)
+    attn = FPDTHostOffloadAttention(chunk_size=16)
+    outs = []
+    for lo in range(0, S, 16):
+        sl = slice(lo, lo + 16)
+        outs.append(attn.attend(q[:, sl], k[:, sl], v[:, sl]))
+    got = jnp.concatenate(outs, axis=1)
+    ref = _xla_attention(q, k, v, causal=True)
+    # block-causal equals token-causal only within blocks — compare against
+    # chunked reference with the same 16-token causal granularity
+    ref_blocks = []
+    for lo in range(0, S, 16):
+        sl = slice(lo, lo + 16)
+        kk = k[:, :lo + 16]
+        vv = v[:, :lo + 16]
+        mask_ref = _xla_attention(q[:, sl], kk, vv, causal=True)
+        ref_blocks.append(mask_ref)
+    ref2 = jnp.concatenate(ref_blocks, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref2),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_fpdt_ffn_chunked():
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((B, S, D)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((D, 4 * D)), jnp.float32) * 0.2
+    w2 = jnp.asarray(rng.standard_normal((4 * D, D)), jnp.float32) * 0.2
+
+    def ffn(h):
+        return jax.nn.gelu(h @ w1) @ w2
+
+    ref = ffn(x)
+    got = fpdt_ffn(ffn, x, chunk_size=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fpdt_logits_loss_matches_dense():
+    rng = np.random.default_rng(6)
+    V = 97
+    hidden = jnp.asarray(rng.standard_normal((B, S, D)), jnp.float32)
+    vocab = jnp.asarray(rng.standard_normal((D, V)), jnp.float32) * 0.1
+    labels = jnp.asarray(rng.integers(0, V, (B, S)))
+
+    logits = (hidden @ vocab).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    ref = jnp.mean(logz - gold)
+
+    got = fpdt_logits_loss(hidden, vocab, labels, chunk_size=16)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    # grads flow through the chunked loss
+    g = jax.grad(lambda h: fpdt_logits_loss(h, vocab, labels, chunk_size=16))(
+        hidden)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fpdt_attention_over_sp_mesh():
+    """FPDT_Attention = Ulysses a2a + chunked local attention on the sp axis."""
+    groups.initialize_mesh(dp=2, sp=4)
+    try:
+        q, k, v = _qkv(7)
+        fp = FPDT_Attention(q_chunk=16, kv_chunk=16, causal=True)
+        out = fp(q, k, v)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+    finally:
+        groups.reset_mesh()
