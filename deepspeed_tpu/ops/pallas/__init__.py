@@ -1,0 +1,22 @@
+"""Pallas TPU kernels — the native-kernel layer (L1).
+
+TPU answer to the reference's ``csrc/`` CUDA tree (SURVEY.md §2.2): where the
+reference JIT-compiles .cu files through the op-builder, we ship Pallas
+(Mosaic) kernels compiled by XLA.  Each kernel has an interpret-mode path so
+the numerics tests run on CPU (the analog of the reference's per-kernel
+numerics tests vs a torch oracle, ``tests/unit/ops/``).
+
+Kernels:
+  flash_attention — blockwise online-softmax attention (fwd+bwd), the analog
+      of csrc/transformer/inference softmax+attention and the FastGen
+      blocked-flash kernels.
+  optimizers — fused Adam/Lion/LAMB elementwise update kernels with
+      interleaved master-weight cast (csrc/adam/multi_tensor_adam.cu,
+      csrc/lion, csrc/lamb).
+  quantizer — blockwise int8/int4 (de)quantization (csrc/quantization) used
+      by ZeRO++ qwZ/qgZ and weight-only inference quant.
+"""
+
+from .flash_attention import flash_attention
+from .quantizer import quantize_blockwise, dequantize_blockwise
+from .optimizers import (fused_adam_step, fused_lion_step, fused_lamb_step)

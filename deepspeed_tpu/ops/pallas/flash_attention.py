@@ -1,0 +1,347 @@
+"""Blockwise online-softmax (flash) attention, Pallas TPU.
+
+TPU-native re-design of the reference's attention kernels
+(``csrc/transformer/inference/csrc/softmax.cu`` + the FastGen blocked flash,
+``inference/v2/kernels/ragged_ops/blocked_flash``): one fused kernel that
+streams K/V blocks through VMEM, keeping the running max/sum (online softmax,
+the same recurrence FPDT uses at chunk granularity —
+``deepspeed/sequence/fpdt_layer.py:58 update_out_and_lse``) in VMEM scratch so
+the S×S score matrix never exists in HBM.
+
+Layout: [B, H, S, D] inside the kernel (callers use [B, S, H, D]; the public
+wrapper transposes).  Q-heads may be a multiple of KV-heads (GQA/MQA): K/V
+blocks are fetched per KV-head via the BlockSpec index map — no materialized
+`repeat`, so HBM traffic stays proportional to the KV size.
+
+Backward is the standard two-kernel flash recomputation (dq; dk+dv) behind a
+``jax.custom_vjp``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = float("-inf")
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    rem = (-size) % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+def _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k):
+    """Validity mask for one (block_q, block_k) score tile.  ``sq``/``sk`` are
+    the *unpadded* lengths, so the zero-padded K tail is always excluded."""
+    col = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = col < sk
+    if causal:
+        row = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                 (block_q, block_k), 0)
+        mask = jnp.logical_and(mask, row + (sk - sq) >= col)
+    return mask
+
+
+def _block_live(q_start, k_start, causal, sq, sk, block_q):
+    """Whether this K block contributes at all (static-shape early-out)."""
+    live = k_start < sk
+    if causal:
+        live = jnp.logical_and(live,
+                               k_start <= q_start + block_q - 1 + (sk - sq))
+    return live
+
+
+# --------------------------------------------------------------------- fwd
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, sq, sk, block_q, block_k):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start, k_start = iq * block_q, ik * block_k
+
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Rows with every position masked (padded Q tail) keep m=-inf; guard
+        # the exp so they stay 0 rather than nan.
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.where(mask, jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        lse = jnp.where(m == _NEG_INF, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
+    """Core on padded [B,H,S,D] inputs; sq/sk are the unpadded lengths."""
+    B, Hq, sq_p, D = q.shape
+    _, Hkv, sk_p, _ = k.shape
+    nq, nk = sq_p // block_q, sk_p // block_k
+    kv_head = lambda h: (h * Hkv) // Hq
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i, j:
+                         (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, sq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, sq_p, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# --------------------------------------------------------------------- bwd
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, sq, sk, block_q, block_k):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start, k_start = iq * block_q, ik * block_k
+
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale, causal, sq, sk, block_q,
+                    block_k):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, k_start = iq * block_q, ik * block_k
+
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        # dv += pᵀ·do ; ds = p∘(do·vᵀ − delta) ; dk += dsᵀ·q
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
+    B, Hq, sq_p, D = q.shape
+    _, Hkv, sk_p, _ = k.shape
+    nq, nk = sq_p // block_q, sk_p // block_k
+    kv_head = lambda h: (h * Hkv) // Hq
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # Broadcast per-row scalars across the 128-lane minor dim once, outside.
+    lse_l = jnp.broadcast_to(lse[..., None], lse.shape + (128, ))
+    delta_l = jnp.broadcast_to(delta[..., None], delta.shape + (128, ))
+
+    semantics = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, sq=sq,
+                          sk=sk, block_q=block_q, block_k=block_k),
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), j, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=semantics,
+        interpret=_interpret(),
+    )(q, k, v, do, lse_l, delta_l)
+
+    # dk/dv are produced per *query* head ([B,Hq,Sk,D]) and group-summed to
+    # KV heads afterwards — the GQA head fan-in.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, sq=sq,
+                          sk=sk, block_q=block_q, block_k=block_k),
+        grid=(B, Hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, kv_head(h), i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, sk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hq, sk_p, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=semantics,
+        interpret=_interpret(),
+    )(q, k, v, do, lse_l, delta_l)
+    if Hq != Hkv:
+        g = Hq // Hkv
+        dk = dk.reshape(B, Hkv, g, sk_p, D).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(B, Hkv, g, sk_p, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, sq, sk):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, sq, sk, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, softmax_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """[B, S, H, D] flash attention with GQA (Hkv | Hq) support.
+
+    Differentiable (custom VJP with flash recomputation).  S and D need not be
+    block-aligned; inputs are zero-padded and masked internally.
+    """
+    B, sq, Hq, D = q.shape
+    _, sk, Hkv, _ = k.shape
+    if Hq % Hkv:
+        raise ValueError(f"q heads {Hq} not a multiple of kv heads {Hkv}")
+    scale = float(softmax_scale) if softmax_scale is not None else D**-0.5
+    block_q = max(16, min(block_q, sq))
+    block_k = max(16, min(block_k, sk))
+
+    qt = _pad_to(_pad_to(q.transpose(0, 2, 1, 3), 2, block_q), 3, 128)
+    kt = _pad_to(_pad_to(k.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
+    vt = _pad_to(_pad_to(v.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
+    o = _flash(qt, kt, vt, bool(causal), scale, block_q, block_k, sq, sk)
+    return o[:, :, :sq, :D].transpose(0, 2, 1, 3)
