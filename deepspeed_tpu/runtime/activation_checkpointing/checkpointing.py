@@ -168,7 +168,8 @@ def non_reentrant_checkpoint(function, *args, **kwargs):
 def checkpoint_wrapper(function, policy=None):
     """Return a remat-wrapped callable (for scan-over-layers use)."""
     cfg = get_policy()
-    jp = policy if policy is not None else cfg.jax_policy()
+    jp = (policy.jax_policy() if isinstance(policy, CheckpointPolicy)
+          else policy if policy is not None else cfg.jax_policy())
     return jax.checkpoint(function, policy=jp)
 
 

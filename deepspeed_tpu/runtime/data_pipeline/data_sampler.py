@@ -51,7 +51,12 @@ class DistributedSampler:
             indices = list(range(self.n))
         if not self.drop_last:
             pad = self.total_size - len(indices)
-            indices += indices[:pad]
+            if pad > 0 and indices:
+                # pad may exceed n (e.g. n=3, replicas=8): cycle the index
+                # list however many times it takes so every rank gets
+                # num_samples entries
+                reps = -(-pad // len(indices)) + 1
+                indices = (indices * reps)[:self.total_size]
         else:
             indices = indices[:self.total_size]
         return iter(indices[self.rank:self.total_size:self.num_replicas])
@@ -114,8 +119,12 @@ class DeepSpeedDataSampler:
         remaining = np.ones(self.total_samples, dtype=bool)
         if self.total_samples < self.global_batch_size:
             return  # not even one full batch (drop_last semantics)
+        # self.batch_step is the *lifetime* counter (curriculum difficulty and
+        # seeds advance across epochs; checkpoint-resumable); the epoch bound
+        # uses its own counter so a second epoch isn't empty.
+        epoch_batches = 0
         while remaining.sum() >= self.global_batch_size and \
-                self.batch_step < len(self):
+                epoch_batches < len(self):
             difficulty = None
             if self.curriculum_scheduler is not None:
                 difficulty = self.curriculum_scheduler.update_difficulty(
@@ -143,6 +152,7 @@ class DeepSpeedDataSampler:
                 batch = pool[:self.global_batch_size]
             remaining[batch] = False
             self.batch_step += 1
+            epoch_batches += 1
             self.consumed_samples += self.global_batch_size
             # per-dp-rank slice (engine path passes dp_size=1 and shards
             # the assembled batch itself)

@@ -97,15 +97,27 @@ def chunked_attention(q, k, v, q_chunk=1024, kv_chunk=1024, causal=True,
             out, lse = carry
             ki, k_blk, v_blk = inputs
             k_start = ki * kv_chunk
+
+            def attend(carry):
+                out, lse = carry
+                if causal:
+                    rows = q_start + jnp.arange(q_chunk)[:, None]
+                    cols = k_start + jnp.arange(kv_chunk)[None, :]
+                    mask = rows >= cols
+                else:
+                    mask = None
+                new_out, new_lse = _chunk_attend(q_blk, k_blk, v_blk,
+                                                 mask=mask,
+                                                 softmax_scale=softmax_scale)
+                return update_out_and_lse(out, lse, new_out, new_lse)
+
             if causal:
-                rows = q_start + jnp.arange(q_chunk)[:, None]
-                cols = k_start + jnp.arange(kv_chunk)[None, :]
-                mask = rows >= cols
+                # Chunks entirely above the diagonal are fully masked: skip
+                # both einsums (halves the O(S²) work at FPDT's scales).
+                live = k_start <= q_start + q_chunk - 1
+                out, lse = jax.lax.cond(live, attend, lambda c: c, (out, lse))
             else:
-                mask = None
-            new_out, new_lse = _chunk_attend(q_blk, k_blk, v_blk, mask=mask,
-                                             softmax_scale=softmax_scale)
-            out, lse = update_out_and_lse(out, lse, new_out, new_lse)
+                out, lse = attend((out, lse))
             return (out, lse), None
 
         init = (jnp.zeros((B, q_chunk, H, D), jnp.float32),

@@ -127,16 +127,33 @@ def _local_block(leaf, dtype=np.float32):
     if len(shards) == 1:
         return np.asarray(shards[0].data, dtype=dtype)
     nd = leaf.ndim
+    # Dedup replicated shards (several local devices may hold the same slice).
+    by_index = {}
+    for s in shards:
+        key = tuple((ix.start or 0, ix.stop if ix.stop is not None else dim)
+                    for ix, dim in zip(s.index, leaf.shape))
+        by_index.setdefault(key, s)
+    shards = list(by_index.values())
     starts = [min((s.index[d].start or 0) for s in shards) for d in range(nd)]
     stops = [max((s.index[d].stop if s.index[d].stop is not None
                   else leaf.shape[d]) for s in shards) for d in range(nd)]
     out = np.zeros([hi - lo for lo, hi in zip(starts, stops)], dtype=dtype)
+    covered = 0
     for s in shards:
         sl = tuple(
             slice((ix.start or 0) - lo,
                   (ix.stop if ix.stop is not None else dim) - lo)
             for ix, lo, dim in zip(s.index, starts, leaf.shape))
         out[sl] = np.asarray(s.data, dtype=dtype)
+        covered += int(np.prod([x.stop - x.start for x in sl]))
+    if covered != out.size:
+        # Non-contiguous local shards (e.g. a 2D mesh ordering giving this
+        # host slices 0 and 2 of 4): the bounding box would contain fabricated
+        # zeros — refuse rather than return garbage.
+        raise ValueError(
+            "local shards do not tile a contiguous block "
+            f"(covered {covered} of {out.size} elements); read the full "
+            "tensor via safe_get_full_fp32_param instead")
     return out
 
 
