@@ -119,11 +119,25 @@ class DeepSpeedEngine:
         # ---------------------------------------------------------- bring-up
         # (reference initialize() :143-146 → init_distributed; :153-162 mesh)
         mc = config.mesh_config
+        zc = config.zero_config
+        # hpZ secondary partition and MiCS shard groups both factor dp into
+        # (outer, inner) — one reshaped mesh serves either.
+        zp_size = (zc.mics_shard_size if zc.mics_shard_size and
+                   zc.mics_shard_size > 1 else zc.zero_hpz_partition_size)
         if not groups.mesh_is_initialized():
             groups.initialize_mesh(
                 pp=mc.pp, dp=None if mc.dp in (-1, None) else mc.dp,
                 sp=mc.sp, tp=mc.tp, ep=mc.ep,
-                zero_partition_size=config.zero_config.zero_hpz_partition_size)
+                zero_partition_size=zp_size)
+        elif zp_size and zp_size > 1 and \
+                groups.get_mesh_state().zero_partition_size != zp_size:
+            # a pre-initialized mesh without the matching dp factoring would
+            # silently drop hpZ/MiCS — fail loudly instead
+            raise ValueError(
+                f"config requests zero partition groups of {zp_size} but the "
+                f"mesh was pre-initialized with zero_partition_size="
+                f"{groups.get_mesh_state().zero_partition_size}; pass "
+                "zero_partition_size to groups.initialize_mesh()")
         dist.init_distributed(config=config)
         self.mesh = groups.get_global_mesh()
         self.dp_world_size = groups._get_data_parallel_world_size()
@@ -166,7 +180,6 @@ class DeepSpeedEngine:
                 "model must be a flax Module or a callable f(params, *inputs)")
 
         # ZeRO partition plan (stage → sharding policy)
-        zc = config.zero_config
         zero_axes = groups.zero_sharding_axes(
             sequence_parallel=self.seq_parallel_world_size > 1)
         self.zero_stage = zc.stage
@@ -179,7 +192,9 @@ class DeepSpeedEngine:
             offload_optimizer=(zc.offload_optimizer is not None
                                and zc.offload_optimizer.device != "none"),
             offload_param=(zc.offload_param is not None
-                           and zc.offload_param.device != "none"))
+                           and zc.offload_param.device != "none"),
+            hpz_mesh=groups.get_mesh_state().hpz_mesh,
+            mics=bool(zc.mics_shard_size and zc.mics_shard_size > 1))
 
         # legacy curriculum learning (reference engine exposes a
         # CurriculumScheduler when "curriculum_learning" is configured)
@@ -357,7 +372,7 @@ class DeepSpeedEngine:
         def map_state(s):
             return jax.tree_util.tree_map_with_path(
                 lambda kp, x: NamedSharding(
-                    self.mesh,
+                    self.plan.state_mesh,
                     self.plan.master_spec(x.shape, path_str(kp))), s)
         return map_state(state_shape)
 
@@ -446,8 +461,19 @@ class DeepSpeedEngine:
         """Build (loss, grads) = value_and_grad over compute params."""
         apply_fn = self._apply_fn
         gas = self.gradient_accumulation_steps()
+        zc = self._config.zero_config
+        if zc.zero_quantized_gradients:
+            # qgZ replaces the GSPMD gradient reduction with a quantized
+            # all-to-all reduce under manual SPMD (zeropp.py).
+            from .zero.zeropp import build_manual_dp_micro
+            return build_manual_dp_micro(self)
+        qw = zc.zero_quantized_weights and self.zero_stage >= 3
 
         def loss_fn(params, scale, inputs):
+            if qw:
+                # qwZ: int8 param all-gather (straight-through bwd)
+                from .zero.zeropp import quantized_weight_gather
+                params = quantized_weight_gather(params, self.plan)
             out = apply_fn(params, *inputs)
             loss = out[0] if isinstance(out, (tuple, list)) else out
             # scale for fp16; divide by GAS (reference backward :2023 scales

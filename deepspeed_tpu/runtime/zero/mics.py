@@ -1,0 +1,52 @@
+"""MiCS — Minimal-Communication Sharding (reference ``runtime/zero/mics.py``).
+
+In the reference, MiCS is a ZeRO-3 subclass (``MiCS_Optimizer``
+``mics.py:472``) that partitions params/grads/optimizer state over a
+*shard group* of ``mics_shard_size`` ranks (instead of all of DP) and
+replicates across groups, trading memory for shorter all-gathers plus a
+hierarchical cross-group gradient all-reduce (``MiCS_Init``).
+
+In the TPU design this is entirely a **sharding policy** (SURVEY.md §7): the
+``dp`` mesh axis is factored as ``dp = zp_outer × zp`` (``utils/groups.py``
+hpz mesh) and ``ZeroPartitionPlan(mics=True)`` shards *all* ZeRO state over
+the inner ``zp`` axis only:
+
+  * param/state all-gathers ride the short intra-group ICI hops — the
+    "minimal communication" part;
+  * gradients are still averaged over full dp: with grads constrained to
+    zp-sharded-but-zp_outer-replicated layouts, GSPMD emits exactly the
+    hierarchical reduce (reduce-scatter within the group, all-reduce across
+    groups) that ``MiCS_Optimizer`` hand-implements.
+
+Config: ``{"zero_optimization": {"stage": 3, "mics_shard_size": N}}`` —
+identical JSON schema to the reference.  ``mics_hierarchical_params_gather``
+is implied (the mesh factoring IS the hierarchy).
+
+``MiCS_Init``/``MiCS_Optimizer`` classes are not needed — params are born in
+their shard-group layout via ``engine.initialize_parameters`` — but thin
+aliases are provided for import parity.
+"""
+
+from .partition import ZeroPartitionPlan
+
+
+def mics_plan(mesh, hpz_mesh, stage=3, **kw):
+    """Build the MiCS sharding policy (engine does this automatically when
+    ``mics_shard_size > 1``)."""
+    return ZeroPartitionPlan(stage=stage, mesh=mesh, hpz_mesh=hpz_mesh,
+                             mics=True, **kw)
+
+
+class MiCS_Init:
+    """Import-parity alias for ``deepspeed.zero.MiCS_Init`` (reference
+    ``mics.py``): a no-op context — partitioned creation happens in
+    ``engine.initialize_parameters`` under the MiCS plan."""
+
+    def __init__(self, *a, **kw):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

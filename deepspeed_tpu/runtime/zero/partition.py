@@ -161,7 +161,8 @@ class ZeroPartitionPlan:
     """
 
     def __init__(self, stage, mesh, zero_axes=("dp", ), min_partition_size=1,
-                 offload_optimizer=False, offload_param=False, tp_rules=None):
+                 offload_optimizer=False, offload_param=False, tp_rules=None,
+                 hpz_mesh=None, mics=False):
         self.stage = stage
         self.mesh = mesh
         self.zero_axes = tuple(a for a in zero_axes if mesh.shape.get(a, 1) >= 1)
@@ -172,6 +173,26 @@ class ZeroPartitionPlan:
         # analog, reference module_inject/auto_tp.py:273) — composed with the
         # ZeRO axes on every state tensor.
         self.tp_rules = tp_rules or {}
+        # hpZ (ZeRO++ secondary partition, reference engine.py:906 + utils/
+        # groups.py:531): *params* shard over only the intra-host "zp" factor
+        # of dp — forward all-gathers ride short ICI hops — while master/grads
+        # stay sharded over full dp.  MiCS (reference runtime/zero/mics.py):
+        # ALL state shards over the "zp" shard group and replicates across
+        # groups; gradients still average over full dp (GSPMD emits the
+        # hierarchical allreduce automatically from the specs).
+        self.param_mesh, self.param_axes = mesh, self.zero_axes
+        self.state_mesh, self.state_axes = mesh, self.zero_axes
+        if hpz_mesh is not None:
+            from ...utils.groups import ZP_AXIS
+            # zp replaces only the dp/ep factor; other ZeRO axes (e.g. "sp"
+            # under Ulysses seq-dp sharding) survive — hpz_mesh carries them.
+            extra = tuple(a for a in self.zero_axes if a not in ("dp", "ep"))
+            zp_axes = (ZP_AXIS, ) + extra
+            if mics:
+                self.param_mesh = self.state_mesh = hpz_mesh
+                self.param_axes = self.state_axes = zp_axes
+            elif stage >= 3:
+                self.param_mesh, self.param_axes = hpz_mesh, zp_axes
 
     # specs -----------------------------------------------------------------
     def _tp_base(self, path, shape=None):
@@ -200,7 +221,7 @@ class ZeroPartitionPlan:
     def param_spec(self, shape, path=None):
         base = self._tp_base(path, shape)
         if self.stage >= 3:
-            return shard_spec(shape, self.mesh, self.zero_axes,
+            return shard_spec(shape, self.param_mesh, self.param_axes,
                               self.min_partition_size, base_spec=base)
         return base if base is not None else P()
 
@@ -208,7 +229,7 @@ class ZeroPartitionPlan:
         """fp32 master weights + optimizer moments."""
         base = self._tp_base(path, shape)
         if self.stage >= 1:
-            return shard_spec(shape, self.mesh, self.zero_axes,
+            return shard_spec(shape, self.state_mesh, self.state_axes,
                               self.min_partition_size, base_spec=base)
         return base if base is not None else P()
 
@@ -218,7 +239,7 @@ class ZeroPartitionPlan:
         psum to reduce-scatter)."""
         base = self._tp_base(path, shape)
         if self.stage >= 2:
-            return shard_spec(shape, self.mesh, self.zero_axes,
+            return shard_spec(shape, self.state_mesh, self.state_axes,
                               self.min_partition_size, base_spec=base)
         return base if base is not None else P()
 
@@ -229,29 +250,33 @@ class ZeroPartitionPlan:
         # "pinned-host offload → memory kinds").
         return "pinned_host" if offload else None
 
-    def _sharding(self, spec, offload=False):
+    def _sharding(self, spec, offload=False, mesh=None):
+        mesh = mesh if mesh is not None else self.mesh
         kind = self._memory_kind(offload)
         if kind is not None:
             try:
-                return NamedSharding(self.mesh, spec, memory_kind=kind)
+                return NamedSharding(mesh, spec, memory_kind=kind)
             except Exception:
-                return NamedSharding(self.mesh, spec)
-        return NamedSharding(self.mesh, spec)
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, spec)
 
     def param_shardings(self, params):
         return jax.tree_util.tree_map_with_path(
             lambda kp, x: self._sharding(
                 self.param_spec(x.shape, path_str(kp)),
-                offload=self.offload_param and self.stage >= 3), params)
+                offload=self.offload_param and self.stage >= 3,
+                mesh=self.param_mesh), params)
 
     def master_shardings(self, params):
         return jax.tree_util.tree_map_with_path(
             lambda kp, x: self._sharding(self.master_spec(x.shape, path_str(kp)),
-                                         offload=self.offload_optimizer), params)
+                                         offload=self.offload_optimizer,
+                                         mesh=self.state_mesh), params)
 
     def grad_shardings(self, params):
         return jax.tree_util.tree_map_with_path(
-            lambda kp, x: self._sharding(self.grad_spec(x.shape, path_str(kp))),
+            lambda kp, x: self._sharding(self.grad_spec(x.shape, path_str(kp)),
+                                         mesh=self.state_mesh),
             params)
 
     def param_specs(self, params):

@@ -1,0 +1,261 @@
+"""ZeRO++ — quantized ZeRO communication (qwZ / qgZ / hpZ).
+
+TPU-native re-design of the reference's ZeRO++ stack (wiring at
+``runtime/zero/stage3.py:123`` + ``runtime/engine.py:906-913``, kernels in
+``csrc/quantization``, collectives in
+``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``):
+
+* **qwZ** (quantized weight all-gather): the stage-3 forward/backward param
+  all-gather moves int8 + per-group scales instead of bf16 — ~2× gather
+  traffic reduction.  Implemented as a ``shard_map`` wrapper around each
+  dp-sharded leaf: quantize local shard → ``lax.all_gather`` the int8 payload
+  → dequantize → reassemble.  Composes with TP sharding (only the ZeRO axes
+  are gathered).
+* **qgZ** (quantized gradient reduce): gradients are reduced with a single
+  quantized all-to-all + local sum (int8 payload, fp32 accumulation).  The
+  reference needs a *hierarchical* 2-hop (intra-node all-to-all, dequant-
+  reduce, inter-node all-to-all with ``swizzled_quantize``) because NCCL
+  all-to-all crosses nodes at full fan-out; on a TPU torus the single
+  mesh-axis all-to-all already rides ICI neighbor links, so the 1-hop scheme
+  gets the same 4× volume reduction with ONE quantization error instead of
+  two.
+* **hpZ** (secondary partition) is a *sharding policy*, not a collective:
+  ``ZeroPartitionPlan(hpz_mesh=...)`` shards params over the intra-host "zp"
+  mesh factor only (see ``partition.py``).
+
+qgZ requires taking over the gradient reduction from GSPMD, so the engine
+switches its micro-step to a manual-SPMD (``shard_map``) variant — see
+:func:`build_manual_dp_micro`.  That path supports pure-DP meshes (ZeRO++ is
+a DP-communication optimization; reference scope is the same).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
+
+DEFAULT_GROUP_SIZE = 2048
+
+
+def _zero_dim(spec, zero_axes):
+    """Locate the dim carrying ZeRO axes.  Returns (dim, axes_present) or
+    (None, ())."""
+    for i, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry, )
+        present = tuple(a for a in names if a in zero_axes)
+        if present:
+            return i, present
+    return None, ()
+
+
+def _strip_axes(spec, dim, axes):
+    """Remove ``axes`` from ``spec[dim]`` (gathered result keeps e.g. tp)."""
+    entry = spec[dim]
+    names = entry if isinstance(entry, tuple) else (entry, )
+    kept = tuple(a for a in names if a not in axes)
+    new = list(spec)
+    new[dim] = kept if len(kept) > 1 else (kept[0] if kept else None)
+    return P(*new)
+
+
+def quantized_all_gather(x, ax_names, dim, num_bits=8,
+                         group_size=DEFAULT_GROUP_SIZE):
+    """Inside-shard_map: int8-gather the local tile along mesh axes
+    ``ax_names``, reassembling the full dim in axis-index order (matches GSPMD
+    tiling order).  The wire payload is int8 values + one f32 scale per
+    ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu)."""
+    q, s, meta = quantize_blockwise(x, num_bits=num_bits,
+                                    group_size=group_size, use_pallas=False)
+    qg = jax.lax.all_gather(q, ax_names)
+    sg = jax.lax.all_gather(s, ax_names)
+    parts = jax.vmap(lambda qq, ss: dequantize_blockwise(
+        qq, ss, meta, use_pallas=False))(qg, sg)
+    return jnp.concatenate(list(parts), axis=dim)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _qdq_all_gather_st(x, ax_names, dim, num_bits, group_size):
+    """Straight-through quantized gather: forward is int8 gather; backward is
+    the exact VJP of a plain all-gather (reduce-scatter of the cotangent) —
+    the quantization rounding must not zero the gradient."""
+    return quantized_all_gather(x, ax_names, dim, num_bits, group_size)
+
+
+def _qdq_fwd(x, ax_names, dim, num_bits, group_size):
+    return _qdq_all_gather_st(x, ax_names, dim, num_bits, group_size), None
+
+
+def _qdq_bwd(ax_names, dim, num_bits, group_size, _, dy):
+    return (jax.lax.psum_scatter(dy, ax_names, scatter_dimension=dim,
+                                 tiled=True), )
+
+
+_qdq_all_gather_st.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def quantized_weight_gather(params, plan, num_bits=8,
+                            group_size=DEFAULT_GROUP_SIZE):
+    """qwZ in GSPMD mode: explicitly gather every ZeRO-sharded param with an
+    int8 payload; XLA sees already-replicated (over dp) values and inserts no
+    further gather.  Differentiable (straight-through; backward is the
+    standard reduce-scatter).  Usable both outside and inside ``jax.jit``."""
+    from .partition import path_str
+    mesh = plan.param_mesh
+
+    def gather_leaf(kp, x):
+        spec = plan.param_spec(x.shape, path_str(kp))
+        dim, axes = _zero_dim(spec, plan.param_axes)
+        if dim is None:
+            return x
+        out_spec = _strip_axes(spec, dim, axes)
+        # positional call: custom_vjp rejects kwargs for nondiff argnums
+        fn = shard_map(
+            lambda t: _qdq_all_gather_st(t, axes, dim, num_bits, group_size),
+            mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
+        return fn(x)
+
+    return jax.tree_util.tree_map_with_path(gather_leaf, params)
+
+
+def all_to_all_quant_reduce(g, ax_names, dim, n, num_bits=8,
+                            group_size=DEFAULT_GROUP_SIZE):
+    """Inside-shard_map: quantized reduce-scatter of a (replicated) gradient:
+    split along ``dim`` into ``n`` partitions, int8 all-to-all so rank i
+    receives every rank's partition i, dequantize and average in fp32.
+    Returns this rank's partition (reference ``all_to_all_quant_reduce``,
+    runtime/comm/coalesced_collectives.py:31 — single-hop on ICI, see module
+    docstring)."""
+    chunks = jnp.stack(jnp.split(g, n, axis=dim))  # [n, ...chunk]
+
+    def q_one(c):
+        return quantize_blockwise(c, num_bits=num_bits, group_size=group_size,
+                                  use_pallas=False)[:2]
+
+    meta_shape = chunks.shape[1:]
+    _, _, meta = quantize_blockwise(chunks[0], num_bits=num_bits,
+                                    group_size=group_size, use_pallas=False)
+    q, s = jax.vmap(q_one)(chunks)
+    qx = jax.lax.all_to_all(q, ax_names, split_axis=0, concat_axis=0)
+    sx = jax.lax.all_to_all(s, ax_names, split_axis=0, concat_axis=0)
+    parts = jax.vmap(lambda qq, ss: dequantize_blockwise(
+        qq, ss, (meta_shape, jnp.float32, meta[2]), use_pallas=False))(qx, sx)
+    return jnp.sum(parts.astype(jnp.float32), axis=0) / n
+
+
+def build_manual_dp_micro(engine):
+    """Manual-SPMD micro-step for the qgZ path.
+
+    The GSPMD micro-step lets XLA insert the DP gradient reduction (bf16/f32);
+    to quantize that traffic we compute grads per-shard under ``shard_map``
+    and reduce them ourselves:
+
+        per device:  local loss/grad on the local batch shard
+        qwZ (opt.):  int8 param all-gather for stage-3 sharded params
+        qgZ:         int8 all-to-all reduce-scatter into the master partition
+
+    Returns ``micro(params, scale, inputs) -> (loss, grads)`` with grads in
+    the master (ZeRO) sharding — drop-in for the engine's compiled micro fn.
+    """
+    plan = engine.plan
+    zc = engine._config.zero_config
+    gas = engine.gradient_accumulation_steps()
+    apply_fn = engine._apply_fn
+    grad_dtype = engine.grad_accum_dtype
+    if engine.mp_world_size > 1 or engine.seq_parallel_world_size > 1 or \
+            engine.pp_world_size > 1:
+        raise ValueError(
+            "zero_quantized_gradients requires a pure data-parallel mesh "
+            "(tp=sp=pp=1) — it replaces the DP gradient reduction")
+    # With hpZ/MiCS the manual step runs over the reshaped hpz mesh, whose
+    # (zp_outer, zp) axes tile the same device order as (dp, ep) on the
+    # global mesh — full-dp specs are translated axis-for-axis.
+    hpz_active = (plan.param_mesh is not plan.mesh or
+                  plan.state_mesh is not plan.mesh)
+    if hpz_active:
+        from ...utils.groups import ZP_AXIS, ZP_OUTER_AXIS
+        mesh = plan.param_mesh
+        dp_axes = (ZP_OUTER_AXIS, ZP_AXIS)
+
+        def _translate(spec):
+            out = []
+            for entry in spec:
+                names = (entry if isinstance(entry, tuple) else
+                         (entry, )) if entry is not None else ()
+                if any(a in ("dp", "ep") for a in names):
+                    names = tuple(a for a in names
+                                  if a not in ("dp", "ep")) + dp_axes
+                out.append(names if len(names) > 1 else
+                           (names[0] if names else None))
+            return P(*out)
+    else:
+        mesh = plan.mesh
+        dp_axes = plan.zero_axes
+        _translate = lambda spec: spec
+    qw = zc.zero_quantized_weights
+
+    from .partition import path_str
+
+    def loss_fn(params, scale, inputs):
+        out = apply_fn(params, *inputs)
+        loss = out[0] if isinstance(out, (tuple, list)) else out
+        return loss.astype(jnp.float32) * scale / gas, loss
+
+    def micro(params, scale, inputs):
+        param_specs = jax.tree_util.tree_map(_translate,
+                                             plan.param_specs(params),
+                                             is_leaf=lambda x: isinstance(
+                                                 x, P))
+        master_specs = jax.tree_util.tree_map(_translate,
+                                              plan.master_specs(params),
+                                              is_leaf=lambda x: isinstance(
+                                                  x, P))
+        batch_specs = tuple(
+            P(*([dp_axes] + [None] * (x.ndim - 1))) for x in inputs)
+
+        def body(params, inputs):
+            # stage-3: reassemble full params from local shards (int8 when qwZ)
+            def gather_leaf(kp, x):
+                spec = plan.param_spec(x.shape, path_str(kp))
+                dim, axes = _zero_dim(spec, plan.param_axes)
+                if dim is None:
+                    return x
+                if qw:
+                    return quantized_all_gather(x, axes, dim)
+                return jax.lax.all_gather(x, axes, axis=dim, tiled=True)
+
+            full = jax.tree_util.tree_map_with_path(gather_leaf, params)
+            (_, loss), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(full, scale, inputs)
+            loss = jax.lax.pmean(loss, dp_axes)
+
+            def reduce_leaf(kp, g):
+                # translated spec lives in manual-mode axis space (dp_axes ∪
+                # zp), so searching dp_axes covers plain/hpZ/MiCS alike
+                spec = _translate(plan.master_spec(g.shape, path_str(kp)))
+                dim, axes = _zero_dim(spec, dp_axes)
+                if dim is None:
+                    return jax.lax.pmean(g, dp_axes).astype(grad_dtype)
+                n = 1
+                for a in axes:
+                    n *= mesh.shape[a]
+                out = all_to_all_quant_reduce(g, axes, dim, n)
+                # average over any remaining dp axes not in this dim
+                rest = tuple(a for a in dp_axes if a not in axes)
+                if rest:
+                    out = jax.lax.pmean(out, rest)
+                return out.astype(grad_dtype)
+
+            grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+            return loss, grads
+
+        fn = shard_map(body, mesh=mesh, in_specs=(param_specs, batch_specs),
+                       out_specs=(P(), master_specs), check_vma=False)
+        return fn(params, inputs)
+
+    return micro
