@@ -1,0 +1,7 @@
+"""Compressed communication backends (reference ``deepspeed/runtime/comm/``:
+``nccl.py``/``mpi.py``/``compressed.py`` 1-bit backends + ``coalesced_
+collectives.py`` quantized collectives — the quantized ZeRO++ collectives
+live in ``runtime/zero/zeropp.py``)."""
+
+from .compressed import (CompressedBackend, compressed_allreduce, pack_signs,
+                         unpack_signs)

@@ -1,0 +1,153 @@
+"""Error-feedback 1-bit compressed allreduce (reference
+``runtime/comm/nccl.py:51 compressed_allreduce`` / ``compressed.py:13
+CompressedBackend`` + the packbits native op ``csrc/xpu/packbits``).
+
+The 1-bit optimizers communicate the *sign* of the (error-compensated)
+momentum plus one fp32 scale per tensor — 1/32 the allreduce volume — with
+local error feedback so the quantization noise is re-injected next step
+(Bernstein et al. signSGD-with-majority / 1-bit Adam).
+
+Wire scheme (2-stage, like the reference):
+  stage 1: each worker packs sign bits (8/byte) and all-to-alls chunk j to
+           worker j with its scale; worker j decodes and averages its chunk
+           ("server" role), carrying a server-side error term.
+  stage 2: each worker re-compresses its averaged chunk and all-gathers —
+           every worker ends with the identical averaged tensor.
+
+Everything is axis-name collectives, so it runs inside ``shard_map`` over the
+dp mesh axes (SPMD) — no NCCL/MPI backend objects needed; ``CompressedBackend``
+is a thin parity shim exposing the reference's class API.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_POW2 = (1 << np.arange(8)).astype(np.uint8)  # bit i → 2^i
+
+
+def pack_signs(bits):
+    """bool[k*8] → uint8[k] (packbits; bit i of byte j = bits[8j+i])."""
+    b = bits.reshape(-1, 8).astype(jnp.uint8)
+    return (b * _POW2).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed):
+    """uint8[k] → float[k*8] of ±1."""
+    bits = (packed[:, None] >> np.arange(8).astype(np.uint8)) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def _l2(x):
+    return jnp.sqrt(jnp.sum(x.astype(jnp.float32)**2))
+
+
+def compressed_allreduce(x, worker_error, server_error, ax_names, n):
+    """Inside-shard_map 1-bit averaged allreduce with error feedback.
+
+    Args:
+      x: local tensor (any shape); all workers contribute, result is the
+         (approximate) mean across the ``ax_names`` mesh axes.
+      worker_error: f32[padded_size] per-worker compression residual.
+      server_error: f32[padded_size // n] per-worker chunk residual.
+      ax_names: dp mesh axis names; n: their total size.
+
+    Returns ``(avg, new_worker_error, new_server_error)``; avg has x's
+    shape/dtype, identical on every worker.  State sizes come from
+    :func:`error_shapes`.
+    """
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    padded = worker_error.shape[0]
+    flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+
+    # ---- worker compression
+    corrected = flat + worker_error
+    scale = _l2(corrected) / jnp.sqrt(jnp.float32(padded))
+    signs = corrected >= 0
+    new_worker_error = corrected - scale * (signs.astype(jnp.float32) * 2 - 1)
+    packed = pack_signs(signs).reshape(n, -1)  # [n, chunk/8]
+
+    # ---- exchange: chunk j → worker j; scales to everyone
+    recv = jax.lax.all_to_all(packed, ax_names, split_axis=0, concat_axis=0)
+    scales = jax.lax.all_gather(scale, ax_names)  # [n]
+    decoded = jax.vmap(unpack_signs)(recv) * scales[:, None]  # [n, chunk]
+    chunk_avg = jnp.mean(decoded, axis=0)
+
+    # ---- server compression of my averaged chunk
+    corrected2 = chunk_avg + server_error
+    scale2 = _l2(corrected2) / jnp.sqrt(jnp.float32(corrected2.shape[0]))
+    signs2 = corrected2 >= 0
+    new_server_error = corrected2 - scale2 * (
+        signs2.astype(jnp.float32) * 2 - 1)
+    packed2 = pack_signs(signs2)
+
+    # ---- gather: every worker reconstructs the full averaged tensor
+    g_p = jax.lax.all_gather(packed2, ax_names)     # [n, chunk/8]
+    g_s = jax.lax.all_gather(scale2, ax_names)      # [n]
+    full = (jax.vmap(unpack_signs)(g_p) * g_s[:, None]).reshape(-1)
+    out = full[:int(np.prod(shape, dtype=np.int64))].reshape(shape)
+    return out.astype(dtype), new_worker_error, new_server_error
+
+
+def error_shapes(numel, n):
+    """(worker_error_size, server_error_size): numel padded so each of the n
+    chunks holds a whole number of bytes of sign bits."""
+    chunk = -(-numel // n)
+    chunk += (-chunk) % 8
+    return chunk * n, chunk
+
+
+class CompressedBackend:
+    """Parity shim for reference ``runtime/comm/compressed.py:13`` — the
+    functional collective above is the real implementation; this class holds
+    per-tensor error state for library users driving it from the host.
+
+    ``compressed_allreduce(x)`` takes the per-worker contributions as one
+    global array with a leading worker axis ``[n, *shape]`` (sharded or not)
+    and returns the error-compensated mean — the SPMD analog of every rank
+    passing its local tensor."""
+
+    def __init__(self, ax_names=None, mesh=None):
+        from jax.sharding import Mesh, PartitionSpec as P
+        if mesh is None:
+            from ...utils import groups
+            mesh = groups.get_global_mesh()
+            if ax_names is None:
+                ax_names = tuple(a for a in ("dp", "ep")
+                                 if mesh.shape.get(a, 1) > 1)
+        self.mesh = mesh
+        self.ax_names = tuple(ax_names)
+        self.n = 1
+        for a in self.ax_names:
+            self.n *= mesh.shape[a]
+        self._errors = {}
+        self._P = P
+
+    def compressed_allreduce(self, x_stacked, key=0):
+        from jax import shard_map
+        P = self._P
+        n = self.n
+        numel = int(np.prod(x_stacked.shape[1:], dtype=np.int64))
+        we_size, se_size = error_shapes(numel, n)
+        we, se = self._errors.get(
+            key, (jnp.zeros((n, we_size), jnp.float32),
+                  jnp.zeros((n, se_size), jnp.float32)))
+
+        def body(xl, wel, sel):
+            out, w2, s2 = compressed_allreduce(xl[0], wel[0], sel[0],
+                                               self.ax_names, n)
+            return out[None], w2[None], s2[None]
+
+        nd = x_stacked.ndim - 1
+        fn = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(self.ax_names, *([None] * nd)),
+                      P(self.ax_names, None), P(self.ax_names, None)),
+            out_specs=(P(self.ax_names, *([None] * nd)),
+                       P(self.ax_names, None), P(self.ax_names, None)),
+            check_vma=False)
+        out, we, se = fn(x_stacked, we, se)
+        self._errors[key] = (we, se)
+        return out[0]

@@ -122,6 +122,13 @@ class DeepSpeedEngine:
         zc = config.zero_config
         # hpZ secondary partition and MiCS shard groups both factor dp into
         # (outer, inner) — one reshaped mesh serves either.
+        if zc.mics_shard_size and zc.mics_shard_size > 1 and \
+                zc.zero_hpz_partition_size > 1 and \
+                zc.zero_hpz_partition_size != zc.mics_shard_size:
+            raise ValueError(
+                f"mics_shard_size={zc.mics_shard_size} and "
+                f"zero_hpz_partition_size={zc.zero_hpz_partition_size} are "
+                "mutually exclusive shard-group factorings")
         zp_size = (zc.mics_shard_size if zc.mics_shard_size and
                    zc.mics_shard_size > 1 else zc.zero_hpz_partition_size)
         if not groups.mesh_is_initialized():
@@ -193,7 +200,10 @@ class DeepSpeedEngine:
                                and zc.offload_optimizer.device != "none"),
             offload_param=(zc.offload_param is not None
                            and zc.offload_param.device != "none"),
-            hpz_mesh=groups.get_mesh_state().hpz_mesh,
+            # only when the config asked for it — a pre-initialized mesh may
+            # carry an hpz factoring this engine did not request
+            hpz_mesh=(groups.get_mesh_state().hpz_mesh
+                      if zp_size and zp_size > 1 else None),
             mics=bool(zc.mics_shard_size and zc.mics_shard_size > 1))
 
         # legacy curriculum learning (reference engine exposes a
@@ -303,6 +313,8 @@ class DeepSpeedEngine:
         from ..ops.adam import fused_adam
         from ..ops.lamb import fused_lamb
         from ..ops.lion import fused_lion, sgd
+        from .config import (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+                             ZERO_ONE_ADAM_OPTIMIZER)
 
         cfg = self._config
         lr_fn = None
@@ -310,6 +322,24 @@ class DeepSpeedEngine:
             sched = get_lr_scheduler(cfg.scheduler_name, cfg.scheduler_params)
             lr_fn = sched.get_lr
             self._sched_for_lr = sched
+
+        self._onebit_opt = None
+        onebit_map = {}
+        try:
+            from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+            onebit_map = {ONEBIT_ADAM_OPTIMIZER: OnebitAdam,
+                          ONEBIT_LAMB_OPTIMIZER: OnebitLamb,
+                          ZERO_ONE_ADAM_OPTIMIZER: ZeroOneAdam}
+        except ImportError:
+            pass
+        if cfg.optimizer_name in onebit_map and client_optimizer is None:
+            p = dict(cfg.optimizer_params or {})
+            self._onebit_opt = onebit_map[cfg.optimizer_name](lr_fn=lr_fn, **p)
+            self._grad_transform = None
+            self.optimizer = _OptimizerFacade(self)
+            if self.params is not None:
+                self._init_onebit_state()
+            return
 
         if client_optimizer is not None:
             self._grad_transform = client_optimizer
@@ -355,6 +385,26 @@ class DeepSpeedEngine:
             self.opt_state = jax.jit(
                 self._grad_transform.init,
                 out_shardings=self._opt_state_shardings(target))(target)
+
+    def _init_onebit_state(self):
+        """Place the 1-bit optimizer state: moments replicated, per-worker
+        error buffers sharded over dp (fp16/onebit/common.py layout)."""
+        from .fp16.onebit.common import _dp_axes
+        axes, mesh = _dp_axes(self)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        target = self.master if self.master is not None else self.params
+        state = self._onebit_opt.init(target, max(1, n))
+        rep = NamedSharding(mesh, P())
+        err = NamedSharding(mesh, P(axes if axes else None, None))
+        place = lambda t, s: jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, s), t)
+        self.opt_state = state._replace(
+            mu=place(state.mu, rep), nu=place(state.nu, rep),
+            worker_error=place(state.worker_error, err),
+            server_error=place(state.server_error, err),
+            extra=place(state.extra, rep))
 
     def _opt_state_shardings(self, target):
         """Optimizer moments shard like the master weights; scalars replicated."""
@@ -461,6 +511,9 @@ class DeepSpeedEngine:
         """Build (loss, grads) = value_and_grad over compute params."""
         apply_fn = self._apply_fn
         gas = self.gradient_accumulation_steps()
+        if self._onebit_opt is not None:
+            # 1-bit optimizers consume *unreduced* per-worker grads
+            return self._onebit_opt.build_micro(self)
         zc = self._config.zero_config
         if zc.zero_quantized_gradients:
             # qgZ replaces the GSPMD gradient reduction with a quantized
@@ -468,18 +521,14 @@ class DeepSpeedEngine:
             from .zero.zeropp import build_manual_dp_micro
             return build_manual_dp_micro(self)
         qw = zc.zero_quantized_weights and self.zero_stage >= 3
-
-        def loss_fn(params, scale, inputs):
-            if qw:
-                # qwZ: int8 param all-gather (straight-through bwd)
-                from .zero.zeropp import quantized_weight_gather
-                params = quantized_weight_gather(params, self.plan)
-            out = apply_fn(params, *inputs)
-            loss = out[0] if isinstance(out, (tuple, list)) else out
-            # scale for fp16; divide by GAS (reference backward :2023 scales
-            # loss by 1/gas before autograd)
-            scaled = loss.astype(jnp.float32) * scale / gas
-            return scaled, loss
+        if qw:
+            # qwZ: int8 param all-gather (straight-through bwd)
+            from .zero.zeropp import quantized_weight_gather
+            inner = apply_fn
+            apply_fn = lambda params, *inputs: inner(
+                quantized_weight_gather(params, self.plan), *inputs)
+        from .utils import make_scaled_loss_fn
+        loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
         def micro(params, scale, inputs):
             (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -507,6 +556,8 @@ class DeepSpeedEngine:
 
     def _apply_update_fn(self):
         """The boundary step: unscale, overflow, clip, optimizer, recast."""
+        if self._onebit_opt is not None:
+            return self._onebit_opt.build_apply(self)
         plan = self.plan
         cfg = self._config
         grad_clip = cfg.gradient_clipping

@@ -1,0 +1,4 @@
+"""fp16 runtime (reference ``deepspeed/runtime/fp16/``): loss scaling lives in
+``runtime/loss_scaler.py``; the flat-group FP16_Optimizer machinery is
+subsumed by the engine's jitted apply step (``engine.py``); this package holds
+the 1-bit communication-compressed optimizers."""

@@ -1,0 +1,9 @@
+"""1-bit optimizers (reference ``runtime/fp16/onebit/``): OnebitAdam
+(``adam.py:14``), OnebitLamb (``lamb.py:447``), ZeroOneAdam (``zoadam.py:363``)
+— Adam/LAMB variants whose momentum is all-reduced with error-feedback 1-bit
+sign compression (``runtime/comm/compressed.py``) after a full-precision
+warmup phase."""
+
+from .adam import OnebitAdam
+from .lamb import OnebitLamb
+from .zoadam import ZeroOneAdam

@@ -1,0 +1,211 @@
+"""Shared machinery for the 1-bit optimizers.
+
+The 1-bit family needs *unreduced, per-worker* gradients (the whole point is
+replacing the dense gradient/momentum allreduce), so these optimizers swap
+both engine compiled functions:
+
+  * micro-step: manual-SPMD (``shard_map``) value_and_grad whose output is
+    the stack of per-worker local gradients ``[n_dp, *shape]`` (sharded over
+    dp) — no reduction;
+  * apply-step: one ``shard_map`` region doing warmup (exact pmean) or
+    compressed (1-bit error-feedback momentum allreduce) updates per leaf.
+
+Reference wiring: DeepSpeed disables ``enable_backward_allreduce`` when a
+1-bit optimizer is configured and the optimizer's ``step`` drives the
+compressed backend (``runtime/fp16/onebit/adam.py:14`` + engine).  Scope:
+pure data-parallel meshes, ZeRO stage 0 (reference 1-bit optimizers are
+likewise incompatible with ZeRO sharding).
+"""
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...comm.compressed import error_shapes
+
+
+class OnebitState(NamedTuple):
+    count: jnp.ndarray
+    mu: object          # momentum, replicated
+    nu: object          # variance, replicated
+    worker_error: object  # per-leaf [n, we_size], sharded over dp
+    server_error: object  # per-leaf [n, se_size], sharded over dp
+    extra: object       # optimizer-specific per-leaf scalars (e.g. lamb coeff)
+
+
+def _dp_axes(engine):
+    from ....utils import groups
+    mesh = engine.plan.mesh
+    return tuple(a for a in groups.dp_axes() if mesh.shape.get(a, 1) > 1), mesh
+
+
+def check_compatible(engine, name):
+    if engine.zero_stage > 0:
+        raise ValueError(f"{name} is incompatible with ZeRO stages > 0 "
+                         "(reference 1-bit optimizers have the same scope)")
+    if engine.mp_world_size > 1 or engine.seq_parallel_world_size > 1 or \
+            engine.pp_world_size > 1:
+        raise ValueError(f"{name} requires a pure data-parallel mesh")
+
+
+def init_state(params, n, extra_fn=None):
+    zeros_like_f32 = lambda p: jnp.zeros(p.shape, jnp.float32)
+    mu = jax.tree_util.tree_map(zeros_like_f32, params)
+    nu = jax.tree_util.tree_map(zeros_like_f32, params)
+
+    def err_zeros(p, which):
+        sizes = error_shapes(int(np.prod(p.shape, dtype=np.int64)), n)
+        return jnp.zeros((n, sizes[which]), jnp.float32)
+
+    we = jax.tree_util.tree_map(lambda p: err_zeros(p, 0), params)
+    se = jax.tree_util.tree_map(lambda p: err_zeros(p, 1), params)
+    extra = (jax.tree_util.tree_map(extra_fn, params)
+             if extra_fn is not None else
+             jax.tree_util.tree_map(lambda p: jnp.zeros((), jnp.float32),
+                                    params))
+    return OnebitState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu,
+                       worker_error=we, server_error=se, extra=extra)
+
+
+def build_local_grad_micro(engine):
+    """Manual micro returning per-worker local grads stacked on axis 0."""
+    plan = engine.plan
+    axes, mesh = _dp_axes(engine)
+    gas = engine.gradient_accumulation_steps()
+    apply_fn = engine._apply_fn
+    grad_dtype = engine.grad_accum_dtype
+
+    from ...utils import make_scaled_loss_fn
+    loss_fn = make_scaled_loss_fn(apply_fn, gas)
+
+    def micro(params, scale, inputs):
+        batch_specs = tuple(
+            P(*([axes] + [None] * (x.ndim - 1))) for x in inputs)
+        param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+
+        def body(params, inputs):
+            (_, loss), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, scale, inputs)
+            loss = jax.lax.pmean(loss, axes)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(grad_dtype)[None], grads)
+            return loss, grads
+
+        grad_specs = jax.tree_util.tree_map(
+            lambda p: P(*([axes] + [None] * p.ndim)), params)
+        fn = shard_map(body, mesh=mesh, in_specs=(param_specs, batch_specs),
+                       out_specs=(P(), grad_specs), check_vma=False)
+        return fn(params, inputs)
+
+    return micro
+
+
+def build_onebit_apply(engine, leaf_update):
+    """Shared apply-step: unscale, overflow check, per-leaf ``leaf_update``
+    (the optimizer math, running inside shard_map with dp collectives
+    available), overflow-skip select, loss-scale update.
+
+    ``leaf_update(g, p32, m, v, we, se, extra, count, lr) ->
+        (p32', m', v', we', se', extra')``
+    """
+    axes, mesh = _dp_axes(engine)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    scaler = engine.loss_scaler
+    fp16 = engine._config.fp16_enabled
+    compute_dtype = engine.compute_dtype
+    opt = engine._onebit_opt
+    lr_fn = getattr(opt, "lr_fn", None)
+
+    def apply(params, master, opt_state, grad_acc, scale_state):
+        has_master = master is not None
+        target = master if has_master else params
+        count = opt_state.count + 1
+        lr = lr_fn(count) if lr_fn is not None else opt.lr
+
+        p_specs = jax.tree_util.tree_map(lambda _: P(), target)
+        g_specs = jax.tree_util.tree_map(
+            lambda p: P(*([axes] + [None] * p.ndim)), target)
+        e_specs = jax.tree_util.tree_map(lambda _: P(axes, None), target)
+        x_specs = jax.tree_util.tree_map(lambda _: P(), opt_state.extra)
+
+        def body(target, mu, nu, we, se, extra, grads, scale):
+            inv = 1.0 / scale
+            flat_t, treedef = jax.tree_util.tree_flatten(target)
+            flat_m = treedef.flatten_up_to(mu)
+            flat_v = treedef.flatten_up_to(nu)
+            flat_we = treedef.flatten_up_to(we)
+            flat_se = treedef.flatten_up_to(se)
+            flat_x = treedef.flatten_up_to(extra)
+            flat_g = treedef.flatten_up_to(grads)
+
+            gs = [g[0].astype(jnp.float32) * inv for g in flat_g]
+            if fp16:
+                ofl = sum(jnp.sum(~jnp.isfinite(g)) for g in gs) > 0
+                overflow = jax.lax.pmax(ofl.astype(jnp.float32), axes) > 0
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
+
+            outs = [
+                leaf_update(g, p.astype(jnp.float32), m, v, w[0], s[0], x,
+                            count, lr, axes, n)
+                for g, p, m, v, w, s, x in zip(gs, flat_t, flat_m, flat_v,
+                                               flat_we, flat_se, flat_x)
+            ]
+
+            def pick(new, old):
+                return jnp.where(overflow, old, new)
+
+            new_t = treedef.unflatten(
+                [pick(o[0], p.astype(jnp.float32)).astype(p.dtype)
+                 for o, p in zip(outs, flat_t)])
+            new_m = treedef.unflatten(
+                [pick(o[1], m) for o, m in zip(outs, flat_m)])
+            new_v = treedef.unflatten(
+                [pick(o[2], v) for o, v in zip(outs, flat_v)])
+            new_we = treedef.unflatten(
+                [pick(o[3], w[0])[None] for o, w in zip(outs, flat_we)])
+            new_se = treedef.unflatten(
+                [pick(o[4], s[0])[None] for o, s in zip(outs, flat_se)])
+            new_x = treedef.unflatten(
+                [pick(o[5], x) for o, x in zip(outs, flat_x)])
+            # post-reduction momentum norm (the exact grad norm would need a
+            # dense allreduce, which 1-bit exists to avoid)
+            gnorm = jnp.sqrt(
+                sum(jnp.sum(jnp.square(m)) for m in
+                    jax.tree_util.tree_leaves(new_m)))
+            return new_t, new_m, new_v, new_we, new_se, new_x, overflow, gnorm
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(p_specs, p_specs, p_specs, e_specs, e_specs, x_specs,
+                      g_specs, P()),
+            out_specs=(p_specs, p_specs, p_specs, e_specs, e_specs, x_specs,
+                       P(), P()),
+            check_vma=False)
+        (new_target, new_m, new_v, new_we, new_se, new_x, overflow,
+         gnorm) = fn(target, opt_state.mu, opt_state.nu,
+                     opt_state.worker_error, opt_state.server_error,
+                     opt_state.extra, grad_acc, scale_state.scale)
+
+        new_opt = OnebitState(
+            count=jnp.where(overflow, opt_state.count, count),
+            mu=new_m, nu=new_v, worker_error=new_we, server_error=new_se,
+            extra=new_x)
+        if has_master:
+            new_master = new_target
+            new_params = jax.tree_util.tree_map(
+                lambda m_: m_.astype(compute_dtype), new_master)
+        else:
+            new_master = None
+            new_params = new_target
+        new_scale = scaler.update(scale_state, overflow)
+        return new_params, new_master, new_opt, new_scale, overflow, gnorm
+
+    return apply

@@ -1,0 +1,75 @@
+"""OnebitLamb (reference ``runtime/fp16/onebit/lamb.py:447``).
+
+Warmup: exact LAMB (per-tensor trust ratio from ‖p‖/‖u‖).  Compression
+phase: momentum goes through the 1-bit error-feedback allreduce and the
+trust ratio is *frozen* at its last warmup value (the reference freezes
+``scaling_coeff`` per layer at ``freeze_step`` because the post-compression
+momentum magnitude is no longer comparable) — stored in the state's per-leaf
+``extra`` scalar.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce
+from .common import (build_local_grad_micro, build_onebit_apply,
+                     check_compatible, init_state)
+
+
+class OnebitLamb:
+
+    name = "OnebitLamb"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100, max_coeff=10.0,
+                 min_coeff=0.01, cuda_aware=False, comm_backend_name="mesh",
+                 lr_fn=None, **_):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.lr_fn = lr_fn
+
+    def init(self, params, n):
+        # extra = frozen scaling coefficient, starts at 1
+        return init_state(params, n,
+                          extra_fn=lambda p: jnp.ones((), jnp.float32))
+
+    def build_micro(self, engine):
+        check_compatible(engine, self.name)
+        return build_local_grad_micro(engine)
+
+    def build_apply(self, engine):
+        b1, b2 = self.betas
+        eps, wd = self.eps, self.weight_decay
+        freeze = self.freeze_step
+        max_c, min_c = self.max_coeff, self.min_coeff
+
+        def leaf_update(g, p32, m, v, we, se, coeff, count, lr, axes, n):
+            def warmup(_):
+                g_avg = jax.lax.pmean(g, axes)
+                m_ = b1 * m + (1 - b1) * g_avg
+                v_ = b2 * v + (1 - b2) * g_avg * g_avg
+                u = m_ / (jnp.sqrt(v_) + eps) + wd * p32
+                p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+                u_norm = jnp.sqrt(jnp.sum(u * u))
+                ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                                  jnp.clip(p_norm / u_norm, min_c, max_c),
+                                  1.0)
+                return m_, v_, we, se, u, ratio
+
+            def compressed(_):
+                m_local = b1 * m + (1 - b1) * g
+                m_, we_, se_ = compressed_allreduce(m_local, we, se, axes, n)
+                u = m_ / (jnp.sqrt(v) + eps) + wd * p32
+                return m_, v, we_, se_, u, coeff  # frozen ratio
+
+            m_, v_, we_, se_, u, ratio = jax.lax.cond(
+                count <= freeze, warmup, compressed, None)
+            p_ = p32 - lr * ratio * u
+            return p_, m_, v_, we_, se_, ratio
+
+        return build_onebit_apply(engine, leaf_update)

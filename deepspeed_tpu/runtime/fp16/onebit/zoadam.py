@@ -1,0 +1,83 @@
+"""ZeroOneAdam — 0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py:363``).
+
+Compresses from step one (no dense warmup) and additionally *skips*
+communication rounds: the sync interval doubles every ``local_step_scaler``
+steps up to ``local_step_clipper`` (the reference's learning-rate-variance
+policies), with pure-local momentum updates (and error feedback) in between.
+The variance is refreshed from the synced momentum every
+``var_update_scaler`` steps until ``var_freeze_step``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce
+from .common import (build_local_grad_micro, build_onebit_apply,
+                     check_compatible, init_state)
+
+
+class ZeroOneAdam:
+
+    name = "ZeroOneAdam"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, var_freeze_step=100000,
+                 var_update_scaler=16, local_step_scaler=32678,
+                 local_step_clipper=16, cuda_aware=False,
+                 comm_backend_name="mesh", lr_fn=None, **_):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self.lr_fn = lr_fn
+
+    def init(self, params, n):
+        return init_state(params, n)
+
+    def build_micro(self, engine):
+        check_compatible(engine, self.name)
+        return build_local_grad_micro(engine)
+
+    def build_apply(self, engine):
+        b1, b2 = self.betas
+        eps, wd = self.eps, self.weight_decay
+        var_freeze = self.var_freeze_step
+        var_every = max(1, self.var_update_scaler)
+        ls_scaler = max(1, self.local_step_scaler)
+        ls_clip = self.local_step_clipper
+
+        def leaf_update(g, p32, m, v, we, se, x, count, lr, axes, n):
+            m_local = b1 * m + (1 - b1) * g
+            # sync interval: 2^(count // local_step_scaler), clipped
+            exp = jnp.minimum(count // ls_scaler, ls_clip)
+            interval = jnp.left_shift(jnp.int32(1), exp)
+            sync = (count % interval) == 0
+
+            def do_sync(_):
+                return compressed_allreduce(m_local, we, se, axes, n)
+
+            def local(_):
+                # local step: momentum advances locally; errors untouched
+                return m_local, we, se
+
+            m_, we_, se_ = jax.lax.cond(sync, do_sync, local, None)
+            # (count-1) % every: step 1 always refreshes the variance — with
+            # v=0 the update would be m/eps (unbounded) otherwise
+            var_due = jnp.logical_and(count <= var_freeze,
+                                      ((count - 1) % var_every) == 0)
+            v_ = jnp.where(var_due, b2 * v + (1 - b2) * m_ * m_, v)
+            # x = number of variance refreshes so far; bias-correct both
+            # moments or the sparse v updates leave the denominator tiny for
+            # the first ~1/(1-b2) refreshes (cold-start blow-up)
+            vc = x + var_due.astype(jnp.float32)
+            m_hat = m_ / (1.0 - b1**count.astype(jnp.float32))
+            v_hat = v_ / (1.0 - b2**jnp.maximum(vc, 1.0))
+            update = m_hat / (jnp.sqrt(v_hat) + eps)
+            p_ = p32 - lr * (update + wd * p32)
+            return p_, m_, v_, we_, se_, vc
+
+        return build_onebit_apply(engine, leaf_update)

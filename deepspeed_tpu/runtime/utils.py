@@ -96,3 +96,16 @@ def count_parameters(params):
 def ensure_directory_exists(filename):
     import os
     os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
+
+
+def make_scaled_loss_fn(apply_fn, gas):
+    """The one loss-scaling convention shared by every micro-step variant
+    (GSPMD, qgZ manual-SPMD, 1-bit local-grad): scale for fp16, divide by GAS
+    (reference engine.backward :2023), return (scaled, raw) for has_aux."""
+
+    def loss_fn(params, scale, inputs):
+        out = apply_fn(params, *inputs)
+        loss = out[0] if isinstance(out, (tuple, list)) else out
+        return loss.astype(jnp.float32) * scale / gas, loss
+
+    return loss_fn

@@ -200,11 +200,8 @@ def build_manual_dp_micro(engine):
     qw = zc.zero_quantized_weights
 
     from .partition import path_str
-
-    def loss_fn(params, scale, inputs):
-        out = apply_fn(params, *inputs)
-        loss = out[0] if isinstance(out, (tuple, list)) else out
-        return loss.astype(jnp.float32) * scale / gas, loss
+    from ..utils import make_scaled_loss_fn
+    loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
     def micro(params, scale, inputs):
         param_specs = jax.tree_util.tree_map(_translate,
