@@ -1,0 +1,8 @@
+"""Compression subsystem (reference ``deepspeed/compression/``): QAT weight/
+activation quantization, sparse/row/head/channel pruning, layer reduction —
+config-driven, same JSON schema."""
+
+from .compress import (init_compression, redundancy_clean,
+                       student_initialization)
+from .quantizers import fake_quantize, quant_act
+from .scheduler import CompressionScheduler

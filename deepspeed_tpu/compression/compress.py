@@ -1,0 +1,307 @@
+"""Compression orchestration (reference ``compression/compress.py``:
+``init_compression`` :100, ``redundancy_clean`` :148,
+``student_initialization`` :192).
+
+Where the reference swaps ``nn.Linear`` → ``LinearLayer_Compress`` modules,
+the TPU engine is functional: compression attaches
+
+  * a differentiable **param transform** (STE fake-quant) composed into the
+    engine's apply_fn — QAT inside the jitted micro-step;
+  * **masks** re-applied to params (and fp32 master) after every optimizer
+    step — pruning that survives optimizer updates;
+  * a bit-width **schedule** that invalidates the compiled step when the
+    quantization ladder advances.
+
+Module-name patterns are regexes matched against the engine's ``path_str``
+parameter paths ('.' in reference-style patterns matches '/' naturally).
+"""
+
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from . import constants as C
+from .pruners import channel_mask, head_mask, row_mask, sparse_mask
+from .quantizers import bits_schedule, fake_quantize
+from .scheduler import CompressionScheduler
+
+
+def _flat_params(engine):
+    from ..runtime.zero.partition import path_str
+    out = {}
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(engine.params):
+        out[path_str(kp)] = leaf
+    return out
+
+
+def _match(patterns, path):
+    return any(re.search(p, path) for p in patterns)
+
+
+def _apply_mask(w, mask, kind):
+    """kind: 'full' (elementwise), 'out' (trailing dims), 'in' (leading)."""
+    if kind == "full":
+        return w * mask.astype(w.dtype)
+    size = mask.shape[0]
+    if kind == "out":
+        # fold trailing dims until their product == mask size
+        prod, k = 1, w.ndim
+        while k > 0 and prod < size:
+            k -= 1
+            prod *= w.shape[k]
+        if prod != size:
+            return w
+        return w * mask.reshape((1, ) * k + w.shape[k:]).astype(w.dtype)
+    # 'in'
+    prod, k = 1, 0
+    while k < w.ndim and prod < size:
+        prod *= w.shape[k]
+        k += 1
+    if prod != size:
+        return w
+    return w * mask.reshape(w.shape[:k] + (1, ) * (w.ndim - k)).astype(w.dtype)
+
+
+class _Group:
+
+    def __init__(self, name, params, modules, related=None):
+        self.name = name
+        self.params = params
+        self.modules = modules
+        self.related = related or []
+
+
+def _parse_groups(section):
+    shared = section.get(C.SHARED_PARAMETERS, {})
+    groups = []
+    for name, g in section.get(C.DIFFERENT_GROUPS, {}).items():
+        rel = g.get(C.GROUP_RELATED_MODULES) or []
+        rel = [p for sub in rel for p in (sub if isinstance(sub, list)
+                                          else [sub])]
+        groups.append(_Group(name, g.get(C.GROUP_PARAMS, {}),
+                             g.get(C.GROUP_MODULES, []), rel))
+    return shared, groups
+
+
+class CompressionManager:
+    """Holds all compression state for one engine."""
+
+    def __init__(self, engine, config_dict):
+        self.engine = engine
+        self.cfg = config_dict.get(C.COMPRESSION_TRAINING, config_dict) or {}
+        self.step_count = 0
+        self.masks = {}          # path → (mask, kind)
+        self.current_bits = {}   # path → int | None
+        self._wq_shared, self._wq_groups = _parse_groups(
+            self.cfg.get(C.WEIGHT_QUANTIZATION, {}))
+        self._aq_shared, self._aq_groups = _parse_groups(
+            self.cfg.get(C.ACTIVATION_QUANTIZATION, {}))
+        self._prune_cfgs = {
+            method: _parse_groups(self.cfg.get(method, {}))
+            for method in (C.SPARSE_PRUNING, C.ROW_PRUNING, C.HEAD_PRUNING,
+                           C.CHANNEL_PRUNING)
+        }
+        self.scheduler = CompressionScheduler(self)
+        self._install()
+
+    # ------------------------------------------------------------ wiring
+    def _wq_enabled(self):
+        return self._wq_shared.get(C.ENABLED, False) and self._wq_groups
+
+    def _install(self):
+        if self._wq_enabled():
+            self.engine.register_param_transform(self._quant_transform)
+        self.engine.register_post_step_hook(self._post_step)
+
+    def _path_bits(self):
+        """path → bits for the current step (None = not yet quantizing)."""
+        out = {}
+        if not self._wq_enabled():
+            return out
+        offset = self._wq_shared.get(C.SCHEDULE_OFFSET, 0)
+        for path in self._param_paths:
+            for g in self._wq_groups:
+                if _match(g.modules, path):
+                    out[path] = bits_schedule(
+                        self.step_count, g.params.get(C.START_BITS, 8),
+                        g.params.get(C.TARGET_BITS, 8), offset,
+                        g.params.get(C.QUANTIZATION_PERIOD, 0))
+                    break
+        return out
+
+    @property
+    def _param_paths(self):
+        return list(_flat_params(self.engine).keys())
+
+    def _quant_transform(self, params):
+        """Differentiable fake-quant over matched leaves (traced — the bits
+        dict is static per compile; on_step invalidates when it changes)."""
+        bits = dict(self.current_bits)
+        if not any(b for b in bits.values()):
+            return params
+        sym = self._wq_shared.get(C.QUANTIZATION_TYPE,
+                                  "symmetric") == "symmetric"
+        groups = self._wq_shared.get(C.QUANTIZE_GROUPS, 1)
+        from ..runtime.zero.partition import path_str
+
+        def q(kp, x):
+            b = bits.get(path_str(kp))
+            if not b or x.ndim < 2:
+                return x
+            return fake_quantize(x, int(b), sym, groups)
+
+        return jax.tree_util.tree_map_with_path(q, params)
+
+    # ------------------------------------------------------------ stepping
+    def on_step(self, step):
+        self.step_count = step
+        if self._wq_enabled():
+            new_bits = self._path_bits()
+            if new_bits != self.current_bits:
+                self.current_bits = new_bits
+                self.engine.invalidate_compiled()
+        self._update_masks()
+        if self.masks:
+            self._apply_masks()
+
+    def _update_masks(self):
+        flat = _flat_params(self.engine)
+        for method, (shared, groups) in self._prune_cfgs.items():
+            if not shared.get(C.ENABLED, False):
+                continue
+            if self.step_count < shared.get(C.SCHEDULE_OFFSET, 0):
+                continue
+            for g in groups:
+                for path, w in flat.items():
+                    if w.ndim < 2 or not _match(g.modules, path):
+                        continue
+                    if path in self.masks:
+                        continue  # masks are sticky once computed
+                    ratio = g.params.get(C.DENSE_RATIO, 0.5)
+                    m = shared.get(C.METHOD, "l1")
+                    if method == C.SPARSE_PRUNING:
+                        self.masks[path] = (sparse_mask(
+                            w, ratio, m,
+                            shared.get("block_pattern")), "full")
+                    elif method == C.ROW_PRUNING:
+                        mask = row_mask(w, ratio, m)
+                        self.masks[path] = (mask, "out")
+                        for rp, rw in flat.items():
+                            if _match(g.related, rp) and rw.ndim >= 2:
+                                self.masks[rp] = (mask, "in")
+                    elif method == C.HEAD_PRUNING:
+                        mask = head_mask(w, ratio,
+                                         shared.get(C.NUM_HEADS, 1), m)
+                        self.masks[path] = (mask, "in")
+                        for rp, rw in flat.items():
+                            if _match(g.related, rp) and rw.ndim >= 2:
+                                self.masks[rp] = (mask, "out")
+                    elif method == C.CHANNEL_PRUNING:
+                        self.masks[path] = (channel_mask(w, ratio, m), "in")
+
+    def _apply_masks(self):
+        from ..runtime.zero.partition import path_str
+
+        def mask_tree(tree):
+            if tree is None:
+                return None
+
+            def f(kp, x):
+                entry = self.masks.get(path_str(kp))
+                if entry is None:
+                    return x
+                return _apply_mask(x, entry[0], entry[1])
+
+            return jax.tree_util.tree_map_with_path(f, tree)
+
+        self.engine.params = mask_tree(self.engine.params)
+        self.engine.master = mask_tree(self.engine.master)
+
+    def _post_step(self, engine):
+        self.scheduler.step()
+
+    # ------------------------------------------------------------ reporting
+    def sparsity_report(self):
+        flat = _flat_params(self.engine)
+        rep = {}
+        for path, (mask, kind) in self.masks.items():
+            m = np.asarray(mask)
+            rep[path] = 1.0 - float(m.mean())
+        return rep
+
+
+def init_compression(engine, deepspeed_config=None, teacher_model=None,
+                     mpu=None):
+    """Attach compression to an engine (reference ``compress.py:100`` — the
+    module-rewrite pass becomes transform/mask registration)."""
+    cfg = deepspeed_config
+    if cfg is None:
+        cfg = getattr(engine._config, "_param_dict", {}) or {}
+    if isinstance(cfg, str):
+        import json
+        with open(cfg) as f:
+            cfg = json.load(f)
+    manager = CompressionManager(engine, cfg)
+    engine.compression_manager = manager
+    logger.info(f"compression initialized: wq={manager._wq_enabled()} "
+                f"methods={[m for m, (s, _) in manager._prune_cfgs.items() if s.get(C.ENABLED)]}")
+    return engine
+
+
+def redundancy_clean(engine, deepspeed_config=None, mpu=None):
+    """Bake the masks in (reference ``compress.py:148``): final mask
+    application so exported weights carry the pruning pattern."""
+    manager = getattr(engine, "compression_manager", None)
+    if manager is not None and manager.masks:
+        manager._apply_masks()
+    return engine
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Layer-reduction init (reference ``compress.py:192``): copy the chosen
+    teacher layers into the student (depth-pruned) parameter tree.
+
+    Supports both per-layer subtrees (paths containing ``<prefix>/<idx>/``)
+    and stacked-layer leaves (leading dim = num layers) under ``prefix``.
+    """
+    cfg = deepspeed_config.get(C.COMPRESSION_TRAINING,
+                               deepspeed_config).get(C.LAYER_REDUCTION, {})
+    if not cfg.get(C.ENABLED, False):
+        return student_params
+    prefix = cfg.get(C.MODULE_NAME_PREFIX, "")
+    teacher_layers = cfg.get(C.TEACHER_LAYER, [])
+    from ..runtime.zero.partition import path_str
+
+    t_flat = {}
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(teacher_params):
+        t_flat[path_str(kp)] = leaf
+
+    def pick(kp, s_leaf):
+        path = path_str(kp)
+        if prefix and prefix in path:
+            tail = path.split(prefix, 1)[1].lstrip("/")
+            parts = tail.split("/")
+            if parts and parts[0].isdigit():
+                # per-layer subtree: student layer i ← teacher layer map[i]
+                i = int(parts[0])
+                if i < len(teacher_layers):
+                    t_path = path.replace(f"{prefix}/{i}",
+                                          f"{prefix}/{teacher_layers[i]}", 1)
+                    t = t_flat.get(t_path)
+                    if t is not None and t.shape == s_leaf.shape:
+                        return t
+            t = t_flat.get(path)
+            if t is not None and t.ndim == s_leaf.ndim and \
+                    t.shape[1:] == s_leaf.shape[1:] and \
+                    t.shape[0] != s_leaf.shape[0]:
+                # stacked-layer leaf: slice the chosen teacher layers
+                idx = jnp.asarray(teacher_layers[:s_leaf.shape[0]])
+                return jnp.take(t, idx, axis=0)
+        t = t_flat.get(path)
+        return t if t is not None and t.shape == s_leaf.shape else s_leaf
+
+    return jax.tree_util.tree_map_with_path(pick, student_params)

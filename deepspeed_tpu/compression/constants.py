@@ -1,0 +1,38 @@
+"""Compression config keys — same JSON schema as reference
+``deepspeed/compression/constants.py`` (so existing configs run unmodified)."""
+
+COMPRESSION_TRAINING = "compression_training"
+SHARED_PARAMETERS = "shared_parameters"
+DIFFERENT_GROUPS = "different_groups"
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+
+ENABLED = "enabled"
+SCHEDULE_OFFSET = "schedule_offset"
+SCHEDULE_OFFSET_END = "schedule_offset_end"
+METHOD = "method"
+QUANTIZE_GROUPS = "quantize_groups"
+QUANTIZATION_TYPE = "quantization_type"
+ROUNDING = "rounding"
+NUM_HEADS = "num_heads"
+
+GROUP_PARAMS = "params"
+GROUP_MODULES = "modules"
+GROUP_RELATED_MODULES = "related_modules"
+
+START_BITS = "start_bits"
+TARGET_BITS = "target_bits"
+QUANTIZATION_PERIOD = "quantization_period"
+BITS = "bits"
+DENSE_RATIO = "dense_ratio"
+
+KEEP_NUMBER_LAYERS = "keep_number_layers"
+MODULE_NAME_PREFIX = "module_name_prefix"
+TEACHER_LAYER = "teacher_layer"
+OTHER_MODULE_NAME = "other_module_name"

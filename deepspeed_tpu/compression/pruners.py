@@ -1,0 +1,82 @@
+"""Pruning-mask builders (reference ``compression/basic_layer.py``
+LinearLayer_Compress mask logic: sparse/row/head/channel, l1 | topk).
+
+Masks are computed from weight magnitudes on the host side of the step
+boundary and re-applied after every optimizer step (functionally identical to
+the reference's masked-forward: the optimizer may move a pruned weight, the
+mask zeroes it again before it is ever used).
+
+Convention: 2D kernels are [in_features, out_features] (flax DenseGeneral);
+"row" pruning removes *output* features (reference prunes nn.Linear rows =
+output neurons) → masks along the LAST dim; the related-module mask (the
+consumer's input dim) applies along the FIRST dim.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _keep_k(scores, ratio):
+    k = max(1, int(round(scores.size * ratio)))
+    thresh = np.partition(scores.reshape(-1), -k)[-k]
+    return scores >= thresh
+
+
+def sparse_mask(w, dense_ratio, method="l1", block_pattern=None):
+    """Unstructured (or block-structured) magnitude mask."""
+    w = np.asarray(w, np.float32)
+    if method not in ("l1", "topk", "snip_momentum"):
+        raise ValueError(f"unknown sparse pruning method {method!r}")
+    scores = np.abs(w)
+    if block_pattern and block_pattern != "1x1" and w.ndim >= 2:
+        # "RxC" blocks over the trailing 2 dims score by block l1 mean
+        r, c = (int(t) for t in block_pattern.split("x"))
+        rows, cols = w.shape[-2], w.shape[-1]
+        r, c = min(r, rows), min(c, cols)
+        rr, cc = rows - rows % r, cols - cols % c
+        lead = w.shape[:-2]
+        blk = scores[..., :rr, :cc].reshape(*lead, rr // r, r, cc // c, c)
+        blk_score = blk.mean(axis=(-3, -1))
+        keep = _keep_k(blk_score, dense_ratio)
+        mask = np.zeros_like(scores, dtype=bool)
+        mask[..., :rr, :cc] = np.repeat(np.repeat(keep, r, axis=-2), c,
+                                        axis=-1)
+        mask[..., rr:, :] = True
+        mask[..., :, cc:] = True
+        return jnp.asarray(mask, jnp.float32)
+    return jnp.asarray(_keep_k(scores, dense_ratio), jnp.float32)
+
+
+def row_mask(w, dense_ratio, method="l1"):
+    """Output-feature mask [out] from a [in, out] kernel."""
+    w = np.asarray(w, np.float32)
+    scores = np.abs(w).sum(axis=tuple(range(w.ndim - 1)))
+    return jnp.asarray(_keep_k(scores, dense_ratio), jnp.float32)
+
+
+def head_mask(w, dense_ratio, num_heads, method="topk"):
+    """Head mask for an attention output projection [in(=H*dh), out]: score
+    heads by the l1 norm of their input slice (reference head_pruning on
+    attention.output.dense with related qkv)."""
+    w = np.asarray(w, np.float32)
+    in_dim = w.shape[0]
+    if in_dim % num_heads:
+        raise ValueError(f"in dim {in_dim} not divisible by {num_heads} heads")
+    per = in_dim // num_heads
+    scores = np.abs(w).reshape(num_heads, per, -1).sum(axis=(1, 2))
+    keep = _keep_k(scores, dense_ratio)
+    return jnp.asarray(np.repeat(keep, per), jnp.float32)  # [in]
+
+
+def channel_mask(w, dense_ratio, method="l1"):
+    """Input-feature (channel) mask [in] from a [in, out] kernel."""
+    w = np.asarray(w, np.float32)
+    scores = np.abs(w).reshape(w.shape[0], -1).sum(axis=1)
+    return jnp.asarray(_keep_k(scores, dense_ratio), jnp.float32)
+
+
+def apply_dim_mask(w, mask, axis):
+    shape = [1] * w.ndim
+    shape[axis] = mask.shape[0]
+    return w * mask.reshape(shape).astype(w.dtype)

@@ -1,0 +1,73 @@
+"""Quantization-aware-training fake-quantizers (reference
+``compression/basic_layer.py`` quantize functions + ``utils.py``).
+
+All quantizers are straight-through (identity backward) so QAT gradients flow
+— the reference achieves this with autograd Functions; here a custom_vjp.
+Per-group quantization reshapes to (groups, -1) like the reference's
+``quantize_groups``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def fake_quantize(x, bits, symmetric=True, num_groups=1):
+    """Quantize-dequantize ``x`` to ``bits`` with a straight-through grad."""
+    return _fq_impl(x, bits, symmetric, num_groups)
+
+
+def _fq_impl(x, bits, symmetric, num_groups):
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    g = max(1, min(num_groups, n))
+    pad = (-n) % g
+    flat = jnp.pad(flat, (0, pad))
+    grp = flat.reshape(g, -1)
+    if symmetric:
+        qmax = 2.0**(bits - 1) - 1
+        scale = jnp.maximum(jnp.abs(grp).max(axis=1, keepdims=True), 1e-8) / qmax
+        q = jnp.clip(jnp.round(grp / scale), -qmax, qmax)
+        out = q * scale
+    else:
+        levels = 2.0**bits - 1
+        lo = grp.min(axis=1, keepdims=True)
+        hi = grp.max(axis=1, keepdims=True)
+        scale = jnp.maximum(hi - lo, 1e-8) / levels
+        q = jnp.clip(jnp.round((grp - lo) / scale), 0, levels)
+        out = q * scale + lo
+    return out.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+
+
+def _fq_fwd(x, bits, symmetric, num_groups):
+    return _fq_impl(x, bits, symmetric, num_groups), None
+
+
+def _fq_bwd(bits, symmetric, num_groups, _, g):
+    return (g, )
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_act(x, bits, symmetric=False):
+    """Activation fake-quant with dynamic per-tensor range (reference
+    ``QuantAct`` with range_calibration="dynamic", basic_layer.py:17) — model
+    code calls this at the annotated activation sites."""
+    return fake_quantize(x, bits, symmetric, 1)
+
+
+def bits_schedule(step, start_bits, target_bits, offset, period):
+    """Staged bit reduction (reference weight-quant schedule: bits step down
+    every ``quantization_period`` steps after ``schedule_offset``):
+    start → midpoint → target.  Returns None while quantization is off."""
+    if step < offset:
+        return None
+    if period <= 0 or start_bits <= target_bits:
+        return target_bits
+    drops = (step - offset) // period
+    ladder = [start_bits, (start_bits + target_bits) // 2, target_bits]
+    return ladder[min(drops, 2)] if drops < len(ladder) else target_bits

@@ -1,0 +1,15 @@
+"""Compression scheduler (reference ``compression/scheduler.py``): each method
+activates at its ``schedule_offset`` (and optionally deactivates at
+``schedule_offset_end``); the engine calls ``step()`` once per optimizer
+step."""
+
+
+class CompressionScheduler:
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.training_steps = 0
+
+    def step(self, step_zero_check=False):
+        self.training_steps += 1
+        self.manager.on_step(self.training_steps)

@@ -1,0 +1,8 @@
+"""deepspeed_tpu.linear (reference ``deepspeed/linear/``): OptimizedLinear
+(QLoRA-style sharded/quantized base + LoRA adapters), LoRAConfig,
+QuantizationConfig."""
+
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import (OptimizedLinear, init_lora, merge_lora,
+                               unmerge_lora)
+from .quantization import QuantizedParameter, quantize_param_tree

@@ -1,0 +1,140 @@
+"""OptimizedLinear (reference ``linear/optimized_linear.py:18``): a linear
+layer for memory-efficient fine-tuning — frozen base weight, optionally
+int8-quantized and sharded over the dp mesh axis, plus trainable LoRA
+adapters ``y = x·W + (alpha/r)·(x·A)·B``.
+
+TPU-native shape: a flax module whose base kernel carries a dp sharding
+constraint (the "base_weight_sharding" of the reference becomes a
+NamedSharding over the zero axes — XLA gathers on use), and whose quantized
+variant fake-quantizes through the blockwise kernel with a straight-through
+cast (the base is frozen, so no gradient flows there anyway).
+
+``deepspeed_tpu.linear.init_lora`` offers the functional path: split an
+existing param tree into (frozen base, trainable lora) and a merged apply.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..compression.quantizers import fake_quantize
+from .config import LoRAConfig, QuantizationConfig
+
+
+class OptimizedLinear(nn.Module):
+    """Drop-in linear; LoRA + optional weight quantization.
+
+    Reference semantics (``LoRAOptimizedLinear.forward``): base frozen via
+    ``stop_gradient``; adapters initialized (A: he-uniform, B: zeros) so the
+    initial output equals the base linear.
+    """
+    output_dim: int
+    lora_config: LoRAConfig = None
+    quantization_config: QuantizationConfig = None
+    bias: bool = False
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.lora_config or LoRAConfig()
+        in_dim = x.shape[-1]
+        dtype = jnp.dtype(self.dtype)
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (in_dim, self.output_dim), jnp.float32)
+        if self.quantization_config is not None:
+            qc = self.quantization_config
+            kernel = fake_quantize(kernel, qc.q_bits, True,
+                                   max(1, kernel.size // qc.group_size))
+        base = jax.lax.stop_gradient(kernel)  # frozen base
+        # base-weight sharding over the ZeRO/dp axes when a mesh is live
+        from ..utils import groups
+        if groups.mesh_is_initialized() and cfg.base_weight_sharding > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..runtime.zero.partition import shard_spec
+            mesh = groups.get_global_mesh()
+            spec = shard_spec(base.shape, mesh, groups.dp_axes())
+            try:
+                base = jax.lax.with_sharding_constraint(
+                    base, NamedSharding(mesh, spec))
+            except Exception:
+                pass
+        out = x.astype(dtype) @ base.astype(dtype)
+
+        lora_a = self.param(
+            "lora_a",
+            lambda key, shape: jax.random.uniform(
+                key, shape, jnp.float32,
+                -math.sqrt(1.0 / in_dim), math.sqrt(1.0 / in_dim)),
+            (in_dim, cfg.lora_r))
+        lora_b = self.param("lora_b", nn.initializers.zeros,
+                            (cfg.lora_r, self.output_dim), jnp.float32)
+        scaling = cfg.lora_alpha / cfg.lora_r
+        out = out + scaling * (x.astype(dtype) @ lora_a.astype(dtype)
+                               ) @ lora_b.astype(dtype)
+        if self.bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.output_dim, ), jnp.float32)
+            out = out + b.astype(dtype)
+        return out
+
+
+def init_lora(params, lora_config: LoRAConfig = None, rng=None):
+    """Functional LoRA init over an existing tree: for each 2D kernel whose
+    path matches ``target_mods``, create a (lora_a, lora_b) pair (A:
+    he-uniform, B: zeros → merged output initially equals the base).
+
+    Returns a flat dict ``{param_path: {"lora_a": A, "lora_b": B}}`` — the
+    trainable adapter tree."""
+    cfg = lora_config or LoRAConfig()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    from ..runtime.zero.partition import path_str
+    out = {}
+    for kp, x in jax.tree_util.tree_leaves_with_path(params):
+        path = path_str(kp)
+        if getattr(x, "ndim", 0) != 2 or \
+                not any(t in path for t in cfg.target_mods):
+            continue
+        k = jax.random.fold_in(rng, len(out))
+        a = jax.random.uniform(k, (x.shape[0], cfg.lora_r), jnp.float32,
+                               -math.sqrt(1.0 / x.shape[0]),
+                               math.sqrt(1.0 / x.shape[0]))
+        b = jnp.zeros((cfg.lora_r, x.shape[1]), jnp.float32)
+        out[path] = {"lora_a": a, "lora_b": b}
+    return out
+
+
+def merge_lora(params, lora_params, lora_config: LoRAConfig = None):
+    """Fold adapters into the base weights (the hybrid-engine 'fuse_lora'
+    path, reference ``runtime/hybrid_engine.py:132``).  ``lora_params`` is
+    the path-keyed dict from :func:`init_lora`."""
+    cfg = lora_config or LoRAConfig()
+    scaling = cfg.lora_alpha / cfg.lora_r
+    from ..runtime.zero.partition import path_str
+
+    def merge(kp, p):
+        l = lora_params.get(path_str(kp))
+        if l is None:
+            return p
+        return (p.astype(jnp.float32) +
+                scaling * l["lora_a"] @ l["lora_b"]).astype(p.dtype)
+
+    return jax.tree_util.tree_map_with_path(merge, params)
+
+
+def unmerge_lora(params, lora_params, lora_config: LoRAConfig = None):
+    """Inverse of :func:`merge_lora` (hybrid-engine 'unfuse_lora',
+    reference ``runtime/hybrid_engine.py:146``)."""
+    cfg = lora_config or LoRAConfig()
+    scaling = cfg.lora_alpha / cfg.lora_r
+    from ..runtime.zero.partition import path_str
+
+    def unmerge(kp, p):
+        l = lora_params.get(path_str(kp))
+        if l is None:
+            return p
+        return (p.astype(jnp.float32) -
+                scaling * l["lora_a"] @ l["lora_b"]).astype(p.dtype)
+
+    return jax.tree_util.tree_map_with_path(unmerge, params)

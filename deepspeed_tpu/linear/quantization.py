@@ -1,0 +1,40 @@
+"""Quantized parameter container (reference ``linear/quantization.py``
+``QuantizedParameter``): weights stored int8 + per-group scales, dequantized
+on use.  Uses the blockwise quantizer kernel (``ops/pallas/quantizer``)."""
+
+import jax.numpy as jnp
+
+from ..ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
+from .config import QuantizationConfig
+
+
+class QuantizedParameter:
+    """Host-side container: ``quantize`` once, ``dequantized()`` per use.
+    2× (int8) memory saving on frozen base weights."""
+
+    def __init__(self, data, quant_config: QuantizationConfig = None):
+        self.quant_config = quant_config or QuantizationConfig()
+        self.q, self.scales, self.meta = quantize_blockwise(
+            jnp.asarray(data), num_bits=self.quant_config.q_bits,
+            group_size=self.quant_config.group_size)
+
+    def dequantized(self):
+        return dequantize_blockwise(self.q, self.scales, self.meta)
+
+    @property
+    def shape(self):
+        return self.meta[0]
+
+
+def quantize_param_tree(tree, quant_config=None, predicate=None):
+    """Quantize matching leaves of a pytree into QuantizedParameter holders."""
+    import jax
+
+    def q(x):
+        if predicate is not None and not predicate(x):
+            return x
+        if getattr(x, "ndim", 0) < 2:
+            return x
+        return QuantizedParameter(x, quant_config)
+
+    return jax.tree_util.tree_map(q, tree)

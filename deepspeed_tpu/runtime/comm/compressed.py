@@ -57,6 +57,9 @@ def compressed_allreduce(x, worker_error, server_error, ax_names, n):
     shape/dtype, identical on every worker.  State sizes come from
     :func:`error_shapes`.
     """
+    if not ax_names or n <= 1:
+        # single worker: the mean is the input; nothing to compress
+        return x, worker_error, server_error
     shape, dtype = x.shape, x.dtype
     flat = x.reshape(-1).astype(jnp.float32)
     padded = worker_error.shape[0]
@@ -114,9 +117,9 @@ class CompressedBackend:
         if mesh is None:
             from ...utils import groups
             mesh = groups.get_global_mesh()
-            if ax_names is None:
-                ax_names = tuple(a for a in ("dp", "ep")
-                                 if mesh.shape.get(a, 1) > 1)
+        if ax_names is None:
+            ax_names = tuple(a for a in ("dp", "ep")
+                             if mesh.shape.get(a, 1) > 1)
         self.mesh = mesh
         self.ax_names = tuple(ax_names)
         self.n = 1
@@ -131,6 +134,9 @@ class CompressedBackend:
         n = self.n
         numel = int(np.prod(x_stacked.shape[1:], dtype=np.int64))
         we_size, se_size = error_shapes(numel, n)
+        # error state is per (key, size) — mixing residuals across tensors of
+        # different sizes would crash the pad or corrupt the feedback
+        key = (key, numel)
         we, se = self._errors.get(
             key, (jnp.zeros((n, we_size), jnp.float32),
                   jnp.zeros((n, se_size), jnp.float32)))

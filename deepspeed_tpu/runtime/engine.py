@@ -115,6 +115,9 @@ class DeepSpeedEngine:
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_train_batch = {}
+        # compression / user hooks
+        self._param_transforms = []   # differentiable params→params, in fwd
+        self._post_step_hooks = []    # called after each optimizer step
 
         # ---------------------------------------------------------- bring-up
         # (reference initialize() :143-146 → init_distributed; :153-162 mesh)
@@ -506,14 +509,38 @@ class DeepSpeedEngine:
             jax.device_put(jnp.asarray(x), self._batch_sharding(jnp.asarray(x)))
             for x in inputs)
 
+    # -------------------------------------------------------------- hooks
+    def register_param_transform(self, fn):
+        """Register a differentiable params→params transform composed into
+        the forward (QAT fake-quant, LoRA merge, …); invalidates compiles."""
+        self._param_transforms.append(fn)
+        self.invalidate_compiled()
+
+    def register_post_step_hook(self, fn):
+        self._post_step_hooks.append(fn)
+
+    def invalidate_compiled(self):
+        self._compiled_micro = {}
+        self._compiled_apply = None
+        self._compiled_train_batch = {}
+
+    def _effective_apply_fn(self):
+        """apply_fn with registered param transforms composed in — the single
+        model-fn entry for every micro-step variant (GSPMD / qgZ / 1-bit)."""
+        fn = self._apply_fn
+        for t in self._param_transforms:
+            fn = (lambda inner, t: lambda params, *i, **k: inner(
+                t(params), *i, **k))(fn, t)
+        return fn
+
     # ---------------------------------------------------------- compiled fns
     def _micro_step_fn(self):
         """Build (loss, grads) = value_and_grad over compute params."""
-        apply_fn = self._apply_fn
-        gas = self.gradient_accumulation_steps()
         if self._onebit_opt is not None:
             # 1-bit optimizers consume *unreduced* per-worker grads
             return self._onebit_opt.build_micro(self)
+        apply_fn = self._effective_apply_fn()
+        gas = self.gradient_accumulation_steps()
         zc = self._config.zero_config
         if zc.zero_quantized_gradients:
             # qgZ replaces the GSPMD gradient reduction with a quantized
@@ -622,7 +649,9 @@ class DeepSpeedEngine:
         self._check_params()
         inputs = self.shard_batch(*inputs)
         if not self.training:
-            out = self._apply_fn(self.params, *inputs, **kwargs)
+            # transforms (QAT fake-quant, …) apply in eval too — otherwise
+            # validation measures a different model than is being optimized
+            out = self._effective_apply_fn()(self.params, *inputs, **kwargs)
             return out
         self.timers(FORWARD_GLOBAL_TIMER).start()
         micro = self._get_compiled_micro(inputs)
@@ -642,7 +671,7 @@ class DeepSpeedEngine:
         self._flops_profiled = True
         from ..profiling.flops_profiler import FlopsProfiler, jaxpr_flops
         prof = FlopsProfiler(self)
-        apply_fn = self._apply_fn
+        apply_fn = self._effective_apply_fn()
 
         def fwd(params, inputs):
             out = apply_fn(params, *inputs)
@@ -704,6 +733,8 @@ class DeepSpeedEngine:
                 self.lr_scheduler.step()
             if self.curriculum_scheduler is not None:
                 self.curriculum_scheduler.update_difficulty(self.global_steps)
+            for hook in self._post_step_hooks:
+                hook(self)
             self._report_step_metrics(gnorm)
         self.micro_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()

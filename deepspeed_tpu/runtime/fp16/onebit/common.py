@@ -77,7 +77,7 @@ def build_local_grad_micro(engine):
     plan = engine.plan
     axes, mesh = _dp_axes(engine)
     gas = engine.gradient_accumulation_steps()
-    apply_fn = engine._apply_fn
+    apply_fn = engine._effective_apply_fn()
     grad_dtype = engine.grad_accum_dtype
 
     from ...utils import make_scaled_loss_fn

@@ -165,7 +165,7 @@ def build_manual_dp_micro(engine):
     plan = engine.plan
     zc = engine._config.zero_config
     gas = engine.gradient_accumulation_steps()
-    apply_fn = engine._apply_fn
+    apply_fn = engine._effective_apply_fn()
     grad_dtype = engine.grad_accum_dtype
     if engine.mp_world_size > 1 or engine.seq_parallel_world_size > 1 or \
             engine.pp_world_size > 1:

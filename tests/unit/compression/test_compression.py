@@ -1,0 +1,223 @@
+"""Compression tests (reference ``tests/unit/compression/test_compression.py``
+— same config schema, adapted to the functional engine: transforms + masks
+instead of module rewrites)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (fake_quantize, init_compression,
+                                       quant_act, redundancy_clean,
+                                       student_initialization)
+from deepspeed_tpu.compression.pruners import (channel_mask, head_mask,
+                                               row_mask, sparse_mask)
+from deepspeed_tpu.compression.quantizers import bits_schedule
+from tests.unit.simple_model import (batches, make_simple_mlp_params,
+                                     random_dataset, simple_mlp_apply)
+
+HIDDEN = 16
+
+
+# ------------------------------------------------------------- quantizers
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_fake_quantize_levels(symmetric):
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(512), jnp.float32)
+    q = fake_quantize(x, 4, symmetric, 2)
+    # 4-bit → at most 16 distinct levels per group (2 groups)
+    assert len(np.unique(np.asarray(q))) <= 16 * 2
+    # straight-through gradient: identity
+    g = jax.grad(lambda t: jnp.sum(fake_quantize(t, 4, symmetric, 2) * 3.0))(x)
+    np.testing.assert_allclose(np.asarray(g), 3.0)
+
+
+def test_quant_act_rounds():
+    x = jnp.linspace(-1, 1, 100)
+    q8 = quant_act(x, 8)
+    assert float(jnp.abs(q8 - x).max()) < 0.02
+
+
+def test_bits_schedule_ladder():
+    assert bits_schedule(0, 12, 4, 10, 5) is None       # before offset
+    assert bits_schedule(10, 12, 4, 10, 5) == 12        # start
+    assert bits_schedule(15, 12, 4, 10, 5) == 8         # midpoint
+    assert bits_schedule(20, 12, 4, 10, 5) == 4         # target
+    assert bits_schedule(100, 12, 4, 10, 5) == 4
+
+
+# --------------------------------------------------------------- pruners
+def test_sparse_mask_ratio():
+    w = np.random.default_rng(1).standard_normal((64, 64))
+    m = np.asarray(sparse_mask(w, 0.5))
+    assert abs(m.mean() - 0.5) < 0.02
+    # largest magnitudes survive
+    assert m.reshape(-1)[np.argmax(np.abs(w))] == 1.0
+
+
+def test_sparse_mask_block_pattern():
+    w = np.random.default_rng(2).standard_normal((64, 64))
+    m = np.asarray(sparse_mask(w, 0.5, block_pattern="4x1"))
+    blocks = m.reshape(16, 4, 64)
+    # each 4x1 block all-kept or all-dropped
+    assert np.all((blocks.sum(1) == 0) | (blocks.sum(1) == 4))
+
+
+def test_row_head_channel_masks():
+    w = np.random.default_rng(3).standard_normal((32, 64))
+    rm = np.asarray(row_mask(w, 0.25))
+    assert rm.shape == (64, ) and abs(rm.mean() - 0.25) < 0.05
+    hm = np.asarray(head_mask(w, 0.5, num_heads=4))
+    assert hm.shape == (32, )
+    # head granularity: mask constant within each 8-wide head slice
+    assert np.all(hm.reshape(4, 8).std(axis=1) == 0)
+    cm = np.asarray(channel_mask(w, 0.5))
+    assert cm.shape == (32, )
+
+
+# ---------------------------------------------------------- end-to-end QAT
+def _compression_config(extra):
+    return {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adam", "params": {"lr": 0.02}},
+        "zero_optimization": {"stage": 0},
+        "compression_training": extra,
+    }
+
+
+def _train(engine, steps=12):
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    it = iter(data * 50)
+    losses = []
+    for _ in range(steps):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_qat_training_loss_decreases():
+    cfg = _compression_config({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "quantize_groups": 1,
+                                  "quantization_type": "symmetric"},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 8,
+                                   "quantization_period": 10},
+                        "modules": ["layer_"]}},
+        }})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply,
+        model_parameters=make_simple_mlp_params(HIDDEN),
+        config=cfg)
+    init_compression(engine)
+    losses = _train(engine, steps=15)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_pruning_masks_stick_through_steps():
+    cfg = _compression_config({
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 3,
+                                  "method": "l1"},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["layer_"]}},
+        }})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply,
+        model_parameters=make_simple_mlp_params(HIDDEN),
+        config=cfg)
+    init_compression(engine)
+    _train(engine, steps=8)
+    mgr = engine.compression_manager
+    assert mgr.masks, "masks should exist after schedule offset"
+    report = mgr.sparsity_report()
+    assert any(0.4 < s < 0.6 for s in report.values()), report
+    # pruned weights are actually zero after steps (mask re-applied)
+    redundancy_clean(engine)
+    for path, (mask, kind) in mgr.masks.items():
+        if kind != "full":
+            continue
+        leaf = {k: v for k, v in
+                [(p, l) for p, l in _leaves(engine.params)]}[path]
+        zeros = np.asarray(leaf)[np.asarray(mask) == 0]
+        np.testing.assert_allclose(zeros, 0.0, atol=1e-7)
+
+
+def _leaves(tree):
+    from deepspeed_tpu.runtime.zero.partition import path_str
+    return [(path_str(kp), leaf) for kp, leaf in
+            jax.tree_util.tree_leaves_with_path(tree)]
+
+
+def test_head_pruning_with_related_modules():
+    # weights shaped like an attention block: out-proj [32, 16], qkv [16, 32]
+    params = {"attn": {"out_proj": {"kernel": jnp.asarray(
+        np.random.default_rng(5).standard_normal((32, 16)), jnp.float32)},
+        "qkv": {"kernel": jnp.asarray(
+            np.random.default_rng(6).standard_normal((16, 32)), jnp.float32)}}}
+
+    def apply_fn(p, x, y):
+        h = x @ p["attn"]["qkv"]["kernel"]
+        out = h @ p["attn"]["out_proj"]["kernel"]
+        return jnp.mean((out - y)**2)
+
+    cfg = _compression_config({
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 1,
+                                  "method": "topk", "num_heads": 4},
+            "different_groups": {
+                "hp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["out_proj"],
+                        "related_modules": [["qkv"]]}},
+        }})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params, config=cfg)
+    init_compression(engine)
+    x = np.random.default_rng(7).standard_normal((8, 16)).astype(np.float32)
+    y = np.zeros((8, 16), np.float32)
+    for _ in range(4):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    mgr = engine.compression_manager
+    assert "attn/out_proj/kernel" in mgr.masks
+    assert "attn/qkv/kernel" in mgr.masks
+    out_k = np.asarray({p: l for p, l in _leaves(engine.params)}
+                       ["attn/out_proj/kernel"])
+    # half the head slices (8 rows each) fully zeroed
+    head_norms = np.abs(out_k).reshape(4, 8, 16).sum(axis=(1, 2))
+    assert (head_norms == 0).sum() == 2, head_norms
+
+
+# ---------------------------------------------------------- layer reduction
+def test_student_initialization_per_layer_subtrees():
+    teacher = {"encoder": {"layer": {str(i): {"w": jnp.full((4, ), float(i))}
+                                     for i in range(6)}}}
+    student = {"encoder": {"layer": {str(i): {"w": jnp.zeros(4)}
+                                     for i in range(3)}}}
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layers": 3,
+        "module_name_prefix": "encoder/layer",
+        "teacher_layer": [1, 3, 5]}}}
+    out = student_initialization(student, teacher, cfg)
+    got = [float(out["encoder"]["layer"][str(i)]["w"][0]) for i in range(3)]
+    assert got == [1.0, 3.0, 5.0], got
+
+
+def test_student_initialization_stacked_leaf():
+    teacher = {"blocks": {"w": jnp.arange(6, dtype=jnp.float32
+                                          ).reshape(6, 1) * jnp.ones((6, 4))}}
+    student = {"blocks": {"w": jnp.zeros((3, 4))}}
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layers": 3,
+        "module_name_prefix": "blocks",
+        "teacher_layer": [0, 2, 4]}}}
+    out = student_initialization(student, teacher, cfg)
+    np.testing.assert_allclose(np.asarray(out["blocks"]["w"])[:, 0],
+                               [0.0, 2.0, 4.0])
