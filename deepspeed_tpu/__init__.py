@@ -66,6 +66,19 @@ def initialize(args=None,
                                 collate_fn=collate_fn,
                                 config=ds_config,
                                 mpu=mpu)
+    elif ds_config.hybrid_engine.enabled:
+        # RLHF flip-flop engine (reference engine choice deepspeed/__init__.py:214)
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(args=args,
+                                       model=model,
+                                       optimizer=optimizer,
+                                       model_parameters=model_parameters,
+                                       training_data=training_data,
+                                       lr_scheduler=lr_scheduler,
+                                       collate_fn=collate_fn,
+                                       config=ds_config,
+                                       mpu=mpu,
+                                       tp_rules=tp_rules)
     else:
         engine = DeepSpeedEngine(args=args,
                                  model=model,

@@ -1,0 +1,7 @@
+"""Autotuning (reference ``deepspeed/autotuning/``): explores ZeRO stage ×
+micro-batch-size (× offload) spaces, measures throughput, emits the best
+config."""
+
+from .autotuner import Autotuner
+from .config import AutotuningConfig
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner

@@ -1,0 +1,165 @@
+"""Autotuner orchestrator (reference ``autotuning/autotuner.py:42``).
+
+The reference forks ``deepspeed`` launcher jobs per experiment and scrapes
+timer logs; here each experiment is an **in-process trial**: build an engine
+with the candidate config, run a few profiled steps on the user's data, read
+the throughput timer.  (A single SPMD process drives all chips on TPU, so
+in-process trials measure the real thing — there is no per-rank subprocess to
+orchestrate.)
+
+Flow (mirrors reference ``tune()``):
+  1. model-info profile (num params / per-step memory estimate, :663);
+  2. build the tuning space: ZeRO stages × micro-batch candidates (:741);
+  3. run the tuner strategy (grid/random/model-based) with early stopping;
+  4. write ``autotuning_results/`` with per-exp metrics + the best config.
+"""
+
+import itertools
+import json
+import os
+import time
+
+import numpy as np
+
+from ..utils.logging import logger
+from .config import AutotuningConfig
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+          "model_based": ModelBasedTuner}
+
+
+class Autotuner:
+
+    def __init__(self, model, base_config, model_parameters=None,
+                 batch_fn=None, autotuning_config=None, steps_per_trial=None):
+        """``model``/``model_parameters``: as for ``initialize()``;
+        ``batch_fn(mbs) -> tuple``: builds one global batch for a candidate
+        micro-batch size (the data the trials train on)."""
+        self.model = model
+        self.model_parameters = model_parameters
+        self.base_config = dict(base_config)
+        at = autotuning_config or self.base_config.get("autotuning", {})
+        if not isinstance(at, AutotuningConfig):
+            at = AutotuningConfig(**at)
+        self.cfg = at
+        self.batch_fn = batch_fn
+        self.steps_per_trial = steps_per_trial or at.end_profile_step
+        self.results = []
+        self.model_info = None
+
+    # ------------------------------------------------------------ profiling
+    def profile_model_info(self):
+        """Reference ``_get_model_info`` / profile run (:663)."""
+        import jax
+        if self.model_parameters is not None:
+            n = sum(int(np.prod(x.shape)) for x in
+                    jax.tree_util.tree_leaves(self.model_parameters))
+        else:
+            n = 0
+        self.model_info = {"num_params": n}
+        return self.model_info
+
+    # --------------------------------------------------------- tuning space
+    def _micro_batch_candidates(self):
+        lo = max(1, self.cfg.min_train_micro_batch_size_per_gpu)
+        hi = max(lo, self.cfg.max_train_micro_batch_size_per_gpu)
+        cands = []
+        v = lo
+        while v <= hi:
+            cands.append(v)
+            v *= 2
+        k = self.cfg.num_tuning_micro_batch_sizes
+        if len(cands) > k:
+            idx = np.linspace(0, len(cands) - 1, k).round().astype(int)
+            cands = [cands[i] for i in idx]
+        return cands
+
+    def build_tuning_space(self):
+        """ZeRO-stage × mbs grid (reference config_templates per stage)."""
+        stages = self.cfg.zero_stages
+        if stages is None:
+            stages = [0, 1, 2, 3]
+        if self.cfg.fast:
+            stages = stages[:2]
+        exps = []
+        for stage, mbs in itertools.product(stages,
+                                            self._micro_batch_candidates()):
+            ds = dict(self.base_config)
+            ds.pop("autotuning", None)
+            ds = json.loads(json.dumps(ds))  # deep copy
+            ds.setdefault("zero_optimization", {})["stage"] = stage
+            ds["train_micro_batch_size_per_gpu"] = mbs
+            ds.pop("train_batch_size", None)
+            exps.append({"name": f"z{stage}_mbs{mbs}", "ds_config": ds})
+        return exps
+
+    # ----------------------------------------------------------- experiment
+    def _run_experiment(self, exp):
+        import jax
+        import deepspeed_tpu
+        from ..utils import groups
+        ds = exp["ds_config"]
+        mbs = ds["train_micro_batch_size_per_gpu"]
+        groups.reset_mesh()
+        deepspeed_tpu.comm.destroy_process_group()
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, model_parameters=self.model_parameters,
+                config=ds)
+            batch = self.batch_fn(mbs * engine.dp_world_size)
+            if not isinstance(batch, tuple):
+                batch = (batch, )
+            warmup = max(1, self.cfg.start_profile_step - 1)
+            steps = max(self.steps_per_trial, warmup + 1)
+            t0 = None
+            for i in range(steps):
+                loss = engine(*batch)
+                engine.backward(loss)
+                engine.step()
+                if i + 1 == warmup:
+                    jax.block_until_ready(loss)
+                    t0 = time.perf_counter()
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(engine.params)[0])
+            dt = time.perf_counter() - t0
+            measured = steps - warmup
+            samples = mbs * engine.dp_world_size * \
+                engine.gradient_accumulation_steps() * measured
+            thr = samples / dt if dt > 0 else 0.0
+            result = {"throughput": thr, "latency": dt / measured,
+                      "flops": None, "steps": measured}
+        except Exception as e:  # OOM / invalid combo → prune the point
+            logger.warning(f"autotuning exp {exp['name']} failed: {e}")
+            result = None
+        finally:
+            groups.reset_mesh()
+            deepspeed_tpu.comm.destroy_process_group()
+        self.results.append({"name": exp["name"], "result": result})
+        return result
+
+    # ---------------------------------------------------------------- tune
+    def tune(self):
+        self.profile_model_info()
+        exps = self.build_tuning_space()
+        tuner_cls = TUNERS.get(self.cfg.tuner_type, GridSearchTuner)
+        tuner = tuner_cls(exps, self._run_experiment, metric=self.cfg.metric)
+        best = tuner.tune(sample_size=1,
+                          n_trials=self.cfg.tuner_num_trials,
+                          early_stopping=self.cfg.tuner_early_stopping)
+        self._write_results(best)
+        return best
+
+    def _write_results(self, best):
+        os.makedirs(self.cfg.results_dir, exist_ok=True)
+        with open(os.path.join(self.cfg.results_dir, "exps.json"), "w") as f:
+            json.dump(self.results, f, indent=2)
+        with open(os.path.join(self.cfg.results_dir,
+                               "model_info.json"), "w") as f:
+            json.dump(self.model_info, f, indent=2)
+        if best is not None:
+            with open(os.path.join(self.cfg.results_dir,
+                                   "ds_config_optimal.json"), "w") as f:
+                json.dump(best["ds_config"], f, indent=2)
+            logger.info(f"autotuning best: {best['name']} "
+                        f"{self.cfg.metric}={best['result'][self.cfg.metric]:.1f}")

@@ -1,0 +1,29 @@
+"""Autotuning config — same JSON keys as reference
+``autotuning/constants.py`` / ``autotuning/config.py``."""
+
+from typing import Dict, List, Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    metric: str = "throughput"          # throughput | latency | flops
+    tuner_type: str = "gridsearch"      # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    arg_mappings: Optional[Dict[str, str]] = None
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: int = 1024
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    mp_size: int = 1
+    model_info: Optional[Dict] = None
+    zero_stages: Optional[List[int]] = None  # TPU addition: restrict space

@@ -1,0 +1,111 @@
+"""Tuner strategies (reference ``autotuning/tuner/``): grid / random /
+model-based search over experiment lists.  The reference's XGBoost cost model
+becomes a ridge-regression-on-features model (no xgboost dependency; the
+feature space is tiny — stage, mbs, gas)."""
+
+import random as _random
+
+import numpy as np
+
+
+class BaseTuner:
+    """Reference ``tuner/base_tuner.py:13``: iterate experiments, track best."""
+
+    def __init__(self, exps, runner, metric="throughput"):
+        self.all_exps = list(exps)
+        self.runner = runner
+        self.metric = metric
+        self.best_exp = None
+        self.best_metric_val = None
+
+    def has_next(self):
+        return len(self.all_exps) > 0
+
+    def next_batch(self, sample_size=1):
+        raise NotImplementedError
+
+    def update(self, exps, results):
+        for exp, res in zip(exps, results):
+            val = None if res is None else res.get(self.metric)
+            exp["result"] = res
+            if val is not None and (self.best_metric_val is None or
+                                    val > self.best_metric_val):
+                self.best_metric_val = val
+                self.best_exp = exp
+
+    def tune(self, sample_size=1, n_trials=1000, early_stopping=None):
+        trials, since_best = 0, 0
+        while self.has_next() and trials < n_trials:
+            batch = self.next_batch(sample_size)
+            results = [self.runner(exp) for exp in batch]
+            prev_best = self.best_metric_val
+            self.update(batch, results)
+            trials += len(batch)
+            since_best = 0 if self.best_metric_val != prev_best else \
+                since_best + len(batch)
+            if early_stopping and since_best >= early_stopping:
+                break
+        return self.best_exp
+
+
+class GridSearchTuner(BaseTuner):
+    """Reference ``index_based_tuner.py:27``: in-order exhaustive."""
+
+    def next_batch(self, sample_size=1):
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Reference ``index_based_tuner.py:11``: uniform without replacement."""
+
+    def next_batch(self, sample_size=1):
+        k = min(sample_size, len(self.all_exps))
+        batch = _random.sample(self.all_exps, k)
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Reference ``model_based_tuner.py:19``: fit a cost model on measured
+    points, propose the predicted-best next."""
+
+    def __init__(self, exps, runner, metric="throughput", tuning_space=None):
+        super().__init__(exps, runner, metric)
+        self._X, self._y = [], []
+
+    def _featurize(self, exp):
+        cfg = exp["ds_config"]
+        z = cfg.get("zero_optimization", {}).get("stage", 0)
+        mbs = cfg.get("train_micro_batch_size_per_gpu", 1)
+        gas = cfg.get("gradient_accumulation_steps", 1)
+        return [float(z), float(np.log2(max(mbs, 1))), float(gas)]
+
+    def _predict(self, exp):
+        if len(self._y) < 3:
+            return 0.0
+        X = np.array([self._featurize(e) for e in self.all_exps])
+        A = np.array(self._X)
+        y = np.array(self._y)
+        # ridge regression on a degree-2 feature expansion
+        def expand(M):
+            return np.concatenate([M, M**2, np.ones((len(M), 1))], axis=1)
+        Ae, Xe = expand(A), expand(np.array([self._featurize(exp)]))
+        w = np.linalg.solve(Ae.T @ Ae + 1e-3 * np.eye(Ae.shape[1]), Ae.T @ y)
+        return float((Xe @ w)[0])
+
+    def next_batch(self, sample_size=1):
+        ranked = sorted(self.all_exps, key=self._predict, reverse=True)
+        batch = ranked[:sample_size]
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch
+
+    def update(self, exps, results):
+        super().update(exps, results)
+        for exp, res in zip(exps, results):
+            if res is not None and res.get(self.metric) is not None:
+                self._X.append(self._featurize(exp))
+                self._y.append(res[self.metric])

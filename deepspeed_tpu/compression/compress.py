@@ -96,6 +96,7 @@ class CompressionManager:
         self.step_count = 0
         self.masks = {}          # path → (mask, kind)
         self.current_bits = {}   # path → int | None
+        self._wq_path_groups = None  # lazy path→group cache
         self._wq_shared, self._wq_groups = _parse_groups(
             self.cfg.get(C.WEIGHT_QUANTIZATION, {}))
         self._aq_shared, self._aq_groups = _parse_groups(
@@ -117,25 +118,30 @@ class CompressionManager:
             self.engine.register_param_transform(self._quant_transform)
         self.engine.register_post_step_hook(self._post_step)
 
+    def _path_group_map(self):
+        """path → wq group, computed once (patterns and the param tree are
+        static after install; per-step regexing would be hot-path waste)."""
+        if self._wq_path_groups is None:
+            self._wq_path_groups = {}
+            for path in _flat_params(self.engine).keys():
+                for g in self._wq_groups:
+                    if _match(g.modules, path):
+                        self._wq_path_groups[path] = g
+                        break
+        return self._wq_path_groups
+
     def _path_bits(self):
         """path → bits for the current step (None = not yet quantizing)."""
-        out = {}
         if not self._wq_enabled():
-            return out
+            return {}
         offset = self._wq_shared.get(C.SCHEDULE_OFFSET, 0)
-        for path in self._param_paths:
-            for g in self._wq_groups:
-                if _match(g.modules, path):
-                    out[path] = bits_schedule(
-                        self.step_count, g.params.get(C.START_BITS, 8),
-                        g.params.get(C.TARGET_BITS, 8), offset,
-                        g.params.get(C.QUANTIZATION_PERIOD, 0))
-                    break
-        return out
-
-    @property
-    def _param_paths(self):
-        return list(_flat_params(self.engine).keys())
+        return {
+            path: bits_schedule(self.step_count,
+                                g.params.get(C.START_BITS, 8),
+                                g.params.get(C.TARGET_BITS, 8), offset,
+                                g.params.get(C.QUANTIZATION_PERIOD, 0))
+            for path, g in self._path_group_map().items()
+        }
 
     def _quant_transform(self, params):
         """Differentiable fake-quant over matched leaves (traced — the bits
@@ -169,6 +175,12 @@ class CompressionManager:
             self._apply_masks()
 
     def _update_masks(self):
+        if getattr(self, "_masks_final", False):
+            return
+        before = len(self.masks)
+        offsets = [s.get(C.SCHEDULE_OFFSET, 0)
+                   for s, _ in self._prune_cfgs.values()
+                   if s.get(C.ENABLED, False)]
         flat = _flat_params(self.engine)
         for method, (shared, groups) in self._prune_cfgs.items():
             if not shared.get(C.ENABLED, False):
@@ -202,6 +214,11 @@ class CompressionManager:
                                 self.masks[rp] = (mask, "out")
                     elif method == C.CHANNEL_PRUNING:
                         self.masks[path] = (channel_mask(w, ratio, m), "in")
+        # masks are sticky — once every enabled method is past its offset and
+        # a full scan added nothing new, stop re-scanning per step
+        if offsets and len(self.masks) == before and \
+                self.step_count >= max(offsets):
+            self._masks_final = True
 
     def _apply_masks(self):
         from ..runtime.zero.partition import path_str

@@ -25,7 +25,8 @@ def _fq_impl(x, bits, symmetric, num_groups):
     n = flat.shape[0]
     g = max(1, min(num_groups, n))
     pad = (-n) % g
-    flat = jnp.pad(flat, (0, pad))
+    # edge-pad: zero padding would pollute the last group's min/max range
+    flat = jnp.pad(flat, (0, pad), mode="edge")
     grp = flat.reshape(g, -1)
     if symmetric:
         qmax = 2.0**(bits - 1) - 1

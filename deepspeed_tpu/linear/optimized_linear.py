@@ -58,8 +58,13 @@ class OptimizedLinear(nn.Module):
             try:
                 base = jax.lax.with_sharding_constraint(
                     base, NamedSharding(mesh, spec))
-            except Exception:
-                pass
+            except Exception as e:
+                # a silently-replicated base defeats the memory saving the
+                # user configured — make the failure visible
+                from ..utils.logging import logger
+                logger.warning(
+                    "OptimizedLinear: base_weight_sharding constraint "
+                    f"failed ({e}); base weight is replicated")
         out = x.astype(dtype) @ base.astype(dtype)
 
         lora_a = self.param(

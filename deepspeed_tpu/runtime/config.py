@@ -108,6 +108,17 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """Reference ``deepspeed/runtime/config.py`` hybrid_engine section
+    (RLHF train↔generate flip-flop, ``runtime/hybrid_engine.py:30``)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     """Reference ``runtime/activation_checkpointing/config.py`` schema; on TPU
     this steers ``jax.checkpoint`` policies (SURVEY.md §7)."""
@@ -273,6 +284,8 @@ class DeepSpeedConfig:
                                         and {"comms_logger": pd.get("comms_logger")})
         self.flops_profiler_config = FlopsProfilerConfig(
             **pd.get("flops_profiler", {}) or {})
+        self.hybrid_engine = HybridEngineConfig(
+            **pd.get("hybrid_engine", {}) or {})
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}) or {})
         self.pipeline_config = PipelineConfig(**pd.get("pipeline", {}) or {})

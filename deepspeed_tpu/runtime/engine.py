@@ -274,14 +274,23 @@ class DeepSpeedEngine:
         are 'born partitioned' via device_put with sharded layouts)."""
         mixed = self.compute_dtype != jnp.float32
         param_shardings = self.plan.param_shardings(model_parameters)
-        self.params = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(jnp.asarray(p, dtype=self.compute_dtype), s),
-            model_parameters, param_shardings)
+
+        def owned_copy(tree, dtype, shardings):
+            # a compiled copy, NOT device_put: device_put may alias the
+            # caller's buffers, which the donated apply-step later deletes —
+            # the engine must own its state outright
+            cast = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, dtype=dtype), tree)
+            return jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                out_shardings=shardings)(cast)
+
+        self.params = owned_copy(model_parameters, self.compute_dtype,
+                                 param_shardings)
         if mixed or self.zero_stage >= 1:
             master_shardings = self.plan.master_shardings(model_parameters)
-            self.master = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(jnp.asarray(p, dtype=jnp.float32), s),
-                model_parameters, master_shardings)
+            self.master = owned_copy(model_parameters, jnp.float32,
+                                     master_shardings)
         else:
             self.master = None  # pure fp32 stage-0: params are the master
         # Gradient accumulator is allocated lazily: the first backward()'s

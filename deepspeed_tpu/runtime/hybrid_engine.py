@@ -1,0 +1,105 @@
+"""DeepSpeedHybridEngine — RLHF train↔generate flip-flop (reference
+``runtime/hybrid_engine.py:30``).
+
+The reference wraps each layer in inference containers
+(``create_inference_containers`` :274), gathers ZeRO-3 params layer-by-layer
+during ``generate`` (``_zero3_forward`` :357) and fuses/unfuses LoRA
+(:132-146).  TPU-native:
+
+* the *same* jitted decode program (``inference/engine.py``) serves
+  generation, fed the live training params — no module surgery, no weight
+  copies; the jit cache is the "inference container";
+* ZeRO-3 sharded params flow straight into the decode program — XLA's
+  latency-hiding scheduler overlaps the per-layer all-gathers with compute,
+  which IS the reference's layer-wise gather strategy, compiled;
+* LoRA fuse = functional ``merge_lora`` on entry to generate (nothing to
+  unfuse — training params are never mutated).
+
+Selected by ``{"hybrid_engine": {"enabled": true}}`` (reference engine choice
+``deepspeed/__init__.py:178-219``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_engine = None
+        self._lora_params = None
+        self._lora_config = None
+        self._lora_fused = False
+        self._gen_count = 0
+        he = self._config.hybrid_engine
+        log_dist(f"HybridEngine ready: max_out_tokens={he.max_out_tokens}",
+                 ranks=[0])
+
+    # ----------------------------------------------------------------- lora
+    def set_lora(self, lora_params, lora_config=None):
+        """Register trainable LoRA adapters (path-keyed dict from
+        ``deepspeed_tpu.linear.init_lora``); generate() merges them."""
+        self._lora_params = lora_params
+        self._lora_config = lora_config
+
+    def fuse_lora_weight(self):
+        """Parity API (reference :132): bake adapters into the params."""
+        if self._lora_params is None or self._lora_fused:
+            return
+        from ..linear import merge_lora
+        self.params = merge_lora(self.params, self._lora_params,
+                                 self._lora_config)
+        self._lora_fused = True
+
+    def unfuse_lora_weight(self):
+        if self._lora_params is None or not self._lora_fused:
+            return
+        from ..linear import unmerge_lora
+        self.params = unmerge_lora(self.params, self._lora_params,
+                                   self._lora_config)
+        self._lora_fused = False
+
+    # ------------------------------------------------------------- generate
+    def _get_inference_engine(self):
+        if self._inference_engine is None:
+            from ..inference.config import DeepSpeedInferenceConfig
+            from ..inference.engine import InferenceEngine
+            he = self._config.hybrid_engine
+            cfg = DeepSpeedInferenceConfig(
+                max_out_tokens=he.max_out_tokens,
+                dtype="bfloat16" if self._config.bfloat16_enabled else
+                ("float16" if self._config.fp16_enabled else "float32"))
+            self._inference_engine = InferenceEngine(
+                (self.module, self.params), config=cfg)
+        return self._inference_engine
+
+    def _generation_params(self):
+        params = self.params
+        if self._lora_params is not None and not self._lora_fused:
+            from ..linear import merge_lora
+            params = merge_lora(params, self._lora_params, self._lora_config)
+        return params
+
+    def generate(self, input_ids, **kwargs):
+        """KV-cached generation with the live training weights (reference
+        ``generate`` :242 area: flip to inference containers, gather, run)."""
+        eng = self._get_inference_engine()
+        params = self._generation_params()
+        # same pytree shapes/shardings step to step → decode jit cache replay
+        eng.params = jax.tree_util.tree_map(
+            lambda p, ref: p.astype(ref.dtype), params, eng.params)
+        self._gen_count += 1
+        out = eng.generate(input_ids, **kwargs)
+        if self._config.hybrid_engine.release_inference_cache:
+            eng.empty_cache()
+        return out
+
+    def eval(self):
+        return super().eval()
+
+    def train(self, mode=True):
+        return super().train(mode)

@@ -259,6 +259,8 @@ class PipelineEngine(DeepSpeedEngine):
                 def scaled(p):
                     cp = jax.tree_util.tree_map(
                         lambda t: t.astype(self.compute_dtype), p)
+                    for transform in self._param_transforms:
+                        cp = transform(cp)
                     return loss_fn(cp, batch_mb, labels_mb) * scale_state.scale
 
                 loss_val, grads = jax.value_and_grad(
@@ -303,8 +305,14 @@ class PipelineEngine(DeepSpeedEngine):
                                                donate_argnums=(0, 1, 2))
         return self._compiled_pipe[key]
 
+    def invalidate_compiled(self):
+        super().invalidate_compiled()
+        self._compiled_pipe = {}
+
     def _plain_gas_loss_fn(self):
-        """pp=1 fallback: mean loss over the microbatch dim (vmap+mean)."""
+        """pp=1 fallback: mean loss over the microbatch dim (vmap+mean).
+        (param transforms are composed once in the step fn's ``scaled`` —
+        not here — so the pp>1 path gets them identically)"""
         apply_fn = self._apply_fn
 
         def loss(params, batch_mb, labels_mb):
