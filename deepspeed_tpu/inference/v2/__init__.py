@@ -1,0 +1,8 @@
+"""Inference v2 — FastGen-style ragged continuous batching (reference
+``deepspeed/inference/v2/``): blocked KV cache, token-budget scheduling,
+put/query/flush serving API."""
+
+from .config_v2 import RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2
+from .ragged import (BlockedAllocator, BlockedKVCache, DSSequenceDescriptor,
+                     DSStateManager)

@@ -1,0 +1,31 @@
+"""Inference v2 config (reference ``inference/v2/config_v2.py``:
+``RaggedInferenceEngineConfig``, ``DeepSpeedTPConfig``,
+``DSStateManagerConfig`` — same key names, TPU-sized defaults)."""
+
+from typing import Optional
+
+from ...runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    tp_size: int = 1
+
+
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 768          # token budget per engine step
+    max_ragged_sequence_count: int = 512      # seqs per step
+    max_context: int = 8192
+    memory_config: Optional[dict] = None
+    offload: bool = False
+
+    # blocked-KV geometry (reference AllocationMode/KVCacheConfig)
+    block_size: int = 128
+    num_blocks: Optional[int] = None          # None → derived
+
+
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    tensor_parallel: DeepSpeedTPConfig = DeepSpeedTPConfig()
+    state_manager: DSStateManagerConfig = DSStateManagerConfig()
+    dtype: str = "bfloat16"
+    quantization_mode: Optional[str] = None

@@ -1,0 +1,200 @@
+"""InferenceEngineV2 — FastGen-style continuous batching (reference
+``inference/v2/engine_v2.py:30``: ``put``/``query``/``flush`` scheduling API
+over a ragged batch + blocked KV cache).
+
+Each engine iteration packs a **fixed token budget** with a mix of decode
+tokens (one per running sequence) and prefill chunks, runs ONE jitted ragged
+step (``ragged_forward.py``), and samples next tokens for every sequence
+whose pending tokens were fully consumed.  Prefills longer than the budget
+stream across iterations automatically (chunked prefill).
+
+Differences from the reference, by TPU design:
+  * scheduling quantum = token budget (static shapes for XLA), not CUDA-graph
+    atoms;
+  * the engine is synchronous per step (``schedule_step``); serving loops
+    (MII analog) call it in a thread.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+from .config_v2 import RaggedInferenceEngineConfig
+from .ragged import BlockedKVCache, DSStateManager
+from .ragged_forward import RAGGED_FORWARDS
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model, params=None, config=None):
+        if isinstance(model, tuple):
+            model, params = model
+        if config is None:
+            config = RaggedInferenceEngineConfig()
+        elif isinstance(config, dict):
+            config = RaggedInferenceEngineConfig(**config)
+        self._config = config
+        self.module = model
+        cfg = model.config
+        self.model_config = cfg
+        name = type(model).__name__
+        if name not in RAGGED_FORWARDS:
+            raise ValueError(
+                f"no ragged forward registered for {name} "
+                f"(have: {list(RAGGED_FORWARDS)})")
+        self._step_fn = RAGGED_FORWARDS[name]
+        if params is None:
+            raise ValueError("InferenceEngineV2 needs params")
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+        sm = config.state_manager
+        block_size = sm.block_size
+        max_blocks_per_seq = -(-sm.max_context // block_size)
+        num_blocks = sm.num_blocks
+        if num_blocks is None:
+            # enough for half the tracked sequences at full context (+1
+            # garbage block) — the reference sizes from free memory
+            num_blocks = 1 + max(sm.max_ragged_sequence_count,
+                                 (sm.max_tracked_sequences *
+                                  max_blocks_per_seq) // 2)
+        self.kv_cache = BlockedKVCache(
+            cfg.num_hidden_layers, num_blocks, block_size,
+            cfg.num_key_value_heads, cfg.head_dim,
+            dtype=jnp.dtype(config.dtype))
+        self.state_manager = DSStateManager(sm, self.kv_cache)
+        self._budget = int(sm.max_ragged_batch_size)
+        self._kv = self.kv_cache.data
+        logger.info(
+            f"InferenceEngineV2: budget={self._budget} blocks={num_blocks}"
+            f"×{block_size} max_seqs={self.state_manager.max_seqs}")
+
+    # ------------------------------------------------------------- put/query
+    def put(self, batch_uids, batch_tokens, do_schedule=False):
+        """Queue prompt (or continuation) tokens (reference ``put`` :130 also
+        runs the engine; here scheduling is explicit — pass
+        ``do_schedule=True`` for reference-style behavior)."""
+        for uid, toks in zip(batch_uids, batch_tokens):
+            toks = [int(t) for t in np.asarray(toks).reshape(-1)]
+            seq = self.state_manager.get_or_create_sequence(uid)
+            seq.tokens.extend(toks)
+            seq.done = False
+        if do_schedule:
+            return self.schedule_step()
+        return {}
+
+    def query(self, uid):
+        """Latest state of a sequence (reference ``query``): returns
+        (generated_token_count, last_token) once past the prompt."""
+        seq = self.state_manager.get_sequence(uid)
+        if seq is None:
+            return None
+        return {"uid": uid, "length": seq.cur_length,
+                "seen": seq.seen_tokens, "done": seq.done,
+                "tokens": list(seq.tokens)}
+
+    def flush(self, uids):
+        """Release sequences (reference ``flush`` :188)."""
+        for uid in uids:
+            self.state_manager.flush_sequence(uid)
+
+    # -------------------------------------------------------------- schedule
+    def _build_batch(self):
+        """Pack the token budget: decode tokens first (latency), then
+        prefill chunks (throughput) — the reference scheduler's policy."""
+        T = self._budget
+        sm = self.state_manager
+        toks, pos, slots = [], [], []
+        finishing = []  # (seq, buffer index of its last scheduled token)
+        # decode tokens (1 pending) first — latency priority over prefill
+        order = sorted(sm.tracked_sequences.values(),
+                       key=lambda s: len(s.pending()))
+        for seq in order:
+            if seq.done:
+                continue
+            pending = seq.pending()
+            if not pending:
+                continue
+            room = T - len(toks)
+            if room <= 0:
+                break
+            take = min(len(pending), room)
+            sm.ensure_capacity(seq, seq.seen_tokens + take)
+            for i in range(take):
+                toks.append(pending[i])
+                pos.append(seq.seen_tokens + i)
+                slots.append(seq.slot)
+            if take == len(pending):
+                finishing.append((seq, len(toks) - 1))
+            seq.seen_tokens += take
+        n = len(toks)
+        if n == 0:
+            return None
+        pad = T - n
+        toks += [0] * pad
+        pos += [0] * pad
+        slots += [0] * pad  # slot 0 → garbage block
+        last_idx = np.zeros(sm.max_seqs, dtype=np.int32)
+        for seq, idx in finishing:
+            last_idx[seq.slot] = idx
+        return (np.asarray(toks, np.int32), np.asarray(pos, np.int32),
+                np.asarray(slots, np.int32), last_idx, finishing)
+
+    def schedule_step(self, do_sample=False, temperature=1.0, rng=None):
+        """One ragged iteration.  Returns {uid: sampled_next_token} for every
+        sequence whose pending tokens were fully consumed this step."""
+        batch = self._build_batch()
+        if batch is None:
+            return {}
+        toks, pos, slots, last_idx, finishing = batch
+        logits, self._kv = self._step_fn(
+            self.params, self._kv, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(slots),
+            jnp.asarray(self.state_manager.block_table),
+            jnp.asarray(last_idx), cfg=self.model_config,
+            block_size=self.kv_cache.block_size)
+        out = {}
+        if finishing:
+            lg = np.asarray(logits)
+            for seq, _ in finishing:
+                row = lg[seq.slot]
+                if do_sample:
+                    r = np.random.default_rng(None if rng is None else rng)
+                    p = np.exp((row - row.max()) / max(temperature, 1e-6))
+                    token = int(r.choice(len(row), p=p / p.sum()))
+                else:
+                    token = int(np.argmax(row))
+                out[seq.uid] = token
+        return out
+
+    # ------------------------------------------------------------- generate
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 do_sample=False, temperature=1.0):
+        """Convenience continuous-batching loop: all prompts in flight at
+        once, chunked prefill + interleaved decode."""
+        uids = list(range(len(prompts)))
+        self.put(uids, prompts)
+        produced = {u: [] for u in uids}
+        active = set(uids)
+        while active:
+            next_tokens = self.schedule_step(do_sample=do_sample,
+                                             temperature=temperature)
+            if not next_tokens:
+                # a chunked prefill step consumes budget without finishing
+                # any sequence — keep going while work remains
+                if any(self.state_manager.get_sequence(u).pending()
+                       for u in active):
+                    continue
+                break
+            for uid, tok in next_tokens.items():
+                seq = self.state_manager.get_sequence(uid)
+                produced[uid].append(tok)
+                if (eos_token_id is not None and tok == eos_token_id) or \
+                        len(produced[uid]) >= max_new_tokens:
+                    seq.done = True
+                    active.discard(uid)
+                else:
+                    seq.tokens.append(tok)  # decode continues next step
+        self.flush(uids)
+        return [produced[u] for u in uids]

@@ -1,0 +1,148 @@
+"""Ragged state management (reference ``inference/v2/ragged/``):
+``BlockedAllocator`` (block free-list, ``blocked_allocator.py``),
+``BlockedKVCache`` (paged KV storage, ``kv_cache.py``),
+``DSSequenceDescriptor`` + ``DSStateManager`` (``ragged_manager.py:19``).
+
+TPU shape discipline: the cache is ONE array per model —
+``[L, 2, num_blocks, block_size, Hkv, Dh]`` — and every sequence owns a row
+of a fixed-width block table ``[max_seqs, max_blocks_per_seq]``; the jitted
+ragged forward only ever sees static shapes (the "ragged" part is metadata).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class BlockedAllocator:
+    """Free-list allocator over ``num_blocks`` KV blocks (reference
+    ``blocked_allocator.py`` — the linked-list becomes a python set; the
+    device never sees this object)."""
+
+    def __init__(self, num_blocks):
+        self.num_blocks = int(num_blocks)
+        self._free = set(range(self.num_blocks))
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def allocate(self, n):
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: want {n} blocks, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.add(b)
+
+
+@dataclass
+class DSSequenceDescriptor:
+    """Host-side record of one tracked sequence (reference
+    ``sequence_descriptor.py``)."""
+    uid: int
+    slot: int                       # row in the block table
+    tokens: List[int] = field(default_factory=list)  # full token history
+    seen_tokens: int = 0            # tokens already in the KV cache
+    blocks: List[int] = field(default_factory=list)
+    done: bool = False
+
+    @property
+    def cur_length(self):
+        return len(self.tokens)
+
+    def pending(self):
+        """Token ids not yet through the model."""
+        return self.tokens[self.seen_tokens:]
+
+
+class BlockedKVCache:
+    """Paged KV storage (reference ``kv_cache.py``): one jnp array
+    ``[L, 2, num_blocks, block_size, Hkv, Dh]`` + the allocator."""
+
+    def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
+                 head_dim, dtype=jnp.bfloat16):
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.data = jnp.zeros(
+            (num_layers, 2, num_blocks, block_size, num_kv_heads, head_dim),
+            dtype=dtype)
+        self.allocator = BlockedAllocator(num_blocks)
+        # block 0 is the garbage sink: padding tokens in the ragged buffer
+        # scatter their K/V there (their slot-0 block-table row is all zeros)
+        self.allocator._free.discard(0)
+
+    def blocks_for(self, num_tokens):
+        return -(-num_tokens // self.block_size)
+
+
+class DSStateManager:
+    """Tracks sequences ↔ cache blocks (reference ``ragged_manager.py:19``:
+    get_or_create_sequence, flush)."""
+
+    def __init__(self, config, kv_cache: BlockedKVCache):
+        self.config = config
+        self.kv_cache = kv_cache
+        self.max_seqs = int(config.max_ragged_sequence_count)
+        self.max_blocks_per_seq = -(-int(config.max_context) //
+                                    kv_cache.block_size)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # slot 0 is reserved for padding tokens (its block-table row stays
+        # zero, pointing at the garbage block)
+        self._free_slots = list(range(1, self.max_seqs))
+        # host-side mirror of the device block table
+        self.block_table = np.zeros((self.max_seqs, self.max_blocks_per_seq),
+                                    dtype=np.int32)
+
+    # ------------------------------------------------------------- tracking
+    @property
+    def tracked_sequences(self):
+        return dict(self._seqs)
+
+    def get_sequence(self, uid) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid) -> DSSequenceDescriptor:
+        seq = self._seqs.get(uid)
+        if seq is not None:
+            return seq
+        if not self._free_slots:
+            raise RuntimeError("max_ragged_sequence_count exceeded")
+        seq = DSSequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
+        self._seqs[uid] = seq
+        return seq
+
+    def ensure_capacity(self, seq: DSSequenceDescriptor, total_tokens):
+        """Grow the sequence's block list to hold ``total_tokens``."""
+        need = self.kv_cache.blocks_for(total_tokens)
+        if need > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"sequence {seq.uid} exceeds max_context "
+                f"({total_tokens} tokens > "
+                f"{self.max_blocks_per_seq * self.kv_cache.block_size})")
+        while len(seq.blocks) < need:
+            blk = self.kv_cache.allocator.allocate(1)[0]
+            self.block_table[seq.slot, len(seq.blocks)] = blk
+            seq.blocks.append(blk)
+
+    def flush_sequence(self, uid):
+        """Release a sequence (reference ``flush``)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            return
+        if seq.blocks:
+            self.kv_cache.allocator.free(seq.blocks)
+        self.block_table[seq.slot, :] = 0
+        self._free_slots.append(seq.slot)
+
+    @property
+    def free_blocks(self):
+        return self.kv_cache.allocator.free_blocks

@@ -1,0 +1,141 @@
+"""Ragged (paged-KV) model forward (reference
+``inference/v2/model_implementations/llama_v2`` + the ragged kernel suite
+``kernels/ragged_ops``: linear_blocked_kv_rotary, blocked flash, logits_gather).
+
+One jitted function processes a *flat token buffer* ``[T]`` — the union of
+prefill chunks and single decode tokens from many sequences — against the
+paged KV cache.  The reference does this with hand-written CUDA (atom builder
++ blocked flash); here the batch metadata (positions, sequence slots, block
+tables) turns the same computation into gathers/scatters XLA schedules, with
+the attention core a candidate for a Pallas paged kernel (the math below is
+already blocked: swap `_paged_attention` for a kernel without touching the
+rest).
+
+Token semantics: every token's K/V is written to the cache *before* attention
+runs, and each token attends to cache positions ≤ its own — so a multi-token
+prefill chunk is causal within itself and sees all earlier chunks, and a
+decode token sees the whole prefix.  Exactly FastGen's ragged semantics.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...models.llama import _rope_freqs
+
+
+def _rotary(x, cos, sin, positions):
+    """x: [T, H, Dh]; positions: [T]."""
+    c = cos[positions][:, None, :]
+    s = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _rmsnorm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size):
+    """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh]; tables_t: [T, maxb];
+    positions: [T].  Returns [T, H, Dh].
+
+    On TPU: the Pallas paged kernel (block pages streamed through VMEM via
+    scalar-prefetched table indices).  Fallback: XLA gather of each token's
+    block run with position masking."""
+    import os
+    if jax.default_backend() == "tpu" and not os.environ.get(
+            "DS_TPU_DISABLE_PALLAS_PAGED"):
+        from ...ops.pallas.paged_attention import paged_attention
+        return paged_attention(q, k_cache, v_cache, tables_t, positions)
+    T, H, Dh = q.shape
+    Hkv = k_cache.shape[2]
+    maxb = tables_t.shape[1]
+    ctx = maxb * block_size
+    k_ctx = k_cache[tables_t].reshape(T, ctx, Hkv, Dh)
+    v_ctx = v_cache[tables_t].reshape(T, ctx, Hkv, Dh)
+    g = H // Hkv
+    qg = q.reshape(T, Hkv, g, Dh).astype(jnp.float32)
+    scores = jnp.einsum("tkgd,tckd->tkgc", qg,
+                        k_ctx.astype(jnp.float32)) * (Dh**-0.5)
+    pos_ctx = jnp.arange(ctx)[None, None, None, :]
+    mask = pos_ctx <= positions[:, None, None, None]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_ctx.astype(jnp.float32))
+    return out.reshape(T, H, Dh).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
+                   donate_argnums=(1, ))
+def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
+                      block_tables, last_token_idx, *, cfg, block_size):
+    """One ragged engine iteration for the Llama family.
+
+    Args:
+      params: LlamaModel param tree (``models/llama.py`` naming).
+      kv_data: [L, 2, num_blocks, bs, Hkv, Dh] paged cache (donated).
+      token_ids/positions/seq_slots: [T] flat batch (padding: slot 0 = the
+        reserved garbage block row, position 0).
+      block_tables: [max_seqs, maxb] int32.
+      last_token_idx: [max_seqs] int32 — buffer index of each slot's last
+        scheduled token (logits gather; 0 for idle slots).
+
+    Returns (logits [max_seqs, V] fp32, new kv_data).
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    eps = cfg.rms_norm_eps
+    cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
+    cos = jnp.asarray(cos, jnp.float32)
+    sin = jnp.asarray(sin, jnp.float32)
+
+    x = params["embed_tokens"]["embedding"][token_ids].astype(dtype)  # [T, D]
+    tables_t = block_tables[seq_slots]                       # [T, maxb]
+    blk = tables_t[jnp.arange(token_ids.shape[0]),
+                   positions // block_size]                  # [T]
+    off = positions % block_size
+
+    for l in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{l}"]
+        attn, mlp = lp["self_attn"], lp["mlp"]
+        h = _rmsnorm(x, lp["input_layernorm"]["weight"], eps)
+        q = jnp.einsum("td,dhk->thk", h,
+                       attn["q_proj"]["kernel"].astype(dtype))
+        k = jnp.einsum("td,dhk->thk", h,
+                       attn["k_proj"]["kernel"].astype(dtype))
+        v = jnp.einsum("td,dhk->thk", h,
+                       attn["v_proj"]["kernel"].astype(dtype))
+        q = _rotary(q, cos, sin, positions)
+        k = _rotary(k, cos, sin, positions)
+        # scatter this batch's K/V into the paged cache (linear_blocked_kv_
+        # rotary analog), then attend against the updated pages
+        kv_data = kv_data.at[l, 0, blk, off].set(k.astype(kv_data.dtype))
+        kv_data = kv_data.at[l, 1, blk, off].set(v.astype(kv_data.dtype))
+        out = _paged_attention(q, kv_data[l, 0], kv_data[l, 1], tables_t,
+                               positions, block_size)
+        o = out.reshape(out.shape[0], H * Dh)
+        x = x + jnp.einsum("tf,fd->td", o,
+                           attn["o_proj"]["kernel"].astype(dtype))
+        h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
+        gate = h2 @ mlp["gate_proj"]["kernel"].astype(dtype)
+        up = h2 @ mlp["up_proj"]["kernel"].astype(dtype)
+        x = x + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(
+            dtype)
+
+    x = _rmsnorm(x, params["norm"]["weight"], eps)
+    # logits_gather analog: only each slot's last token reaches the LM head
+    xl = x[last_token_idx].astype(jnp.float32)               # [max_seqs, D]
+    if cfg.tie_word_embeddings:
+        logits = xl @ params["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    else:
+        logits = xl @ params["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, kv_data
+
+
+RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step}

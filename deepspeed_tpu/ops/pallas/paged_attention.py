@@ -1,0 +1,115 @@
+"""Paged (blocked-KV) attention, Pallas TPU — the FastGen blocked-flash
+analog (reference ``inference/v2/kernels/ragged_ops/blocked_flash`` +
+``linear_blocked_kv_rotary``).
+
+One grid row per ragged-batch token; the token's KV *pages* are streamed
+through VMEM in block-table order using scalar-prefetched indices (the
+``PrefetchScalarGridSpec`` pattern: the block index map reads the table, so
+the pipeline DMAs exactly the pages this token owns), with the online-softmax
+state in VMEM scratch.  GQA is expressed in the index math (no repeated KV).
+
+The XLA fallback (``inference/v2/ragged_forward._paged_attention``) computes
+the same math by gather; this kernel replaces it on TPU where the gather's
+HBM blowup ([T, max_ctx, ...]) matters.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+            l_ref, *, block_size, scale, groups):
+    t, j = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    my_pos = pos_ref[t]
+    k_start = j * block_size
+
+    @pl.when(k_start <= my_pos)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [H, Dh]
+        k = k_ref[0].astype(jnp.float32)          # [bs, Hkv, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        H, Dh = q.shape
+        bs, Hkv, _ = k.shape
+        qg = q.reshape(Hkv, groups, Dh)
+        # scores [Hkv, g, bs] — per-kv-head MXU dots, no repeated KV
+        s = jnp.einsum("kgd,bkd->kgb", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        mask = col <= my_pos
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                       # [H, 1]
+        s_f = s.reshape(H, bs)
+        m_new = jnp.maximum(m_prev, jnp.max(s_f, axis=1, keepdims=True))
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s_f - m_safe)
+        p = jnp.where(s_f == _NEG_INF, 0.0, p)
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.einsum("kgb,bkd->kgd", p.reshape(Hkv, groups, bs), v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(H, Dh)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_cache, v_cache, tables_t, positions,
+                    block_size=None):
+    """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh];
+    tables_t: [T, maxb] int32; positions: [T] int32 → [T, H, Dh]."""
+    T, H, Dh = q.shape
+    nb_total, bs, Hkv, _ = k_cache.shape
+    maxb = tables_t.shape[1]
+    groups = H // Hkv
+    scale = Dh**-0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, maxb),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda t, j, tb, ps: (t, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, Dh),
+                         lambda t, j, tb, ps: (tb[t, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, Dh),
+                         lambda t, j, tb, ps: (tb[t, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda t, j, tb, ps: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, block_size=bs, scale=scale,
+                          groups=groups),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H, Dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(tables_t, positions, q, k_cache, v_cache)
