@@ -12,26 +12,37 @@ the A100+NCCL reference, for which no in-repo number exists; we take 50% MFU
 as the A100 reference point (Ulysses blog reports >54% of peak as its best,
 blogs/deepspeed-ulysses/README.md:82), so vs_baseline = MFU / 0.40 — 1.0 means
 the 0.8× target is met.
+
+Robustness: the environment's sitecustomize registers a remote-TPU ("axon")
+PJRT platform whose init can block on a network tunnel, and it overrides
+JAX_PLATFORMS by force-setting jax_platforms="axon,cpu" in-process. So this
+script, when run with no args, orchestrates two subprocesses:
+
+  --mode device : default platform (TPU via axon) — the real number
+  --mode cpu    : forces jax_platforms="cpu" in-process — smoke fallback
+
+both under bounded timeouts, run in parallel, and ALWAYS prints exactly one
+JSON line (device result preferred, else cpu fallback, else an error record).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "900"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "420"))
 
-def main():
+
+def run_bench(on_tpu: bool) -> dict:
     import jax
-    import jax.numpy as jnp
-
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
 
+    backend = jax.default_backend()
     if on_tpu:
         cfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5504,
@@ -66,12 +77,12 @@ def main():
         return loss
 
     for _ in range(warmup):
-        loss = one_step()
+        one_step()
     jax.block_until_ready(engine.params)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = one_step()
+        one_step()
     jax.block_until_ready(engine.params)
     dt = time.perf_counter() - t0
 
@@ -81,14 +92,99 @@ def main():
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
     mfu = tokens_per_sec * flops_per_token / peak_flops
 
-    print(json.dumps({
+    return {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens/s (B={B} S={S} params={n_params/1e6:.0f}M "
                 f"step={step_time*1000:.0f}ms MFU={mfu:.3f} backend={backend})",
         "vs_baseline": round(mfu / 0.40, 3),
-    }))
+    }
+
+
+def _child_device():
+    """Benchmark on the default platform (TPU when the tunnel is up)."""
+    import jax
+    backend = jax.default_backend()  # may block; parent's timeout bounds us
+    on_tpu = backend not in ("cpu",)
+    print(json.dumps(run_bench(on_tpu)), flush=True)
+
+
+def _child_cpu():
+    """CPU smoke fallback — forces the cpu platform in-process (the
+    sitecustomize's jax_platforms='axon,cpu' override beats the env var)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_bench(False)), flush=True)
+
+
+def _extract_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                rec = json.loads(line)
+                if "metric" in rec:
+                    return rec
+            except (json.JSONDecodeError, ValueError):
+                continue
+    return None
+
+
+def main():
+    me = os.path.abspath(__file__)
+    procs = {}
+    for mode, timeout in (("device", DEVICE_TIMEOUT_S), ("cpu", CPU_TIMEOUT_S)):
+        procs[mode] = (subprocess.Popen(
+            [sys.executable, me, "--mode", mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True),
+            timeout)
+
+    results, errors = {}, {}
+    for mode in ("device", "cpu"):  # device first — its result is preferred
+        proc, timeout = procs[mode]
+        if mode == "cpu" and "device" in results:
+            proc.kill()  # device number in hand; don't wait on the fallback
+            proc.communicate()
+            continue
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            errors[mode] = f"timeout after {timeout}s"
+            rec = _extract_json(out or "")
+            if rec:
+                results[mode] = rec
+            continue
+        rec = _extract_json(out or "")
+        if rec and proc.returncode == 0:
+            results[mode] = rec
+        else:
+            errors[mode] = (f"rc={proc.returncode} "
+                            f"stderr tail: {(err or '')[-500:]}")
+
+    if "device" in results:
+        print(json.dumps(results["device"]), flush=True)
+    elif "cpu" in results:
+        rec = results["cpu"]
+        rec["unit"] += (" [cpu-fallback: device attempt failed: "
+                        f"{errors.get('device', 'unknown')[:200]}]")
+        print(json.dumps(rec), flush=True)
+    else:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": ("bench failed on all backends: "
+                     + "; ".join(f"{m}: {e[:200]}" for m, e in errors.items())),
+            "vs_baseline": 0.0,
+        }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--mode":
+        if sys.argv[2] == "device":
+            _child_device()
+        else:
+            _child_cpu()
+    else:
+        main()

@@ -174,7 +174,8 @@ def build_onebit_apply(engine, leaf_update):
             new_se = treedef.unflatten(
                 [pick(o[4], s[0])[None] for o, s in zip(outs, flat_se)])
             new_x = treedef.unflatten(
-                [pick(o[5], x) for o, x in zip(outs, flat_x)])
+                [jax.tree_util.tree_map(lambda n_, o_: pick(n_, o_), o[5], x)
+                 for o, x in zip(outs, flat_x)])
             # post-reduction momentum norm (the exact grad norm would need a
             # dense allreduce, which 1-bit exists to avoid)
             gnorm = jnp.sqrt(

@@ -1,11 +1,26 @@
 """ZeroOneAdam — 0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py:363``).
 
-Compresses from step one (no dense warmup) and additionally *skips*
-communication rounds: the sync interval doubles every ``local_step_scaler``
-steps up to ``local_step_clipper`` (the reference's learning-rate-variance
-policies), with pure-local momentum updates (and error feedback) in between.
-The variance is refreshed from the synced momentum every
-``var_update_scaler`` steps until ``var_freeze_step``.
+Two phases, mirroring the reference:
+
+* **Variance warmup** (``count <= var_freeze_step``): every step communicates.
+  The worker-local momentum goes through the 1-bit error-feedback compressed
+  allreduce, and the variance refreshes (every ``var_update_scaler``-th step)
+  from the *synced* momentum — a deliberate deviation from the reference
+  (which compresses the raw gradient and refreshes the variance from dense
+  grads): tying ``v`` to the synced momentum keeps the per-element
+  numerator/denominator scales matched under sign-compression noise, and
+  keeps every state replica-identical with a single collective per step.
+
+* **Local stepping** (``count > var_freeze_step``): the variance is frozen and
+  communication rounds are skipped — the sync interval doubles every
+  ``local_step_scaler`` steps up to ``2**local_step_clipper``.  Each worker
+  advances params from its *local* momentum and records the applied deltas in
+  a per-leaf accumulator ``acc`` (plus the summed lr in ``lrs``).  At a sync
+  step it undoes its local drift (``p - acc``), compressed-allreduces the
+  accumulated update (scaled to momentum units by the frozen denominator),
+  re-applies the average, and recovers the synced momentum as ``-buf/lrs`` —
+  the reference's ``momentum_accumulator`` reconcile (``zoadam.py:244-265``).
+  After every sync step params and momentum are replica-identical again.
 """
 
 import jax
@@ -36,7 +51,13 @@ class ZeroOneAdam:
         self.lr_fn = lr_fn
 
     def init(self, params, n):
-        return init_state(params, n)
+        return init_state(
+            params, n,
+            extra_fn=lambda p: {
+                "vc": jnp.zeros((), jnp.float32),
+                "acc": jnp.zeros(p.shape, jnp.float32),
+                "lrs": jnp.zeros((), jnp.float32),
+            })
 
     def build_micro(self, engine):
         check_compatible(engine, self.name)
@@ -51,33 +72,73 @@ class ZeroOneAdam:
         ls_clip = self.local_step_clipper
 
         def leaf_update(g, p32, m, v, we, se, x, count, lr, axes, n):
-            m_local = b1 * m + (1 - b1) * g
-            # sync interval: 2^(count // local_step_scaler), clipped
-            exp = jnp.minimum(count // ls_scaler, ls_clip)
-            interval = jnp.left_shift(jnp.int32(1), exp)
-            sync = (count % interval) == 0
-
-            def do_sync(_):
-                return compressed_allreduce(m_local, we, se, axes, n)
-
-            def local(_):
-                # local step: momentum advances locally; errors untouched
-                return m_local, we, se
-
-            m_, we_, se_ = jax.lax.cond(sync, do_sync, local, None)
+            vc, acc, lrs = x["vc"], x["acc"], x["lrs"]
+            warm = count <= var_freeze
             # (count-1) % every: step 1 always refreshes the variance — with
-            # v=0 the update would be m/eps (unbounded) otherwise
-            var_due = jnp.logical_and(count <= var_freeze,
-                                      ((count - 1) % var_every) == 0)
-            v_ = jnp.where(var_due, b2 * v + (1 - b2) * m_ * m_, v)
-            # x = number of variance refreshes so far; bias-correct both
-            # moments or the sparse v updates leave the denominator tiny for
-            # the first ~1/(1-b2) refreshes (cold-start blow-up)
-            vc = x + var_due.astype(jnp.float32)
-            m_hat = m_ / (1.0 - b1**count.astype(jnp.float32))
-            v_hat = v_ / (1.0 - b2**jnp.maximum(vc, 1.0))
-            update = m_hat / (jnp.sqrt(v_hat) + eps)
-            p_ = p32 - lr * (update + wd * p32)
-            return p_, m_, v_, we_, se_, vc
+            # v=0 the very first update would be m/eps (unbounded) otherwise.
+            var_due = jnp.logical_and(warm, ((count - 1) % var_every) == 0)
+            vc_ = vc + var_due.astype(jnp.float32)
+            bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+
+            def denom(v_):
+                v_hat = v_ / (1.0 - b2 ** jnp.maximum(vc_, 1.0))
+                return jnp.sqrt(v_hat) + eps
+
+            def warmup(args):
+                # Every warmup step syncs: the worker-local momentum goes
+                # through the 1-bit error-feedback allreduce, and the
+                # variance refreshes (on its own schedule) from the *synced*
+                # momentum — so moments and params stay replica-identical.
+                m0, v0, we0, se0, acc0, lrs0, p0 = args
+                m_, we_, se_ = compressed_allreduce(
+                    b1 * m0 + (1 - b1) * g, we0, se0, axes, n)
+                v_ = jnp.where(var_due, b2 * v0 + (1 - b2) * m_ * m_, v0)
+                update = (m_ / bc1) / denom(v_) + wd * p0
+                return (p0 - lr * update, m_, v_, we_, se_,
+                        jnp.zeros_like(acc0), jnp.zeros_like(lrs0))
+
+            def local_phase(args):
+                m0, v0, we0, se0, acc0, lrs0, p0 = args
+                m_loc = b1 * m0 + (1 - b1) * g  # worker-local momentum
+                past = jnp.maximum(count - var_freeze, 0)
+                expo = jnp.minimum(past // ls_scaler, ls_clip)
+                interval = jnp.left_shift(jnp.int32(1), expo)
+                sync = (count % interval) == 0
+
+                update = (m_loc / bc1) / denom(v0) + wd * p0
+                p_loc = p0 - lr * update
+                acc_loc = acc0 - lr * update
+                lrs_loc = lrs0 + lr
+
+                def do_sync(_):
+                    # Undo local drift, average the accumulated update,
+                    # re-apply.  The wire tensor is expressed in *momentum
+                    # units* (acc·denom·bc1/lrs ≈ the lr-weighted mean of the
+                    # local momenta) so the error-feedback residuals keep one
+                    # consistent scale across the warmup and local phases.
+                    p_undo = p_loc - acc_loc
+                    lrs_safe = jnp.maximum(lrs_loc, 1e-30)
+                    # q folds the accumulated wd·p term into the recovered
+                    # momentum — the reference does the same (its comm_buffer
+                    # accumulates lr·(m/denom + wd·p) and exp_avg is rebuilt
+                    # as -comm_buffer/lrs, zoadam.py:241-260).
+                    q = -(acc_loc * denom(v0) / lrs_safe) * bc1  # v frozen
+                    m_sync, we_, se_ = compressed_allreduce(
+                        q, we0, se0, axes, n)
+                    p_new = p_undo - (lrs_safe / bc1) * m_sync / denom(v0)
+                    return (p_new, m_sync, jnp.zeros_like(acc_loc),
+                            jnp.zeros_like(lrs_loc), we_, se_)
+
+                def keep_local(_):
+                    return p_loc, m_loc, acc_loc, lrs_loc, we0, se0
+
+                p_, m_, acc_, lrs_, we_, se_ = jax.lax.cond(
+                    sync, do_sync, keep_local, None)
+                return p_, m_, v0, we_, se_, acc_, lrs_
+
+            p_, m_, v_, we_, se_, acc_, lrs_ = jax.lax.cond(
+                warm, warmup, local_phase, (m, v, we, se, acc, lrs, p32))
+            x_ = {"vc": vc_, "acc": acc_, "lrs": lrs_}
+            return p_, m_, v_, we_, se_, x_
 
         return build_onebit_apply(engine, leaf_update)
