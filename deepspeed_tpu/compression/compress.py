@@ -95,6 +95,7 @@ class CompressionManager:
         self.cfg = config_dict.get(C.COMPRESSION_TRAINING, config_dict) or {}
         self.step_count = 0
         self.masks = {}          # path → (mask, kind)
+        self._masked_fn = None   # jitted mask application, keyed on mask set
         self.current_bits = {}   # path → int | None
         self._wq_path_groups = None  # lazy path→group cache
         self._wq_shared, self._wq_groups = _parse_groups(
@@ -214,6 +215,8 @@ class CompressionManager:
                                 self.masks[rp] = (mask, "out")
                     elif method == C.CHANNEL_PRUNING:
                         self.masks[path] = (channel_mask(w, ratio, m), "in")
+        if len(self.masks) != before:
+            self._masked_fn = None  # mask set changed → kinds closure stale
         # masks are sticky — once every enabled method is past its offset and
         # a full scan added nothing new, stop re-scanning per step
         if offsets and len(self.masks) == before and \
@@ -221,22 +224,34 @@ class CompressionManager:
             self._masks_final = True
 
     def _apply_masks(self):
+        """Multiply the masks into params/master via one jitted (donating)
+        program — an eager per-leaf host loop here would serialize the step
+        dispatch path every iteration once any mask exists."""
         from ..runtime.zero.partition import path_str
 
-        def mask_tree(tree):
-            if tree is None:
-                return None
+        if self._masked_fn is None:
+            kinds = {p: k for p, (_, k) in self.masks.items()}
 
-            def f(kp, x):
-                entry = self.masks.get(path_str(kp))
-                if entry is None:
-                    return x
-                return _apply_mask(x, entry[0], entry[1])
+            def apply_fn(trees, masks):
+                def mask_tree(tree):
+                    if tree is None:
+                        return None
 
-            return jax.tree_util.tree_map_with_path(f, tree)
+                    def f(kp, x):
+                        p = path_str(kp)
+                        if p not in masks:
+                            return x
+                        return _apply_mask(x, masks[p], kinds[p])
 
-        self.engine.params = mask_tree(self.engine.params)
-        self.engine.master = mask_tree(self.engine.master)
+                    return jax.tree_util.tree_map_with_path(f, tree)
+
+                return tuple(mask_tree(t) for t in trees)
+
+            self._masked_fn = jax.jit(apply_fn, donate_argnums=0)
+
+        masks = {p: m for p, (m, _) in self.masks.items()}
+        self.engine.params, self.engine.master = self._masked_fn(
+            (self.engine.params, self.engine.master), masks)
 
     def _post_step(self, engine):
         self.scheduler.step()

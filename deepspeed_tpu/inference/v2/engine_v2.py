@@ -143,7 +143,21 @@ class InferenceEngineV2:
 
     def schedule_step(self, do_sample=False, temperature=1.0, rng=None):
         """One ragged iteration.  Returns {uid: sampled_next_token} for every
-        sequence whose pending tokens were fully consumed this step."""
+        sequence whose pending tokens were fully consumed this step.
+
+        ``rng`` may be a ``np.random.Generator`` or a seed; either way the
+        Generator is created once and advances across tokens and steps (a
+        seed re-seeded per token would sample identical draws every time).
+        """
+        if do_sample:
+            if isinstance(rng, np.random.Generator):
+                self._rng = rng
+                self._rng_seed = None
+            elif (getattr(self, "_rng", None) is None
+                  or (rng is not None and rng != getattr(self, "_rng_seed", None))):
+                # create once per distinct seed; advances across tokens/steps
+                self._rng = np.random.default_rng(rng)
+                self._rng_seed = rng
         batch = self._build_batch()
         if batch is None:
             return {}
@@ -160,9 +174,8 @@ class InferenceEngineV2:
             for seq, _ in finishing:
                 row = lg[seq.slot]
                 if do_sample:
-                    r = np.random.default_rng(None if rng is None else rng)
                     p = np.exp((row - row.max()) / max(temperature, 1e-6))
-                    token = int(r.choice(len(row), p=p / p.sum()))
+                    token = int(self._rng.choice(len(row), p=p / p.sum()))
                 else:
                     token = int(np.argmax(row))
                 out[seq.uid] = token

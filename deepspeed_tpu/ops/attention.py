@@ -31,10 +31,10 @@ def _xla_attention(q, k, v, causal=True, softmax_scale=None):
 def _use_pallas():
     if os.environ.get("DS_TPU_DISABLE_PALLAS_ATTN"):
         return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    # interpret_mode() recognizes proxied TPU platforms (device_kind check),
+    # where jax.default_backend() may not literally be "tpu"
+    from .pallas._common import interpret_mode
+    return not interpret_mode()
 
 
 def attention_core(q, k, v, causal=True, softmax_scale=None):

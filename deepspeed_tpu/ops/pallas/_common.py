@@ -1,0 +1,22 @@
+"""Shared Pallas helpers."""
+
+import functools
+
+import jax
+
+
+@functools.cache
+def interpret_mode() -> bool:
+    """True → run Pallas kernels in interpreter mode (non-TPU backends).
+
+    Checks the default device's platform AND device_kind: proxied PJRT
+    plugins (e.g. the remote-TPU 'axon' platform) may expose a platform
+    string that isn't literally "tpu" while still being a real TPU — running
+    Mosaic kernels interpreted there would silently destroy performance.
+    """
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return True
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return not ("tpu" in dev.platform.lower() or "tpu" in kind)

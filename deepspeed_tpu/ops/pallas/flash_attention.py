@@ -27,10 +27,10 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = float("-inf")
+_DEAD_ROW_LSE = -1e30  # finite lse sentinel for fully-masked rows
 
 
-def _interpret():
-    return jax.default_backend() != "tpu"
+from ._common import interpret_mode as _interpret
 
 
 def _pad_to(x, axis, mult):
@@ -41,6 +41,26 @@ def _pad_to(x, axis, mult):
     pad = [(0, 0)] * x.ndim
     pad[axis] = (0, rem)
     return jnp.pad(x, pad)
+
+
+def _eye(n, dtype):
+    return (jax.lax.broadcasted_iota(jnp.int32, (n, n), 0) ==
+            jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)).astype(dtype)
+
+
+def _col_to_row(col):
+    """(n, 1) → (1, n) via an MXU identity contraction — a Mosaic-safe way to
+    move per-row scalars from sublanes into lanes (cheap: n² MACs)."""
+    return jax.lax.dot_general(col, _eye(col.shape[0], col.dtype),
+                               (((0, ), (0, )), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _row_to_col(row):
+    """(1, n) → (n, 1) via an MXU identity contraction."""
+    return jax.lax.dot_general(_eye(row.shape[1], row.dtype), row,
+                               (((1, ), (1, )), ((), ())),
+                               preferred_element_type=jnp.float32)
 
 
 def _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k):
@@ -107,8 +127,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         m = m_ref[:, :1]
-        lse = jnp.where(m == _NEG_INF, _NEG_INF, m + jnp.log(l_safe))
-        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+        # Dead (fully-masked) rows get a finite -1e30 sentinel, not -inf: the
+        # identity contraction below computes sum_i lse[i]·eye[i,j], and
+        # (-inf)·0 = NaN would poison every row of the packed block.  The
+        # backward needs no special-casing — exp(s − (−1e30)) at the dead
+        # rows' masked positions is exp(−inf) = 0.
+        lse = jnp.where(m == _NEG_INF, _DEAD_ROW_LSE, m + jnp.log(l_safe))
+        # lse output is packed [B,H,S] (S in lanes) — no 128-lane inflation
+        lse_ref[0] = _col_to_row(lse)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
@@ -132,12 +158,11 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i, j:
-                         (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, sq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, sq_p, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, sq_p), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -149,7 +174,7 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
                                  "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
-    return o, lse[..., 0]
+    return o, lse
 
 
 # --------------------------------------------------------------------- bwd
@@ -170,13 +195,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        lse = _row_to_col(lse_ref[0])      # packed [1,bq] lanes → [bq,1]
+        delta = _row_to_col(delta_ref[0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
-        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
-        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
+        # are all masked, so the select discards whatever exp produced
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -206,13 +232,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        lse = _row_to_col(lse_ref[0])      # packed [1,bq] lanes → [bq,1]
+        delta = _row_to_col(delta_ref[0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
-        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
-        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
+        # are all masked, so the select discards whatever exp produced
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         # dv += pᵀ·do ; ds = p∘(do·vᵀ − delta) ; dk += dsᵀ·q
         dv_acc[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -233,10 +260,10 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
     _, Hkv, sk_p, _ = k.shape
     nq, nk = sq_p // block_q, sk_p // block_k
     kv_head = lambda h: (h * Hkv) // Hq
+    # Per-row scalars stay packed [B,H,S] (S in lanes) — the kernels unpack a
+    # (1, block_q) row to a (block_q, 1) column with an MXU identity
+    # contraction instead of hauling 128 duplicated lanes through HBM.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    # Broadcast per-row scalars across the 128-lane minor dim once, outside.
-    lse_l = jnp.broadcast_to(lse[..., None], lse.shape + (128, ))
-    delta_l = jnp.broadcast_to(delta[..., None], delta.shape + (128, ))
 
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
@@ -252,10 +279,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), j, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
-                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -263,7 +288,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=semantics,
         interpret=_interpret(),
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, lse, delta)
 
     # dk/dv are produced per *query* head ([B,Hq,Sk,D]) and group-summed to
     # KV heads afterwards — the GQA head fan-in.
@@ -278,10 +303,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), i, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
-                         lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
-                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -297,7 +320,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
         ],
         compiler_params=semantics,
         interpret=_interpret(),
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, lse, delta)
     if Hq != Hkv:
         g = Hq // Hkv
         dk = dk.reshape(B, Hkv, g, sk_p, D).sum(axis=2).astype(k.dtype)

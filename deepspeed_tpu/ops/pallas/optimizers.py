@@ -28,8 +28,7 @@ _LANES = 128
 _BLOCK_ROWS = 512  # 512×128 f32 = 256 KiB per buffer
 
 
-def _interpret():
-    return jax.default_backend() != "tpu"
+from ._common import interpret_mode as _interpret
 
 
 def _to_tiles(x):

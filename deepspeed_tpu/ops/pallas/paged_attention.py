@@ -23,8 +23,7 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = float("-inf")
 
 
-def _interpret():
-    return jax.default_backend() != "tpu"
+from ._common import interpret_mode as _interpret
 
 
 def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,

@@ -25,8 +25,7 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 
 
-def _interpret():
-    return jax.default_backend() != "tpu"
+from ._common import interpret_mode as _interpret
 
 
 def _quant_kernel(x_ref, q_ref, s_ref, *, qmax):
