@@ -235,18 +235,27 @@ class LlamaModel(nn.Module):
 
 def tp_rules(config: LlamaConfig):
     """AutoTP-style sharding rules: param-path suffix → PartitionSpec.
-    Column-parallel q/k/v/gate/up (+ embed vocab dim), row-parallel o/down."""
+    Column-parallel q/k/v/gate/up (+ embed vocab dim), row-parallel o/down.
+
+    The ``"zero"`` pseudo-axis pins where the ZeRO-3 shard lands (expanded by
+    ``ZeroPartitionPlan`` per stage).  Placement is chosen so ZeRO never
+    shards a contracting/hidden dim: GSPMD would otherwise propagate
+    hidden-dim sharding into the activations and full-rematerialize them back
+    to (dp, sp) batch/seq sharding at every norm boundary (the round-1
+    "involuntary full rematerialization" warnings).  q/k/v take it on the
+    head dim, o/gate/up/down on their output dim, embed/lm_head on vocab.
+    """
     tp = "tp"
     return {
-        "q_proj/kernel": P(None, tp, None),
-        "k_proj/kernel": P(None, tp, None),
-        "v_proj/kernel": P(None, tp, None),
-        "o_proj/kernel": P(tp, None),
-        "gate_proj/kernel": P(None, tp),
-        "up_proj/kernel": P(None, tp),
-        "down_proj/kernel": P(tp, None),
-        "embed_tokens/embedding": P(tp, None),
-        "lm_head/kernel": P(None, tp),
+        "q_proj/kernel": P(None, tp, "zero"),
+        "k_proj/kernel": P(None, tp, "zero"),
+        "v_proj/kernel": P(None, tp, "zero"),
+        "o_proj/kernel": P(tp, "zero"),
+        "gate_proj/kernel": P(None, (tp, "zero")),
+        "up_proj/kernel": P(None, (tp, "zero")),
+        "down_proj/kernel": P(tp, "zero"),
+        "embed_tokens/embedding": P((tp, "zero"), None),
+        "lm_head/kernel": P(None, (tp, "zero")),
     }
 
 

@@ -89,6 +89,17 @@ def _divisible(shape, spec, mesh):
     return True
 
 
+def _strip_zero_placeholder(spec):
+    """Drop the 'zero' pseudo-axis (a ZeRO-placement pin interpreted only by
+    ZeroPartitionPlan) — inference has no ZeRO axes to place."""
+    out = []
+    for ax in spec:
+        names = tuple(a for a in (ax if isinstance(ax, tuple) else (ax, ))
+                      if a is not None and a != "zero")
+        out.append(names if len(names) > 1 else (names[0] if names else None))
+    return P(*out)
+
+
 def shard_params_for_tp(params, mesh, rules=None, tp_axis="tp"):
     """Place ``params`` on ``mesh`` with TP shardings from ``rules``
     (``ReplaceWithTensorSlicing`` analog — reference ``auto_tp.py:30`` — but
@@ -98,6 +109,8 @@ def shard_params_for_tp(params, mesh, rules=None, tp_axis="tp"):
 
     def place(kp, leaf):
         spec = match_tp_rule(rules, path_str(kp))
+        if spec is not None:
+            spec = _strip_zero_placeholder(spec)
         if spec is None or not _divisible(leaf.shape, spec, mesh):
             if spec is not None:
                 logger.warning(

@@ -195,53 +195,103 @@ class ZeroPartitionPlan:
                 self.param_mesh, self.param_axes = hpz_mesh, zp_axes
 
     # specs -----------------------------------------------------------------
-    def _tp_base(self, path, shape=None):
-        if path is None:
-            return None
-        spec = match_tp_rule(self.tp_rules, path)
-        if spec is None or shape is None:
-            return spec
-        # kv-head-aware sanitization (reference module_inject/tp_shard.py):
-        # drop axes a dim can't divide (e.g. 2 kv heads on tp=4 → replicate).
+    def _expand_rule(self, spec, shape, zero_axes, mesh):
+        """Expand ``"zero"`` placeholders in a rule spec and sanitize.
+
+        Rules may pin where the ZeRO shard lands with the pseudo-axis
+        ``"zero"`` (e.g. ``P(None, 'tp', 'zero')`` puts it on the head dim).
+        Placement matters beyond memory balance: ZeRO-sharding a matmul's
+        *contracting* dim (or an embedding's hidden dim) makes GSPMD
+        propagate hidden-dim sharding into the activations and then
+        involuntarily full-rematerialize them back to batch/seq sharding at
+        every norm boundary.  ``zero_axes`` is the stage-dependent expansion
+        of the placeholder (empty → dropped): params expand it only at
+        stage ≥3, master at ≥1, grads at ≥2.
+
+        Sanitization is per-axis greedy (kv-head analog of reference
+        ``module_inject/tp_shard.py``): an explicit axis the dim can't divide
+        is dropped; zero axes are placed one by one while divisibility holds,
+        drawing from a pool that excludes axes the rule claims elsewhere
+        (e.g. 'ep' on expert params) and consuming placed axes so a
+        placeholder appearing on two dims can't double-place.
+
+        Returns ``(PartitionSpec, pinned)`` — ``pinned`` is True when the
+        rule contains a placeholder and its placement is settled (zero axes
+        landed, or there were none to place), i.e. the caller must not add
+        heuristic ZeRO sharding on top.
+        """
+        used = set()
+        for ax in spec:
+            for a in (ax if isinstance(ax, tuple) else (ax, )):
+                if a is not None and a != "zero":
+                    used.add(a)
+        pool = [a for a in zero_axes if a not in used]
+        wanted = any("zero" in (ax if isinstance(ax, tuple) else (ax, ))
+                     for ax in spec if ax is not None)
+        placed = False
         out = []
         for i, ax in enumerate(spec):
-            if ax is None or i >= len(shape):
-                out.append(None if i >= len(shape) else ax)
+            if ax is None or (shape is not None and i >= len(shape)):
+                out.append(None)
                 continue
             names = ax if isinstance(ax, tuple) else (ax, )
+            dim = None if shape is None else shape[i]
+            final, prod = [], 1
             for a in names:
-                if a not in self.mesh.shape:
+                if a == "zero":
+                    for z in list(pool):
+                        n = mesh.shape.get(z, 1)
+                        if n > 1 and (dim is None or dim % (prod * n) == 0):
+                            final.append(z)
+                            prod *= n
+                            pool.remove(z)
+                            placed = True
+                    continue
+                if a not in mesh.shape:
                     raise ValueError(
-                        f"tp_rules for {path!r} references axis {a!r} not in "
-                        f"mesh axes {tuple(self.mesh.shape)}")
-            n = int(np.prod([self.mesh.shape[a] for a in names], dtype=np.int64))
-            out.append(ax if shape[i] % n == 0 else None)
-        return P(*out)
+                        f"tp_rules references axis {a!r} not in mesh axes "
+                        f"{tuple(mesh.shape)}")
+                n = mesh.shape[a]
+                if dim is None or dim % (prod * n) == 0:
+                    final.append(a)
+                    prod *= n
+            out.append(tuple(final) if len(final) > 1
+                       else (final[0] if final else None))
+        return P(*out), (wanted and (placed or not zero_axes))
+
+    def _spec_for(self, shape, path, mesh, axes, enabled):
+        rule = (match_tp_rule(self.tp_rules, path)
+                if path is not None else None)
+        zero_axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        if rule is None:
+            base, pinned = None, False
+        else:
+            base, pinned = self._expand_rule(
+                rule, shape, zero_axes if enabled else (), mesh)
+        if not enabled:
+            return base if base is not None else P()
+        if pinned:
+            return base
+        # plain TP rule, no rule at all, or the pinned dim couldn't take any
+        # zero axis → heuristic (shard_spec re-excludes base-claimed axes)
+        return shard_spec(shape, mesh, axes, self.min_partition_size,
+                          base_spec=base)
 
     def param_spec(self, shape, path=None):
-        base = self._tp_base(path, shape)
-        if self.stage >= 3:
-            return shard_spec(shape, self.param_mesh, self.param_axes,
-                              self.min_partition_size, base_spec=base)
-        return base if base is not None else P()
+        return self._spec_for(shape, path, self.param_mesh, self.param_axes,
+                              self.stage >= 3)
 
     def master_spec(self, shape, path=None):
         """fp32 master weights + optimizer moments."""
-        base = self._tp_base(path, shape)
-        if self.stage >= 1:
-            return shard_spec(shape, self.state_mesh, self.state_axes,
-                              self.min_partition_size, base_spec=base)
-        return base if base is not None else P()
+        return self._spec_for(shape, path, self.state_mesh, self.state_axes,
+                              self.stage >= 1)
 
     def grad_spec(self, shape, path=None):
         """Gradient accumulator sharding. Stage ≥2 shards grads (the engine's
         micro-step constrains grad outputs to this, making XLA lower the DP
         psum to reduce-scatter)."""
-        base = self._tp_base(path, shape)
-        if self.stage >= 2:
-            return shard_spec(shape, self.state_mesh, self.state_axes,
-                              self.min_partition_size, base_spec=base)
-        return base if base is not None else P()
+        return self._spec_for(shape, path, self.state_mesh, self.state_axes,
+                              self.stage >= 2)
 
     # tree versions ---------------------------------------------------------
     def _memory_kind(self, offload):

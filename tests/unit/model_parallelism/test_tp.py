@@ -76,3 +76,80 @@ def test_tp_with_zero3_composes():
     losses, _, engine = _run(tp=2, stage=3)
     assert np.isfinite(losses[-1])
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------- zero-placeholder rules
+def test_zero_placeholder_pins_placement():
+    """Rules may pin the ZeRO shard with the 'zero' pseudo-axis; the plan
+    must expand it per stage and never add heuristic sharding on top."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitionPlan
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "sp", "tp"))
+    rules = {"q_proj/kernel": P(None, "tp", "zero"),
+             "embed_tokens/embedding": P(("tp", "zero"), None)}
+    plan = ZeroPartitionPlan(3, mesh, zero_axes=("dp", "sp"), tp_rules=rules)
+    # q/k/v: zero lands on the head dim, not the contracting dim
+    assert plan.param_spec((64, 4, 16), "m/q_proj/kernel") == \
+        P(None, "tp", ("dp", "sp"))
+    # embed: zero composes with tp on the vocab dim
+    assert plan.param_spec((256, 64), "m/embed_tokens/embedding") == \
+        P(("tp", "dp", "sp"), None)
+    # stage-dependent expansion: stage 1 params keep TP only
+    plan1 = ZeroPartitionPlan(1, mesh, zero_axes=("dp", "sp"), tp_rules=rules)
+    assert plan1.param_spec((64, 4, 16), "m/q_proj/kernel") == \
+        P(None, "tp", None)
+    assert plan1.master_spec((64, 4, 16), "m/q_proj/kernel") == \
+        P(None, "tp", ("dp", "sp"))
+
+
+def test_zero_placeholder_excludes_claimed_axes():
+    """Expansion must not duplicate an axis the rule claims elsewhere (e.g.
+    'ep' on expert params) — dup axes make NamedSharding reject the spec."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitionPlan
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "ep"))
+    rules = {"experts/*": P("ep"), "gate_proj/kernel": P(None, "zero")}
+    plan = ZeroPartitionPlan(3, mesh, zero_axes=("dp", "ep"), tp_rules=rules)
+    spec = plan.param_spec((8, 64, 128), "moe/experts/gate_proj/kernel")
+    # composed scope rule claims 'ep' on dim0; zero expansion may only use dp
+    assert spec == P("ep", None, "dp")
+
+
+def test_zero_placeholder_divisibility_fallback():
+    """If the pinned dim can't take the zero axes, fall back to the heuristic
+    instead of silently replicating."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitionPlan
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("dp", ))
+    rules = {"q_proj/kernel": P(None, None, "zero")}
+    plan = ZeroPartitionPlan(3, mesh, zero_axes=("dp", ), tp_rules=rules)
+    # head dim 4 % 8 != 0 → pin fails → heuristic shards dim0 (64 % 8 == 0)
+    spec = plan.param_spec((64, 2, 4), "m/q_proj/kernel")
+    assert spec == P("dp", None, None)
+    # partial divisibility: sp-sized factor fits even when the full group
+    # doesn't — greedy per-axis placement keeps what divides
+    devs2 = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2 = Mesh(devs2, ("dp", "sp"))
+    plan2 = ZeroPartitionPlan(3, mesh2, zero_axes=("dp", "sp"),
+                              tp_rules=rules)
+    spec2 = plan2.param_spec((64, 4, 2), "m/q_proj/kernel")
+    assert spec2 == P(None, None, "sp") or spec2 == P(None, None, ("sp", ))
+
+
+def test_inference_tp_rules_with_zero_placeholder():
+    """init_inference-style sharding must tolerate rules carrying 'zero'."""
+    from jax.sharding import Mesh
+    from deepspeed_tpu.module_inject.auto_tp import shard_params_for_tp
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("tp", ))
+    cfg = llama.llama_tiny(dtype="float32")
+    params = {"embed_tokens": {"embedding": jnp.zeros((256, 64))},
+              "layers_0": {"self_attn": {"q_proj": {
+                  "kernel": jnp.zeros((64, 4, 16))}}}}
+    out = shard_params_for_tp(params, mesh, llama.tp_rules(cfg))
+    specs = jax.tree_util.tree_map(lambda x: x.sharding.spec, out)
+    assert specs["layers_0"]["self_attn"]["q_proj"]["kernel"] == \
+        jax.sharding.PartitionSpec(None, "tp", None)
