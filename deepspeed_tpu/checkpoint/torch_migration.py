@@ -1,0 +1,269 @@
+"""Migrate torch-DeepSpeed checkpoints → universal layout (round-1 review
+item 7; reference format producers: ``runtime/engine.py:2723-2792`` name
+scheme, ``runtime/zero/stage_1_and_2.py state_dict()`` contents; reference
+consumer being mirrored: ``checkpoint/ds_to_universal.py:112
+extract_zero_shards`` / ``:232 merge``).
+
+Reads a ZeRO stage-0/1/2 checkpoint directory written by the torch
+DeepSpeed:
+
+    {tag}/mp_rank_00_model_states.pt          "module": model state_dict
+    {tag}/zero_pp_rank_{d}_mp_rank_00_optim_states.pt, one per dp rank:
+        sd["optimizer_state_dict"]:
+            "param_slice_mappings":  per group {name: fragment(start, numel)}
+            "base_optimizer_state":  {"state": per group {"exp_avg": flat,
+                                      "exp_avg_sq": flat[, "step": n]}}
+            "single_partition_of_fp32_groups": per group flat fp32 partition
+
+and reassembles full per-parameter fp32 weights + Adam moments by
+concatenating each rank's named fragments in dp order, then writes the
+universal layout (``ds_to_universal.py`` output contract) under TORCH→FLAX
+renaming so ``load_universal_checkpoint`` can resume the run on a TPU mesh.
+
+Unpickling note: those files reference ``deepspeed.utils.tensor_fragment.
+fragment_address`` — a namedtuple from a package this environment doesn't
+ship.  A shim module with a compatible namedtuple is registered before
+``torch.load`` so the files open WITHOUT the torch DeepSpeed installed.
+"""
+
+import collections
+import glob
+import json
+import os
+import re
+import sys
+import types
+
+import numpy as np
+
+from ..utils.logging import logger
+from .constants import DS_VERSION, UNIVERSAL_META, ZERO_FILE_PREFIX
+
+# compatible stand-in for deepspeed.utils.tensor_fragment.fragment_address
+fragment_address = collections.namedtuple("fragment_address",
+                                          ["numel", "start"])
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _unpickle_shims():
+    """Temporarily register shim modules so torch.load can resolve pickled
+    references into the (absent) torch-DeepSpeed package.
+
+    SCOPED: the shims are removed afterwards — a lingering fake ``deepspeed``
+    in ``sys.modules`` (with ``__spec__`` None) breaks every later
+    ``importlib.util.find_spec("deepspeed")`` (transformers probes exactly
+    that)."""
+    names = ("deepspeed", "deepspeed.utils", "deepspeed.utils.tensor_fragment",
+             "deepspeed.runtime", "deepspeed.runtime.fp16",
+             "deepspeed.runtime.fp16.loss_scaler",
+             "deepspeed.runtime.zero", "deepspeed.runtime.zero.config")
+
+    class _Anything:
+        """Accept any pickled construction (LossScaler etc.) — migration
+        only reads tensors and fragment maps."""
+
+        def __init__(self, *a, **k):
+            self.__dict__.update(k)
+
+        def __setstate__(self, state):
+            if isinstance(state, dict):
+                self.__dict__.update(state)
+
+    saved = {}
+    try:
+        for name in names:
+            saved[name] = sys.modules.get(name)
+            if saved[name] is None:
+                mod = types.ModuleType(name)
+                mod.__getattr__ = lambda attr, _c=_Anything: _c
+                sys.modules[name] = mod
+        # unconditional: hasattr would hit the _Anything __getattr__ fallback
+        sys.modules["deepspeed.utils.tensor_fragment"].fragment_address = \
+            fragment_address
+        yield
+    finally:
+        for name in names:
+            if saved.get(name) is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = saved[name]
+
+
+def _torch_load(path):
+    import torch
+    with _unpickle_shims():
+        return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_numpy(t):
+    import torch
+    if isinstance(t, torch.Tensor):
+        t = t.detach()
+        if t.dtype == torch.bfloat16:
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+def default_torch_to_flax(name, arr):
+    """Default torch-module → flax-module renaming:
+
+    ``a.b.weight`` [out, in] → ``a/b/kernel`` transposed; 1-D ``weight``
+    (norms) stays ``weight``; ``bias`` passes through; embeddings (≥2-D
+    ``weight`` on a module whose name mentions embed) keep [V, D] as
+    ``embedding``.  Return None to drop a key; supply a custom ``transform``
+    for models with other conventions.
+    """
+    parts = name.split(".")
+    leaf = parts[-1]
+    prefix = "/".join(parts[:-1])
+    if leaf == "weight":
+        if arr.ndim >= 2 and "embed" in name.lower():
+            return f"{prefix}/embedding", arr
+        if arr.ndim == 2:
+            return f"{prefix}/kernel", np.ascontiguousarray(arr.T)
+        return f"{prefix}/weight", arr
+    if leaf == "bias":
+        return f"{prefix}/bias", arr
+    return f"{prefix}/{leaf}", arr
+
+
+def _resolve_tag(ckpt_dir, tag):
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    return tag
+
+
+def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
+                             transform=default_torch_to_flax):
+    """Convert a torch-DeepSpeed ZeRO (stage ≤2) checkpoint into the
+    universal layout at ``output_dir``.  Returns ``output_dir``."""
+    tag = _resolve_tag(checkpoint_dir, tag)
+    root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no checkpoint at {root}")
+
+    model_files = sorted(glob.glob(os.path.join(root,
+                                                "mp_rank_*_model_states.pt")))
+    if not model_files:
+        raise FileNotFoundError(f"no mp_rank_*_model_states.pt under {root}")
+    if len(model_files) > 1:
+        raise NotImplementedError(
+            "TP-sharded torch checkpoints (mp>1) need merge_tp_slices — "
+            "stage ≤2 single-mp migration is supported")
+    model_sd = _torch_load(model_files[0])
+    module = model_sd.get("module", model_sd)
+    shapes = {k: tuple(v.shape) for k, v in module.items()
+              if hasattr(v, "shape")}
+
+    optim_files = sorted(
+        glob.glob(os.path.join(root, "*_optim_states.pt")),
+        key=lambda p: [int(x) for x in re.findall(r"rank_(\d+)", p)])
+
+    # named fragments per state, concatenated across dp ranks in rank order
+    state_parts = {"fp32": {}, "exp_avg": {}, "exp_avg_sq": {}}
+    step = None
+    for path in optim_files:
+        sd = _torch_load(path)
+        osd = sd.get("optimizer_state_dict", sd)
+        if "single_partition_of_fp32_groups" not in osd:
+            raise NotImplementedError(
+                f"{os.path.basename(path)} is not a stage ≤2 optim file "
+                "(stage-3 migration: not yet supported)")
+        slice_maps = osd["param_slice_mappings"]
+        base_state = osd["base_optimizer_state"]["state"]
+        fp32_groups = osd["single_partition_of_fp32_groups"]
+        for gid, mapping in enumerate(slice_maps):
+            flats = {"fp32": _to_numpy(fp32_groups[gid]),
+                     "exp_avg": _to_numpy(base_state[gid]["exp_avg"]),
+                     "exp_avg_sq": _to_numpy(base_state[gid]["exp_avg_sq"])}
+            if step is None and "step" in base_state[gid]:
+                step = int(_to_numpy(base_state[gid]["step"]))
+            for name, frag in mapping.items():
+                start, numel = int(frag.start), int(frag.numel)
+                for key, flat in flats.items():
+                    state_parts[key].setdefault(name, []).append(
+                        flat[start:start + numel])
+
+    zero_root = os.path.join(output_dir, ZERO_FILE_PREFIX)
+    os.makedirs(zero_root, exist_ok=True)
+    param_meta = {}
+    for name, shape in shapes.items():
+        if name not in state_parts["fp32"]:
+            logger.warning(f"migration: no optimizer fragments for {name} "
+                           "(frozen param?) — copying module weight")
+            full = {"fp32": _to_numpy(module[name]).reshape(shape)}
+        else:
+            full = {}
+            for key in state_parts:
+                flat = np.concatenate(state_parts[key][name])
+                numel = int(np.prod(shape))
+                if flat.size < numel:
+                    raise ValueError(
+                        f"{name}: fragments cover {flat.size} of {numel} "
+                        "elements — checkpoint incomplete?")
+                full[key] = flat[:numel].reshape(shape)
+        mapped = transform(name, full["fp32"])
+        if mapped is None:
+            continue
+        new_name, _ = mapped
+        pdir = os.path.join(zero_root, new_name)
+        os.makedirs(pdir, exist_ok=True)
+        for key, arr in full.items():
+            _, out = transform(name, arr)
+            np.save(os.path.join(pdir, f"{key}.npy"),
+                    out.astype(np.float32))
+        param_meta[new_name] = {"shape": list(mapped[1].shape),
+                                "dtype": "float32",
+                                "source": name}
+
+    meta = {
+        "engine_state": {"global_steps": model_sd.get("global_steps", 0)},
+        "step": step if step is not None else model_sd.get("global_steps", 0),
+        "params": param_meta,
+        "migrated_from": "torch-deepspeed",
+    }
+    with open(os.path.join(output_dir, UNIVERSAL_META), "w") as f:
+        json.dump(meta, f, indent=2)
+    from .. import __version__
+    with open(os.path.join(output_dir, DS_VERSION), "w") as f:
+        f.write(__version__)
+    logger.info(f"migrated {len(param_meta)} params from torch checkpoint "
+                f"{root} → {output_dir}")
+    return output_dir
+
+
+def load_torch_deepspeed_checkpoint(engine, checkpoint_dir, tag=None,
+                                    transform=default_torch_to_flax):
+    """One-call resume from a torch-DeepSpeed checkpoint: migrate into a
+    scratch universal directory, then ``load_universal_checkpoint``."""
+    import tempfile
+    from .universal_checkpoint import load_universal_checkpoint
+    with tempfile.TemporaryDirectory(prefix="ds_tpu_migrate_") as tmp:
+        migrate_torch_checkpoint(checkpoint_dir, tmp, tag=tag,
+                                 transform=transform)
+        return load_universal_checkpoint(engine, tmp)
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Migrate a torch-DeepSpeed ZeRO (stage ≤2) checkpoint "
+        "to the universal layout")
+    p.add_argument("--input_folder", required=True)
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    migrate_torch_checkpoint(args.input_folder, args.output_folder,
+                             tag=args.tag)
+    print(f"universal checkpoint written to {args.output_folder}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 from .comm import (all_gather, all_gather_into_tensor, all_reduce, all_to_all,
                    all_to_all_single, barrier, broadcast, configure,
-                   destroy_process_group, get_local_rank, get_rank,
+                   destroy_process_group, ensure_runtime_initialized,
+                   get_local_rank, get_rank,
                    get_world_group, get_world_size, init_distributed,
                    initialize_mesh_device, is_initialized, log_summary,
                    new_group, reduce_scatter, reduce_scatter_tensor)

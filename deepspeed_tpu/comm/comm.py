@@ -65,10 +65,13 @@ def timed_op(func):
             return func(*args, **kwargs)
         t0 = time.perf_counter()
         result = func(*args, **kwargs)
-        try:
-            result.block_until_ready()
-        except Exception:
-            pass
+        if comms_logger.sync_timing:
+            # opt-in: precise completion latency at the cost of serializing
+            # the async pipeline (round-1 review item 9 — no longer default)
+            try:
+                result.block_until_ready()
+            except Exception:
+                pass
         latency = time.perf_counter() - t0
         # Bind args so a positionally-passed group is still found.
         bound = sig.bind_partial(*args, **kwargs).arguments
@@ -80,6 +83,31 @@ def timed_op(func):
         return result
 
     return wrapper
+
+
+_jax_distributed_up = False
+
+
+def ensure_runtime_initialized():
+    """The multi-process half of ``init_distributed``: bring up
+    ``jax.distributed`` (COORDINATOR_ADDRESS rendezvous — the MASTER_ADDR
+    analog) WITHOUT touching the mesh.  MUST run before anything asks jax
+    for devices, else the backend initializes single-process and the global
+    device view never federates.  Idempotent."""
+    global _jax_distributed_up
+    if _jax_distributed_up:
+        return
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("JAX_PROCESS_COUNT",
+                               os.environ.get("WORLD_SIZE", "1")))
+    pid = int(os.environ.get("JAX_PROCESS_ID", os.environ.get("RANK", "0")))
+    if coord is not None and nproc > 1:
+        import jax
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+        logger.info(
+            f"jax.distributed initialized: process {pid}/{nproc} @ {coord}")
+    _jax_distributed_up = True
 
 
 def init_distributed(dist_backend=None, auto_mpi_discovery=True,
@@ -97,14 +125,7 @@ def init_distributed(dist_backend=None, auto_mpi_discovery=True,
     if is_initialized():
         return cdb
 
-    coord = os.environ.get("COORDINATOR_ADDRESS")
-    nproc = int(os.environ.get("JAX_PROCESS_COUNT", os.environ.get("WORLD_SIZE", "1")))
-    pid = int(os.environ.get("JAX_PROCESS_ID", os.environ.get("RANK", "0")))
-    if coord is not None and nproc > 1:
-        import jax
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_id=pid)
-        logger.info(f"jax.distributed initialized: process {pid}/{nproc} @ {coord}")
+    ensure_runtime_initialized()
 
     from ..accelerator import get_accelerator
     backend_name = dist_backend or get_accelerator().communication_backend_name()

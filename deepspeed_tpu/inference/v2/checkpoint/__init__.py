@@ -1,0 +1,7 @@
+"""Checkpoint ingestion for inference v2 (reference
+``inference/v2/checkpoint/``): pluggable engines yielding ``(name, array)``
+pairs, plus the HuggingFace safetensors/torch loader."""
+
+from .base_engine import CheckpointEngineBase
+from .in_memory_engine import InMemoryModelEngine
+from .huggingface_engine import HuggingFaceCheckpointEngine

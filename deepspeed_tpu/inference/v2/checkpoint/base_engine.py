@@ -1,0 +1,18 @@
+"""Checkpoint engine ABC (reference
+``inference/v2/checkpoint/base_engine.py``): one method, ``parameters()``,
+yielding ``(name, numpy array)`` in the source checkpoint's naming."""
+
+from abc import ABC, abstractmethod
+from typing import Iterable, Tuple
+
+import numpy as np
+
+
+class CheckpointEngineBase(ABC):
+
+    @abstractmethod
+    def parameters(self) -> Iterable[Tuple[str, np.ndarray]]:
+        """Yield ``(param_name, value)`` for every parameter in the
+        checkpoint.  Values are host numpy arrays (the model builder decides
+        device placement and sharding)."""
+        ...

@@ -1,0 +1,89 @@
+"""HuggingFace checkpoint ingestion (reference
+``inference/v2/checkpoint/huggingface_engine.py:16``).
+
+Reads a *local* HF model directory (zero-egress environment: no hub
+downloads) and yields ``(name, numpy)`` pairs from, in preference order:
+
+1. ``model.safetensors.index.json`` → sharded safetensors
+2. ``model.safetensors`` (or any ``*.safetensors`` glob)
+3. ``pytorch_model.bin[.index.json]`` → ``torch.load`` (cpu)
+
+Safetensors are read with ``safetensors.numpy`` — no torch in the loop, and
+bf16 tensors arrive as ml_dtypes bfloat16 without an fp32 detour.
+"""
+
+import glob
+import json
+import os
+from typing import Iterable, Tuple
+
+import numpy as np
+
+from ....utils.logging import logger
+from .base_engine import CheckpointEngineBase
+
+
+class HuggingFaceCheckpointEngine(CheckpointEngineBase):
+
+    def __init__(self, model_name_or_path: str, auth_token: str = None,
+                 **hf_kwargs):
+        if not os.path.isdir(model_name_or_path):
+            raise ValueError(
+                f"{model_name_or_path!r} is not a local directory — this "
+                "environment has no network egress; download the checkpoint "
+                "first (reference engine falls back to snapshot_download)")
+        self.model_name_or_path = model_name_or_path
+        self._config = None
+
+    @property
+    def model_config(self) -> dict:
+        """Parsed ``config.json``."""
+        if self._config is None:
+            path = os.path.join(self.model_name_or_path, "config.json")
+            with open(path) as f:
+                self._config = json.load(f)
+        return self._config
+
+    def _checkpoint_files(self):
+        root = self.model_name_or_path
+        for index_name, kind in (("model.safetensors.index.json", "st"),
+                                 ("pytorch_model.bin.index.json", "pt")):
+            index = os.path.join(root, index_name)
+            if os.path.exists(index):
+                with open(index) as f:
+                    weight_map = json.load(f)["weight_map"]
+                files = sorted({os.path.join(root, v)
+                                for v in weight_map.values()})
+                return files, kind
+        st = sorted(glob.glob(os.path.join(root, "*.safetensors")))
+        if st:
+            return st, "st"
+        pt = sorted(glob.glob(os.path.join(root, "pytorch_model*.bin")))
+        if pt:
+            return pt, "pt"
+        raise FileNotFoundError(
+            f"no safetensors or pytorch_model.bin under {root}")
+
+    def parameters(self) -> Iterable[Tuple[str, np.ndarray]]:
+        files, kind = self._checkpoint_files()
+        logger.info(f"HF checkpoint: {len(files)} {kind} shard(s) from "
+                    f"{self.model_name_or_path}")
+        if kind == "st":
+            from safetensors import safe_open
+            for path in files:
+                with safe_open(path, framework="np") as f:
+                    for name in f.keys():
+                        yield name, f.get_tensor(name)
+        else:
+            import torch
+            for path in files:
+                state = torch.load(path, map_location="cpu",
+                                   weights_only=True)
+                for name, tensor in state.items():
+                    t = tensor.detach()
+                    if t.dtype == torch.bfloat16:
+                        import ml_dtypes
+                        yield name, t.view(torch.uint16).numpy().view(
+                            ml_dtypes.bfloat16)
+                    else:
+                        yield name, t.numpy()

@@ -1,0 +1,23 @@
+"""In-memory checkpoint engine (reference
+``inference/v2/checkpoint/in_memory_engine.py``): wraps an already-loaded
+state dict / param tree for the model builders."""
+
+from typing import Iterable, Tuple
+
+import numpy as np
+
+from .base_engine import CheckpointEngineBase
+
+
+class InMemoryModelEngine(CheckpointEngineBase):
+
+    def __init__(self, state_dict):
+        """``state_dict``: mapping param name → array-like (torch tensors
+        are detached to numpy)."""
+        self._state = state_dict
+
+    def parameters(self) -> Iterable[Tuple[str, np.ndarray]]:
+        for name, value in self._state.items():
+            if hasattr(value, "detach"):  # torch tensor
+                value = value.detach().to("cpu").float().numpy()
+            yield name, np.asarray(value)

@@ -1,0 +1,50 @@
+"""Engine factory (reference ``inference/v2/engine_factory.py``:
+``build_hf_engine`` ``:69``, ``build_engine_from_ds_checkpoint`` ``:32``).
+
+``build_hf_engine(path)`` turns a local HF checkpoint directory into a
+serving-ready :class:`InferenceEngineV2` — config.json → model config,
+safetensors → flax params, ragged forward selected by architecture.
+"""
+
+from typing import Optional, Union
+
+from .checkpoint import (CheckpointEngineBase, HuggingFaceCheckpointEngine,
+                         InMemoryModelEngine)
+from .config_v2 import RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2
+from .model_implementations import build_model_and_params
+
+
+def build_hf_engine(path: str,
+                    engine_config: Optional[Union[
+                        dict, RaggedInferenceEngineConfig]] = None,
+                    debug_level: int = 0,
+                    **kwargs) -> InferenceEngineV2:
+    """Serve a HuggingFace checkpoint (reference ``engine_factory.py:69``).
+
+    ``path``: local model directory (config.json + safetensors / .bin).
+    """
+    if engine_config is None:
+        engine_config = RaggedInferenceEngineConfig(**kwargs)
+    elif isinstance(engine_config, dict):
+        engine_config = RaggedInferenceEngineConfig(**{**engine_config,
+                                                       **kwargs})
+    checkpoint = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(checkpoint,
+                                           dtype=engine_config.dtype)
+    return InferenceEngineV2(model, params=params, config=engine_config)
+
+
+def build_engine_from_checkpoint(checkpoint: CheckpointEngineBase,
+                                 model_config: dict,
+                                 engine_config: Optional[
+                                     RaggedInferenceEngineConfig] = None
+                                 ) -> InferenceEngineV2:
+    """Build from any checkpoint engine + an HF-style config dict (reference
+    ``build_engine_from_ds_checkpoint``)."""
+    if engine_config is None:
+        engine_config = RaggedInferenceEngineConfig()
+    checkpoint.model_config = model_config
+    model, params = build_model_and_params(checkpoint,
+                                           dtype=engine_config.dtype)
+    return InferenceEngineV2(model, params=params, config=engine_config)

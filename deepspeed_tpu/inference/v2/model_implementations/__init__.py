@@ -1,0 +1,11 @@
+"""Per-architecture model builders for inference v2 (reference
+``inference/v2/model_implementations/``: llama_v2, mistral, mixtral, qwen_v2
+policy/container classes).
+
+TPU redesign: instead of layer containers that map checkpoint params onto
+kernel atoms, each builder turns a checkpoint engine's ``(name, array)``
+stream into the flax param tree of the matching in-repo model (Llama family
+or Mixtral) — the ragged forward in ``ragged_forward.py`` then serves it.
+"""
+
+from .hf_builders import (SUPPORTED_MODEL_TYPES, build_model_and_params)

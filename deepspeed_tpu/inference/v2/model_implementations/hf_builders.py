@@ -1,0 +1,170 @@
+"""HF checkpoint → flax param tree builders (reference
+``inference/v2/model_implementations/*/`` policy+container classes, e.g.
+``llama_v2/policy.py``; the name mapping below replaces the reference's
+layer-container atom maps).
+
+Supported ``model_type``s: llama, mistral, qwen2 (Llama arch), mixtral
+(sparse MoE).  Torch linear weights are [out, in] — flax kernels are
+[in, out] — so every projection transposes; attention projections reshape to
+the model's [D, H, Dh] head layout.
+"""
+
+from typing import Dict, Iterable, Tuple
+
+import numpy as np
+
+from ....models.llama import LlamaConfig, LlamaModel
+from ....models.mixtral import MixtralConfig, MixtralModel
+from ....utils.logging import logger
+
+SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral")
+
+_SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
+
+
+def _llama_config_from_hf(cfg: dict, dtype: str) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        num_key_value_heads=cfg.get("num_key_value_heads",
+                                    cfg["num_attention_heads"]),
+        max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+        sliding_window=cfg.get("sliding_window") or 0,
+        attention_bias=cfg.get("attention_bias",
+                               cfg.get("model_type") == "qwen2"),
+        dtype=dtype, remat=False)
+
+
+def _mixtral_config_from_hf(cfg: dict, dtype: str) -> MixtralConfig:
+    base = _llama_config_from_hf(cfg, dtype)
+    from dataclasses import asdict
+    return MixtralConfig(
+        **asdict(base),
+        num_local_experts=cfg.get("num_local_experts", 8),
+        num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+        router_aux_loss_coef=cfg.get("router_aux_loss_coef", 0.02))
+
+
+def _set(tree: dict, path: Tuple[str, ...], value):
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def _attn_param(arr, key, H, Dh):
+    """q/k/v/o torch weights → DenseGeneral kernels/biases."""
+    if key == "o_proj.weight":          # [D, H*Dh] → [H*Dh, D]
+        return ("o_proj", "kernel"), np.ascontiguousarray(arr.T)
+    proj, kind = key.split(".")         # {q,k,v}_proj, weight|bias
+    if kind == "bias":                  # [H*Dh] → [H, Dh]
+        return (proj, "bias"), arr.reshape(H, Dh)
+    D = arr.shape[1]                    # weight [H*Dh, D] → [D, H, Dh]
+    return (proj, "kernel"), np.ascontiguousarray(arr.T).reshape(D, H, Dh)
+
+
+def _ingest_llama(model_cfg: LlamaConfig,
+                  params_iter: Iterable[Tuple[str, np.ndarray]]) -> dict:
+    H, Hkv, Dh = (model_cfg.num_attention_heads,
+                  model_cfg.num_key_value_heads, model_cfg.head_dim)
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES):
+            continue
+        if name == "lm_head.weight":
+            if not model_cfg.tie_word_embeddings:
+                _set(tree, ("lm_head", "kernel"),
+                     np.ascontiguousarray(arr.T))
+            continue
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            _set(tree, ("embed_tokens", "embedding"), arr)
+        elif name == "norm.weight":
+            _set(tree, ("norm", "weight"), arr)
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"layers_{idx}"
+            if rest.startswith("self_attn."):
+                key = rest.removeprefix("self_attn.")
+                heads = H if key.startswith(("q_", "o_")) else Hkv
+                sub, value = _attn_param(arr, key, heads, Dh)
+                _set(tree, (layer, "self_attn") + sub, value)
+            elif rest.startswith("mlp."):
+                proj = rest.split(".")[1]   # gate_proj|up_proj|down_proj
+                _set(tree, (layer, "mlp", proj, "kernel"),
+                     np.ascontiguousarray(arr.T))
+            elif rest in ("input_layernorm.weight",
+                          "post_attention_layernorm.weight"):
+                _set(tree, (layer, rest.split(".")[0], "weight"), arr)
+            else:
+                logger.warning(f"HF llama ingest: skipping {name}")
+        else:
+            logger.warning(f"HF llama ingest: skipping {name}")
+    return tree
+
+
+def _ingest_mixtral(model_cfg: MixtralConfig,
+                    params_iter: Iterable[Tuple[str, np.ndarray]]) -> dict:
+    """Llama mapping + block_sparse_moe → stacked-expert ``moe`` params."""
+    E, D, I = (model_cfg.num_local_experts, model_cfg.hidden_size,
+               model_cfg.intermediate_size)
+    passthrough = []
+    stacks: Dict[Tuple[str, str], np.ndarray] = {}
+
+    def route():
+        for name, arr in params_iter:
+            if ".block_sparse_moe." not in name:
+                passthrough.append((name, arr))
+                continue
+            prefix, rest = name.split(".block_sparse_moe.", 1)
+            layer = f"layers_{prefix.split('.')[-1]}"
+            if rest == "gate.weight":    # [E, D] → [D, E]
+                yield layer, ("gate",), np.ascontiguousarray(arr.T)
+            else:                        # experts.{e}.w{1,2,3}.weight
+                _, e, w, _ = rest.split(".")
+                shape = (E, I, D) if w == "w2" else (E, D, I)
+                stack = stacks.setdefault((layer, w),
+                                          np.empty(shape, dtype=arr.dtype))
+                stack[int(e)] = arr.T
+                continue
+
+    tree: Dict = {}
+    for layer, sub, value in route():
+        _set(tree, (layer, "moe", ) + sub + ("kernel", ), value)
+    for (layer, w), stack in stacks.items():
+        _set(tree, (layer, "moe", w), stack)
+    llama_tree = _ingest_llama(model_cfg, passthrough)
+    for layer, sub in llama_tree.items():
+        node = tree.setdefault(layer, {})
+        node.update(sub)
+    return tree
+
+
+def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
+    """(model, params) from a checkpoint engine with a ``model_config`` dict
+    (HF ``config.json``).  Reference analog: ``engine_factory.build_hf_engine``
+    dispatching on ``model_type`` (``engine_factory.py:69``)."""
+    hf_cfg = checkpoint_engine.model_config
+    model_type = hf_cfg.get("model_type", "llama")
+    if model_type not in SUPPORTED_MODEL_TYPES:
+        raise ValueError(
+            f"unsupported model_type {model_type!r} "
+            f"(supported: {SUPPORTED_MODEL_TYPES})")
+    if model_type == "mixtral":
+        cfg = _mixtral_config_from_hf(hf_cfg, dtype)
+        params = _ingest_mixtral(cfg, checkpoint_engine.parameters())
+        model = MixtralModel(cfg)
+    else:
+        cfg = _llama_config_from_hf(hf_cfg, dtype)
+        params = _ingest_llama(cfg, checkpoint_engine.parameters())
+        model = LlamaModel(cfg)
+    if cfg.sliding_window:
+        logger.info(f"{model_type}: sliding_window={cfg.sliding_window} "
+                    "(enforced in the ragged attention path)")
+    return model, params

@@ -40,16 +40,18 @@ def _rmsnorm(x, w, eps):
     return (x32 * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
 
 
-def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size):
+def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
+                     window=0):
     """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh]; tables_t: [T, maxb];
-    positions: [T].  Returns [T, H, Dh].
+    positions: [T]; window: sliding-window size (0 → full causal).
+    Returns [T, H, Dh].
 
     On TPU: the Pallas paged kernel (block pages streamed through VMEM via
     scalar-prefetched table indices).  Fallback: XLA gather of each token's
     block run with position masking."""
     import os
-    if jax.default_backend() == "tpu" and not os.environ.get(
-            "DS_TPU_DISABLE_PALLAS_PAGED"):
+    if (window == 0 and jax.default_backend() == "tpu"
+            and not os.environ.get("DS_TPU_DISABLE_PALLAS_PAGED")):
         from ...ops.pallas.paged_attention import paged_attention
         return paged_attention(q, k_cache, v_cache, tables_t, positions)
     T, H, Dh = q.shape
@@ -63,11 +65,44 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size):
     scores = jnp.einsum("tkgd,tckd->tkgc", qg,
                         k_ctx.astype(jnp.float32)) * (Dh**-0.5)
     pos_ctx = jnp.arange(ctx)[None, None, None, :]
-    mask = pos_ctx <= positions[:, None, None, None]
+    pos_q = positions[:, None, None, None]
+    mask = pos_ctx <= pos_q
+    if window:
+        mask &= pos_ctx > pos_q - window
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgc,tckd->tkgd", probs, v_ctx.astype(jnp.float32))
     return out.reshape(T, H, Dh).astype(q.dtype)
+
+
+def _qkv(h, proj, dtype):
+    """DenseGeneral [T, D] → [T, H, Dh] with optional bias (Qwen2)."""
+    y = jnp.einsum("td,dhk->thk", h, proj["kernel"].astype(dtype))
+    if "bias" in proj:
+        y = y + proj["bias"].astype(dtype)
+    return y
+
+
+def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
+                            positions, cos, sin, *, cfg, block_size):
+    """Shared attention sub-block: qkv → rotary → cache scatter → paged
+    attention → output projection.  Returns (attn_out [T, D], new kv_layer).
+    kv_layer: [2, num_blocks, bs, Hkv, Dh]."""
+    dtype = jnp.dtype(cfg.dtype)
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    q = _qkv(h, lp_attn["q_proj"], dtype)
+    k = _qkv(h, lp_attn["k_proj"], dtype)
+    v = _qkv(h, lp_attn["v_proj"], dtype)
+    q = _rotary(q, cos, sin, positions)
+    k = _rotary(k, cos, sin, positions)
+    kv_layer = kv_layer.at[0, blk, off].set(k.astype(kv_layer.dtype))
+    kv_layer = kv_layer.at[1, blk, off].set(v.astype(kv_layer.dtype))
+    out = _paged_attention(q, kv_layer[0], kv_layer[1], tables_t,
+                           positions, block_size,
+                           window=getattr(cfg, "sliding_window", 0))
+    o = out.reshape(out.shape[0], H * Dh)
+    return jnp.einsum("tf,fd->td", o,
+                      lp_attn["o_proj"]["kernel"].astype(dtype)), kv_layer
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
@@ -103,39 +138,75 @@ def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
 
     for l in range(cfg.num_hidden_layers):
         lp = params[f"layers_{l}"]
-        attn, mlp = lp["self_attn"], lp["mlp"]
+        mlp = lp["mlp"]
         h = _rmsnorm(x, lp["input_layernorm"]["weight"], eps)
-        q = jnp.einsum("td,dhk->thk", h,
-                       attn["q_proj"]["kernel"].astype(dtype))
-        k = jnp.einsum("td,dhk->thk", h,
-                       attn["k_proj"]["kernel"].astype(dtype))
-        v = jnp.einsum("td,dhk->thk", h,
-                       attn["v_proj"]["kernel"].astype(dtype))
-        q = _rotary(q, cos, sin, positions)
-        k = _rotary(k, cos, sin, positions)
         # scatter this batch's K/V into the paged cache (linear_blocked_kv_
         # rotary analog), then attend against the updated pages
-        kv_data = kv_data.at[l, 0, blk, off].set(k.astype(kv_data.dtype))
-        kv_data = kv_data.at[l, 1, blk, off].set(v.astype(kv_data.dtype))
-        out = _paged_attention(q, kv_data[l, 0], kv_data[l, 1], tables_t,
-                               positions, block_size)
-        o = out.reshape(out.shape[0], H * Dh)
-        x = x + jnp.einsum("tf,fd->td", o,
-                           attn["o_proj"]["kernel"].astype(dtype))
+        attn_out, kv_layer = _ragged_attention_block(
+            lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
+            cos, sin, cfg=cfg, block_size=block_size)
+        kv_data = kv_data.at[l].set(kv_layer)
+        x = x + attn_out
         h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
         gate = h2 @ mlp["gate_proj"]["kernel"].astype(dtype)
         up = h2 @ mlp["up_proj"]["kernel"].astype(dtype)
         x = x + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(
             dtype)
 
+    return _lm_head(params, x, last_token_idx, cfg), kv_data
+
+
+def _lm_head(params, x, last_token_idx, cfg):
+    """logits_gather analog: only each slot's last token reaches the head."""
+    eps = cfg.rms_norm_eps
     x = _rmsnorm(x, params["norm"]["weight"], eps)
-    # logits_gather analog: only each slot's last token reaches the LM head
     xl = x[last_token_idx].astype(jnp.float32)               # [max_seqs, D]
     if cfg.tie_word_embeddings:
-        logits = xl @ params["embed_tokens"]["embedding"].T.astype(jnp.float32)
-    else:
-        logits = xl @ params["lm_head"]["kernel"].astype(jnp.float32)
-    return logits, kv_data
+        return xl @ params["embed_tokens"]["embedding"].T.astype(jnp.float32)
+    return xl @ params["lm_head"]["kernel"].astype(jnp.float32)
 
 
-RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step}
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
+                   donate_argnums=(1, ))
+def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
+                        block_tables, last_token_idx, *, cfg, block_size):
+    """One ragged engine iteration for Mixtral (reference
+    ``inference/v2/model_implementations/mixtral/``): Llama attention skeleton
+    with the MLP replaced by the exact top-k sparse MoE (``moe_apply`` —
+    grouped ``ragged_dot`` over tokens sorted by expert, no token dropping)."""
+    from ...models.mixtral import moe_apply
+
+    dtype = jnp.dtype(cfg.dtype)
+    eps = cfg.rms_norm_eps
+    cos, sin = _rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                           cfg.rope_theta)
+    cos = jnp.asarray(cos, jnp.float32)
+    sin = jnp.asarray(sin, jnp.float32)
+
+    x = params["embed_tokens"]["embedding"][token_ids].astype(dtype)
+    tables_t = block_tables[seq_slots]
+    blk = tables_t[jnp.arange(token_ids.shape[0]),
+                   positions // block_size]
+    off = positions % block_size
+
+    for l in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{l}"]
+        h = _rmsnorm(x, lp["input_layernorm"]["weight"], eps)
+        attn_out, kv_layer = _ragged_attention_block(
+            lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
+            cos, sin, cfg=cfg, block_size=block_size)
+        kv_data = kv_data.at[l].set(kv_layer)
+        x = x + attn_out
+        h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
+        moe = lp["moe"]
+        router_logits = (h2.astype(jnp.float32)
+                         @ moe["gate"]["kernel"].astype(jnp.float32))
+        x = x + moe_apply(h2, router_logits,
+                          moe["w1"].astype(dtype), moe["w2"].astype(dtype),
+                          moe["w3"].astype(dtype), cfg.num_experts_per_tok)
+
+    return _lm_head(params, x, last_token_idx, cfg), kv_data
+
+
+RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step,
+                   "MixtralModel": mixtral_ragged_step}

@@ -21,7 +21,9 @@ class LoRAConfig:
 
 @dataclass
 class QuantizationConfig:
-    """Reference ``linear/config.py:37``."""
+    """Reference ``linear/config.py:37`` (+ ``q_dtype`` selecting the int
+    blockwise kernels vs the FP6-LLM-style float formats)."""
     q_bits: int = 8
     mantissa_bits: int = 3
     group_size: int = 512
+    q_dtype: str = "int"  # "int" (blockwise int8/4) | "fp" (e4m3/e3m2/e4m7)

@@ -1,24 +1,50 @@
 """Quantized parameter container (reference ``linear/quantization.py``
-``QuantizedParameter``): weights stored int8 + per-group scales, dequantized
-on use.  Uses the blockwise quantizer kernel (``ops/pallas/quantizer``)."""
+``QuantizedParameter``): weights stored quantized + per-group scales,
+dequantized on use.
+
+Formats (reference parametrization ``q_bits``/``mantissa_bits``):
+  * ``q_dtype="int"`` — symmetric int8/int4 via the Pallas blockwise
+    quantizer (``ops/pallas/quantizer``);
+  * ``q_dtype="fp"`` — FP8 e4m3 / FP6 e3m2 / FP12 via ``ops/fp_quantizer``
+    (FP6-LLM-style weight-only quant, reference ``csrc/fp_quantizer``):
+    6-bit weights pack 4→3 bytes → 0.75 B/value.
+"""
 
 import jax.numpy as jnp
 
+from ..ops.fp_quantizer import dequantize_fp, quantize_fp
 from ..ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
 from .config import QuantizationConfig
 
 
 class QuantizedParameter:
     """Host-side container: ``quantize`` once, ``dequantized()`` per use.
-    2× (int8) memory saving on frozen base weights."""
+    2× (int8) / 2.7× (fp6) memory saving on frozen base weights."""
+
+    # canonical mantissa widths (must agree with zeropp._FP_FORMATS): fp8 =
+    # e4m3, fp6 = e3m2 (FP6-LLM), fp12 = e4m7.  The config's mantissa_bits
+    # (default 3) applies to 8-bit; narrower formats use their canonical
+    # layout or packed buffers would decode under the wrong bit split.
+    _CANONICAL_MANTISSA = {6: 2, 12: 7}
 
     def __init__(self, data, quant_config: QuantizationConfig = None):
         self.quant_config = quant_config or QuantizationConfig()
-        self.q, self.scales, self.meta = quantize_blockwise(
-            jnp.asarray(data), num_bits=self.quant_config.q_bits,
-            group_size=self.quant_config.group_size)
+        cfg = self.quant_config
+        self._fp = getattr(cfg, "q_dtype", "int") == "fp" or cfg.q_bits in (6, 12)
+        if self._fp:
+            mantissa = self._CANONICAL_MANTISSA.get(cfg.q_bits,
+                                                    cfg.mantissa_bits)
+            self.q, self.scales, self.meta = quantize_fp(
+                jnp.asarray(data), q_bits=cfg.q_bits,
+                mantissa_bits=mantissa, group_size=cfg.group_size)
+        else:
+            self.q, self.scales, self.meta = quantize_blockwise(
+                jnp.asarray(data), num_bits=cfg.q_bits,
+                group_size=cfg.group_size)
 
     def dequantized(self):
+        if self._fp:
+            return dequantize_fp(self.q, self.scales, self.meta)
         return dequantize_blockwise(self.q, self.scales, self.meta)
 
     @property

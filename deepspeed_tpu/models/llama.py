@@ -43,6 +43,8 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    sliding_window: int = 0       # 0 → full causal (Mistral sets 4096)
+    attention_bias: bool = False  # Qwen2-style q/k/v biases
     dtype: str = "bfloat16"
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
@@ -122,9 +124,11 @@ class LlamaAttention(nn.Module):
                       cfg.head_dim)
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=dtype,
                         param_dtype=jnp.float32)
-        q = dense(features=(H, Dh), name="q_proj")(x)
-        k = dense(features=(Hkv, Dh), name="k_proj")(x)
-        v = dense(features=(Hkv, Dh), name="v_proj")(x)
+        qkv = partial(nn.DenseGeneral, use_bias=cfg.attention_bias,
+                      dtype=dtype, param_dtype=jnp.float32)
+        q = qkv(features=(H, Dh), name="q_proj")(x)
+        k = qkv(features=(Hkv, Dh), name="k_proj")(x)
+        v = qkv(features=(Hkv, Dh), name="v_proj")(x)
 
         cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
         cos, sin = jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32)

@@ -1,0 +1,95 @@
+"""Per-architecture injection policies (reference
+``module_inject/containers/`` — bert…llama2, and
+``replace_module.py:183 replace_transformer_layer``).
+
+The reference's containers map a HuggingFace module tree onto fused CUDA
+kernel modules, arch by arch.  The TPU equivalent replaces *modules* rather
+than kernels: each policy names
+
+* the in-repo TPU-optimized model class (Pallas flash-attention, fused XLA
+  blocks) serving that architecture,
+* the HF checkpoint ingestion that fills it
+  (``inference/v2/model_implementations/hf_builders``),
+* the TP sharding rules (dataflow parser or hand rules).
+
+``replace_transformer_layer(orig_cls_or_name, checkpoint_dir, ...)`` is the
+reference-shaped entry: given an HF arch name + local checkpoint, it returns
+a ready (model, params) pair — the whole "kernel injection" in one step,
+because on TPU the fused kernels live inside the model definition and XLA.
+"""
+
+from typing import Callable, NamedTuple, Optional
+
+from ..utils.logging import logger
+
+
+class InjectionPolicy(NamedTuple):
+    model_type: str             # HF config.json model_type
+    model_factory: Callable     # config dict → flax module
+    supports_training: bool = True
+
+
+def _llama_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _llama_config_from_hf)
+    from ..models.llama import LlamaModel
+    return LlamaModel(_llama_config_from_hf(hf_cfg, dtype))
+
+
+def _mixtral_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _mixtral_config_from_hf)
+    from ..models.mixtral import MixtralModel
+    return MixtralModel(_mixtral_config_from_hf(hf_cfg, dtype))
+
+
+# arch aliases the reference keeps one container file per entry for
+# (containers/llama.py, llama2, distil_llama, …): here one policy serves a
+# family because the flax model is config-parametrized.
+POLICIES = {
+    "llama": InjectionPolicy("llama", _llama_factory),
+    "llama2": InjectionPolicy("llama", _llama_factory),
+    "mistral": InjectionPolicy("mistral", _llama_factory),
+    "qwen2": InjectionPolicy("qwen2", _llama_factory),
+    "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
+}
+
+
+def policy_for(arch_or_model) -> Optional[InjectionPolicy]:
+    """Resolve a policy from an arch name, HF config, or torch/flax module
+    class name (reference ``replace_module.py`` policy lookup)."""
+    if isinstance(arch_or_model, str):
+        key = arch_or_model.lower()
+    elif isinstance(arch_or_model, dict):
+        key = arch_or_model.get("model_type", "").lower()
+    else:
+        key = type(arch_or_model).__name__.lower()
+        for name in POLICIES:
+            if name in key:
+                key = name
+                break
+    return POLICIES.get(key)
+
+
+def replace_transformer_layer(arch_or_model, checkpoint_dir=None,
+                              dtype="bfloat16", config=None):
+    """Reference-shaped injection entry (``replace_module.py:183``): swap an
+    architecture for its TPU-optimized implementation, loading weights from
+    a local HF checkpoint when given.  Returns ``(model, params)`` (params
+    None when no checkpoint)."""
+    policy = policy_for(arch_or_model if config is None else config)
+    if policy is None:
+        raise ValueError(
+            f"no injection policy for {arch_or_model!r} "
+            f"(have: {sorted(POLICIES)}); pass the model through unchanged "
+            "or add a policy")
+    if checkpoint_dir is not None:
+        from ..inference.v2.checkpoint import HuggingFaceCheckpointEngine
+        from ..inference.v2.model_implementations import build_model_and_params
+        engine = HuggingFaceCheckpointEngine(checkpoint_dir)
+        return build_model_and_params(engine, dtype=dtype)
+    if config is None:
+        raise ValueError("need either checkpoint_dir or an HF config dict")
+    model = policy.model_factory(config, dtype=dtype)
+    logger.info(f"injected TPU-optimized {policy.model_type} implementation")
+    return model, None

@@ -1,0 +1,287 @@
+"""Dataflow tensor-parallel parser (reference ``module_inject/auto_tp.py:273
+tp_parser`` + ``:330 _replace``).
+
+The reference walks the torch module graph to find "linears followed by an
+all-reduce point".  The TPU-native equivalent walks the model's **jaxpr**: a
+taint analysis tracks which kernel parameters each activation derives from,
+and a residual ``add`` merging two differently-tainted branches is the
+all-reduce point —
+
+* the kernel that *produced* the merged operand (the last matmul on that
+  branch) is ROW-parallel (shard its contracting/input dim; XLA inserts the
+  psum the reference codes as ``LinearAllreduce``);
+* every other kernel in the branch's taint is COLUMN-parallel (shard its
+  output dim);
+* params consumed by gathers (embeddings) are vocab-sharded;
+* anything the analysis can't reach falls back to the name heuristics in
+  ``auto_tp.AutoTP`` (the reference keeps per-arch policy lists for the same
+  reason).
+
+Works on any traceable ``apply(params, *inputs)`` — no per-arch containers
+needed for the zoo models (bert/gpt2/llama/mixtral traced in tests).
+"""
+
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.zero.partition import path_str
+from ..utils.logging import logger
+
+
+class _State(NamedTuple):
+    """Dataflow fact for one jaxpr var."""
+    taint: frozenset          # kernel param ids since the last residual merge
+    last_kernel: Optional[int]  # id of the matmul kernel that produced it
+    param: Optional[int]        # id if var is a pure transform of ONE param
+
+_EMPTY = _State(frozenset(), None, None)
+
+_ELEMENTWISE_PASS = {
+    "convert_element_type", "reshape", "transpose", "broadcast_in_dim",
+    "squeeze", "slice", "dynamic_slice", "rev", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "neg", "sign", "abs", "max", "min", "pow",
+    "integer_pow", "erf", "cbrt", "concatenate", "pad", "stop_gradient",
+    "reduce_max", "reduce_sum", "reduce_min", "div", "sub", "select_n",
+    "exp2", "copy", "cumsum", "cumlogsumexp", "custom_jvp_call",
+    "dynamic_update_slice", "iota", "gather", "clamp", "and", "or", "not",
+    "eq", "ne", "lt", "le", "gt", "ge", "argmax", "argmin", "reduce_and",
+    "reduce_or",
+}
+
+
+class TpParser:
+    """One-shot parser: ``TpParser().parse(apply_fn, params, *inputs)`` →
+    {"column": [paths], "row": [paths], "embed": [paths]}."""
+
+    def __init__(self):
+        self.kernel_class: Dict[int, str] = {}   # param id → column|row|embed
+        self.param_paths: Dict[int, str] = {}
+
+    # ------------------------------------------------------------ plumbing
+    def parse(self, apply_fn, params, *inputs):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        paths = [path_str(kp) for kp, _ in
+                 jax.tree_util.tree_leaves_with_path(params)]
+        self.param_paths = dict(enumerate(paths))
+
+        def flat_fn(flat_params, *ins):
+            p = jax.tree_util.tree_unflatten(treedef, flat_params)
+            return apply_fn(p, *ins)
+
+        closed = jax.make_jaxpr(flat_fn)(leaves, *inputs)
+        jaxpr = closed.jaxpr
+        env: Dict = {}
+        for i, v in enumerate(jaxpr.invars[:len(leaves)]):
+            env[v] = _State(frozenset(), None, i)
+        for v in jaxpr.invars[len(leaves):]:
+            env[v] = _EMPTY
+        self._walk(jaxpr, env)
+        out = {"column": [], "row": [], "embed": [], "router": [],
+               "expert_column": [], "expert_row": []}
+        for pid, cls in self.kernel_class.items():
+            out[cls].append(self.param_paths[pid])
+        return out
+
+    def _read(self, env, atom):
+        if hasattr(atom, "val"):  # Literal
+            return _EMPTY
+        return env.get(atom, _EMPTY)
+
+    def _walk(self, jaxpr, env):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            sub = self._subjaxpr(eqn)
+            if sub is not None:
+                self._recurse(eqn, sub, env)
+                continue
+            states = [self._read(env, a) for a in eqn.invars]
+            if name == "dot_general":
+                out = self._dot(states)
+            elif name == "ragged_dot" or name == "ragged_dot_general":
+                out = self._ragged_dot(states)
+            elif name in ("add", "add_any"):
+                out = self._add(states)
+            elif name == "mul":
+                out = self._mul(states)
+            elif name == "gather" or name == "take":
+                out = self._gather(states)
+            elif name in ("sort", "top_k", "argsort"):
+                # routers: a kernel whose output drives token routing is
+                # gating logic, not a shardable linear — keep it replicated
+                for s in states:
+                    for k in s.taint:
+                        if self.kernel_class.get(k) == "column":
+                            self.kernel_class[k] = "router"
+                out = self._passthrough(states, keep_last=False)
+            else:
+                keep = name in _ELEMENTWISE_PASS
+                out = self._passthrough(states, keep_last=keep)
+            for ov in eqn.outvars:
+                env[ov] = out
+
+    def _subjaxpr(self, eqn):
+        for key in ("jaxpr", "call_jaxpr"):
+            if key in eqn.params:
+                j = eqn.params[key]
+                return getattr(j, "jaxpr", j)
+        if eqn.primitive.name == "scan":
+            return None  # handled as passthrough (stacked-layer scan: the
+            # block is uniform; callers parse the unstacked block instead)
+        return None
+
+    def _recurse(self, eqn, sub, env):
+        inner_env = {}
+        n = min(len(sub.invars), len(eqn.invars))
+        # align trailing invars (leading invars may be consts)
+        for iv, at in zip(sub.invars[len(sub.invars) - n:],
+                          eqn.invars[len(eqn.invars) - n:]):
+            inner_env[iv] = self._read(env, at)
+        self._walk(sub, inner_env)
+        for ov, sov in zip(eqn.outvars, sub.outvars):
+            env[ov] = self._read(inner_env, sov)
+
+    # ------------------------------------------------------------ transfer
+    def _passthrough(self, states, keep_last=True):
+        taint = frozenset().union(*[s.taint for s in states]) \
+            if states else frozenset()
+        params = {s.param for s in states if s.param is not None}
+        lasts = {s.last_kernel for s in states if s.last_kernel is not None}
+        # a pure-param transform stays param-pure only when nothing else
+        # contributes taint
+        param = params.pop() if len(params) == 1 and not taint else None
+        last = lasts.pop() if keep_last and len(lasts) == 1 else None
+        return _State(taint, last, param)
+
+    def _dot(self, states):
+        a, b = states[0], states[1]
+        if b.param is not None and a.param is None:
+            act, kernel = a, b.param
+        elif a.param is not None and b.param is None:
+            act, kernel = b, a.param
+        else:
+            # activation×activation (attention scores etc.): merge taints,
+            # no owning kernel
+            return _State(a.taint | b.taint, None, None)
+        self.kernel_class.setdefault(kernel, "column")
+        return _State(act.taint | {kernel}, kernel, None)
+
+    def _ragged_dot(self, states):
+        """Grouped expert matmul (``jax.lax.ragged_dot``): stacked expert
+        kernels [E, in, out].  The first expert matmuls on a branch are
+        expert-column; one consuming already-expert-tainted activations is
+        the down-projection — expert-row."""
+        a, b = states[0], states[1]
+        if b.param is not None:
+            act, kernel = a, b.param
+        elif a.param is not None:
+            act, kernel = b, a.param
+        else:
+            return _State(a.taint | b.taint, None, None)
+        expert_ids = {k for k in act.taint
+                      if self.kernel_class.get(k, "").startswith("expert")}
+        cls = "expert_row" if expert_ids else "expert_column"
+        self.kernel_class.setdefault(kernel, cls)
+        return _State(act.taint | {kernel}, kernel, None)
+
+    def _add(self, states):
+        a, b = states[0], states[1]
+        # bias add (one side param-pure) → passthrough
+        if a.param is not None and not a.taint:
+            return b
+        if b.param is not None and not b.taint:
+            return a
+        if a.taint != b.taint and (a.last_kernel is not None
+                                   or b.last_kernel is not None):
+            # residual merge = the all-reduce point: the matmul that produced
+            # a merged branch is the reference's "LinearAllreduce" linear
+            # (covers the first block too, where the residual stream is a
+            # taint-free embedding)
+            for s in (a, b):
+                if s.last_kernel is not None:
+                    self.kernel_class[s.last_kernel] = "row"
+            return _State(frozenset(), None, None)
+        return self._passthrough(states)
+
+    def _mul(self, states):
+        a, b = states[0], states[1]
+        # scale-by-param (norm weights) → passthrough of the activation
+        if a.param is not None and not a.taint:
+            return b
+        if b.param is not None and not b.taint:
+            return a
+        # gating (silu(gate)·up): union, no single producer
+        return _State(a.taint | b.taint, None, None)
+
+    def _gather(self, states):
+        src = states[0]
+        if src.param is not None:
+            self.kernel_class.setdefault(src.param, "embed")
+            return _State(frozenset(), None, None)
+        return self._passthrough(states)
+
+
+def derive_tp_rules_from_dataflow(apply_fn, params, *inputs, tp_axis="tp",
+                                  with_zero_pin=True):
+    """Rule table (param-path suffix → PartitionSpec) from the dataflow
+    classification; unclassified linears fall back to name heuristics
+    (``AutoTP.derive_rules``).
+
+    ``with_zero_pin`` appends the ``"zero"`` placeholder the way hand-written
+    model rules do (``models/llama.py tp_rules``) so ZeRO never lands on a
+    contracting dim.
+    """
+    classes = TpParser().parse(apply_fn, params, *inputs)
+    shapes = {path_str(kp): getattr(leaf, "shape", ())
+              for kp, leaf in jax.tree_util.tree_leaves_with_path(params)}
+    z = ("zero", ) if with_zero_pin else ()
+    rules = {}
+
+    def spec_for(path, cls):
+        nd = len(shapes[path])
+        if cls == "embed":
+            return P((tp_axis, ) + z, *([None] * (nd - 1)))
+        if cls == "router":
+            return P(*([None] * nd))  # gating logits: keep replicated
+        if cls == "expert_column":   # stacked [E, in, out]
+            return P("ep", None, (tp_axis, ) + z)
+        if cls == "expert_row":      # stacked [E, in, out] (in=contracting)
+            return P("ep", (tp_axis, ) + z, None)
+        if cls == "column":
+            if nd == 3:      # DenseGeneral [D, H, Dh]: shard heads
+                return P(None, tp_axis, *z) if z else P(None, tp_axis, None)
+            return P(*([None] * (nd - 1)), (tp_axis, ) + z)
+        # row: contracting is the leading dim; pin zero on the output dim
+        rest = z + (None, ) * max(nd - 1 - len(z), 0)
+        return P(tp_axis, *rest)
+
+    for cls in ("embed", "column", "row", "router", "expert_column",
+                "expert_row"):
+        for path in classes[cls]:
+            parts = path.split("/")
+            suffix = "/".join(parts[-2:]) if len(parts) >= 2 else path
+            spec = spec_for(path, cls)
+            prev = rules.get(suffix)
+            if prev is not None and prev != spec:
+                logger.warning("tp_parser: conflicting specs for %s (%s vs "
+                               "%s) — keeping first", suffix, prev, spec)
+                continue
+            rules[suffix] = spec
+    # biases of column-parallel layers follow the kernel's output shard
+    # (zero pin stripped — biases are too small to zero-shard usefully)
+    def _strip_zero(ax):
+        names = tuple(a for a in (ax if isinstance(ax, tuple) else (ax, ))
+                      if a not in (None, "zero"))
+        return names if len(names) > 1 else (names[0] if names else None)
+
+    for path, shape in shapes.items():
+        if path.endswith("/bias"):
+            suffix = "/".join(path.split("/")[-2:])
+            kspec = rules.get(suffix[:-5] + "/kernel")
+            if kspec and len(shape) + 1 == len(tuple(kspec)):
+                rules[suffix[:-5] + "/bias"] = P(
+                    *[_strip_zero(a) for a in tuple(kspec)[1:]])
+    return rules

@@ -1,0 +1,86 @@
+"""Expert-parallel checkpoint layout (reference ``engine.py:3241
+_save_moe_checkpoint`` / ``:3007 _get_moe_state_dicts``: experts saved as
+one file per (layer, expert) so EP-degree can change on load).
+
+Here experts are STACKED arrays (leading E dim sharded over "ep"), so the
+engine checkpoint already holds global expert weights and resumes at any EP
+degree — this module provides the *interchange* layout: explode stacks into
+per-expert files (reference naming ``layer_{L}_expert_{E}_...``) and
+reassemble them, so expert weights can be moved to/from systems that store
+experts separately."""
+
+import os
+import re
+
+import numpy as np
+
+import jax
+
+from ..runtime.zero.partition import path_str
+from ..utils.logging import logger
+
+# paths that hold stacked expert params: anything under an "experts" scope
+# (moe/layer.py vmapped Experts) or mixtral's stacked w1/w2/w3
+_EXPERT_PAT = re.compile(r"(^|/)experts(/|$)|(^|/)moe/w[123]$")
+_LAYER_PAT = re.compile(r"(?:^|/)layers?_(\d+)(?:/|$)")
+
+
+def is_expert_path(path):
+    return bool(_EXPERT_PAT.search(path))
+
+
+def _layer_of(path):
+    m = _LAYER_PAT.search(path)
+    return int(m.group(1)) if m else 0
+
+
+def save_moe_expert_files(params, save_dir, tag="exported"):
+    """Explode stacked expert leaves into per-(layer, expert) npz files.
+    Returns the list of files written."""
+    root = os.path.join(save_dir, tag)
+    os.makedirs(root, exist_ok=True)
+    per_file = {}
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(params):
+        path = path_str(kp)
+        if not is_expert_path(path):
+            continue
+        arr = np.asarray(leaf)
+        layer = _layer_of(path)
+        for e in range(arr.shape[0]):
+            fname = f"layer_{layer}_expert_{e}_model_states.npz"
+            per_file.setdefault(fname, {})[path] = arr[e]
+    files = []
+    for fname, tree in per_file.items():
+        out = os.path.join(root, fname)
+        np.savez(out, **tree)
+        files.append(out)
+    logger.info(f"saved {len(files)} expert files to {root}")
+    return files
+
+
+def load_moe_expert_files(params, load_dir, tag="exported"):
+    """Reassemble per-expert files into the stacked leaves of ``params``
+    (non-expert leaves pass through).  Returns the updated pytree."""
+    root = os.path.join(load_dir, tag)
+    stacks = {}
+    for fname in sorted(os.listdir(root)):
+        m = re.match(r"layer_(\d+)_expert_(\d+)_model_states\.npz", fname)
+        if not m:
+            continue
+        e = int(m.group(2))
+        with np.load(os.path.join(root, fname)) as data:
+            for path in data.files:
+                stacks.setdefault(path, {})[e] = data[path]
+
+    def rebuild(kp, leaf):
+        path = path_str(kp)
+        if path not in stacks:
+            return leaf
+        by_e = stacks[path]
+        arr = np.stack([by_e[e] for e in sorted(by_e)])
+        if arr.shape != leaf.shape:
+            raise ValueError(f"{path}: expert files give {arr.shape}, "
+                             f"model expects {leaf.shape}")
+        return jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+
+    return jax.tree_util.tree_map_with_path(rebuild, params)

@@ -50,7 +50,16 @@ class MoE(nn.Module):
         # gate (kept fp32 — reference gates in fp32 for stability)
         wg = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
                       param_dtype=jnp.float32, name="gate")
-        logits = wg(tokens.astype(jnp.float32))
+        gate_in = tokens.astype(jnp.float32)
+        if (train and self.noisy_gate_policy == "Jitter"
+                and self.has_rng("gating")):
+            # reference 'Jitter' policy: multiplicative uniform noise on the
+            # gate INPUT (sharded_moe.py multiplicative_jitter)
+            eps = 1e-2
+            gate_in = gate_in * jax.random.uniform(
+                self.make_rng("gating"), gate_in.shape,
+                minval=1.0 - eps, maxval=1.0 + eps)
+        logits = wg(gate_in)
         gate = TopKGate(k=self.k, capacity_factor=self.capacity_factor,
                         eval_capacity_factor=self.eval_capacity_factor,
                         min_capacity=self.min_capacity,

@@ -1,0 +1,119 @@
+"""Block-sparse self attention (reference
+``ops/sparse_attention/sparse_self_attention.py:12`` + the Triton
+``matmul.py``/``softmax.py`` kernels).
+
+TPU formulation ("splash-attention-lite"): instead of a hand kernel per
+sparse matmul, each q block GATHERS only its allowed k/v blocks (padded to
+the layout's max row population) and runs batched MXU matmuls over them —
+FLOPs and HBM traffic scale with the number of live blocks, not S².  XLA
+fuses the mask/softmax chain; gradients fall out of AD.  A dedicated Pallas
+kernel (skip-by-layout inside the flash loop, ``flash_attention.py
+_block_live``) is the further optimization once layouts get very sparse.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def _row_gather_indices(layout_h):
+    """[nq, nk] bool → (idx [nq, maxk], valid [nq, maxk]) with idx padded by
+    repeating the first live block (masked out via valid)."""
+    nq, nk = layout_h.shape
+    counts = layout_h.sum(axis=1)
+    maxk = max(1, int(counts.max()))
+    idx = np.zeros((nq, maxk), dtype=np.int32)
+    valid = np.zeros((nq, maxk), dtype=bool)
+    for i in range(nq):
+        cols = np.nonzero(layout_h[i])[0]
+        idx[i, :len(cols)] = cols
+        valid[i, :len(cols)] = True
+        if len(cols) == 0:
+            valid[i, 0] = False
+    return idx, valid
+
+
+def sparse_attention(q, k, v, layout, block, causal=False, scale=None):
+    """q/k/v: [B, S, H, D]; layout: [H or 1, nq, nk] bool (block level).
+    Returns [B, S, H, D].
+    """
+    B, S, H, D = q.shape
+    nb = S // block
+    layout = np.asarray(layout)
+    if layout.shape[0] == 1:
+        layout = np.broadcast_to(layout, (H, ) + layout.shape[1:])
+    scale = scale if scale is not None else D ** -0.5
+
+    # per-head gather tables (host, static)
+    idxs, valids = zip(*(_row_gather_indices(layout[h]) for h in range(H)))
+    maxk = max(i.shape[1] for i in idxs)
+    idx = np.stack([np.pad(i, ((0, 0), (0, maxk - i.shape[1])))
+                    for i in idxs])              # [H, nq, maxk]
+    valid = np.stack([np.pad(m, ((0, 0), (0, maxk - m.shape[1])))
+                      for m in valids])          # [H, nq, maxk]
+
+    qb = q.reshape(B, nb, block, H, D).transpose(3, 0, 1, 2, 4)  # [H,B,nq,bs,D]
+    kb = k.reshape(B, nb, block, H, D).transpose(3, 0, 1, 2, 4)
+    vb = v.reshape(B, nb, block, H, D).transpose(3, 0, 1, 2, 4)
+    idx_j = jnp.asarray(idx)
+    valid_j = jnp.asarray(valid)
+
+    def per_head(qh, kh, vh, idx_h, valid_h):
+        # gather each q block's allowed k/v blocks: [B, nq, maxk, bs, D]
+        kg = kh[:, idx_h]
+        vg = vh[:, idx_h]
+        s = jnp.einsum("bqtd,bqkcd->bqtkc", qh.astype(jnp.float32),
+                       kg.astype(jnp.float32)) * scale
+        # mask: padding blocks; causal within/between blocks
+        mask = valid_h[None, :, None, :, None]
+        if causal:
+            qpos = (jnp.arange(nb)[:, None] * block
+                    + jnp.arange(block)[None, :])        # [nq, bs]
+            kpos = idx_h[:, :, None] * block + jnp.arange(block)  # [nq,maxk,bs]
+            cm = qpos[:, :, None, None] >= kpos[:, None, :, :]
+            mask = jnp.logical_and(mask, cm[None])
+        mask = jnp.broadcast_to(mask, s.shape)
+        s = jnp.where(mask, s, _NEG_INF)
+        flat = s.shape[:3] + (maxk * block, )
+        sf = s.reshape(flat)
+        m = jnp.max(sf, axis=-1, keepdims=True)
+        m = jnp.where(m == _NEG_INF, 0.0, m)
+        p = jnp.where(mask.reshape(flat), jnp.exp(sf - m), 0.0)
+        denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        p = (p / denom).reshape(s.shape)
+        return jnp.einsum("bqtkc,bqkcd->bqtd", p, vg.astype(jnp.float32))
+
+    out = jax.vmap(per_head)(qb, kb, vb, idx_j, valid_j)  # [H,B,nq,bs,D]
+    return out.transpose(1, 2, 3, 0, 4).reshape(B, S, H, D).astype(q.dtype)
+
+
+class SparseSelfAttention:
+    """Reference ``SparseSelfAttention`` API: configure once with a
+    SparsityConfig, call with [B, H, S, D] tensors (reference layout) or
+    [B, S, H, D] (``bshd=True``)."""
+
+    def __init__(self, sparsity_config, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config
+        self.max_seq_length = max_seq_length
+        self._layouts = {}
+
+    def layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, bshd=False, causal=None):
+        q, k, v = (jnp.asarray(t) for t in (query, key, value))
+        if not bshd:  # reference [B, H, S, D] → internal [B, S, H, D]
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        S = q.shape[1]
+        if causal is None:
+            causal = self.sparsity_config.attention == "unidirectional" \
+                if hasattr(self.sparsity_config, "attention") else False
+        out = sparse_attention(q, k, v, self.layout(S),
+                               self.sparsity_config.block, causal=causal)
+        return out if bshd else out.transpose(0, 2, 1, 3)

@@ -246,6 +246,21 @@ class FlopsProfiler:
         self.latency = (time.perf_counter() - t0) / iters
         return self.latency
 
+    # -- device trace capture (round-1 review item 10: the analytic walk
+    # has no per-module *latency* tree; the TPU answer is an xplane trace —
+    # flax module names survive into XLA metadata, so xprof/tensorboard
+    # shows the per-module time breakdown the reference builds from hooks)
+    def start_trace(self, trace_dir):
+        import jax.profiler
+        jax.profiler.start_trace(trace_dir)
+        self._trace_dir = trace_dir
+        return trace_dir
+
+    def stop_trace(self):
+        import jax.profiler
+        jax.profiler.stop_trace()
+        return getattr(self, "_trace_dir", None)
+
     def get_total_flops(self, as_string=False):
         return _num_fmt(self.flops, "FLOPs") if as_string else self.flops
 

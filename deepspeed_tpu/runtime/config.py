@@ -64,6 +64,10 @@ class CommsLoggerConfig(DeepSpeedConfigModel):
     prof_all: bool = True
     debug: bool = False
     prof_ops: list = []
+    # True → block_until_ready around each logged collective (precise
+    # latency, but serializes the async pipeline — measurement changes the
+    # program).  False (default) → dispatch-side timing only.
+    sync_timing: bool = False
 
 
 class CommsConfig(DeepSpeedConfigModel):

@@ -1,0 +1,22 @@
+"""Domino — TP communication/compute overlap (reference
+``runtime/domino/transformer.py:518`` ``DominoTransformerLayer``).
+
+The reference hides tensor-parallel all-reduces by hand: it splits each batch
+into two µ-streams and interleaves one stream's collective with the other's
+compute on separate CUDA streams.
+
+The TPU equivalent is NOT a rewrite of the model: under ``jit``, XLA's
+latency-hiding scheduler (LHS) already converts collectives into
+``all-reduce-start``/``all-reduce-done`` pairs and schedules independent
+compute between them — hand-interleaving inside a jitted program would just
+be re-ordered by the compiler.  What the reference achieves with Domino's
+µ-streams, the TPU build must *verify* instead: :func:`measure_tp_overlap`
+lowers a step and reports whether the collectives in the optimized HLO are
+asynchronous and have compute scheduled inside their windows.
+
+``DominoTransformerLayer`` is therefore an explicit alias documenting the
+design decision (the standard block IS the overlap-scheduled form), and the
+measurement utility is the parity artifact.
+"""
+
+from .overlap import DominoTransformerLayer, measure_tp_overlap

@@ -1,0 +1,98 @@
+"""TP overlap measurement (Domino parity artifact — see package docstring).
+
+``measure_tp_overlap`` compiles a function and inspects the optimized HLO
+schedule: on TPU, XLA's latency-hiding scheduler splits each collective into
+``<op>-start`` / ``<op>-done`` and moves independent compute between them —
+exactly the overlap Domino hand-codes with µ-streams.  The report counts
+
+* ``collectives``      — collective ops in the optimized module,
+* ``async_pairs``      — start/done-split (overlappable) collectives,
+* ``overlapped_pairs`` — async collectives with ≥1 real compute op
+                         scheduled inside the start→done window,
+
+so a TP config can assert its all-reduces are hidden (reference blog claims
+up to 1.3×; here the compiler provides the schedule and this tool the
+evidence).
+"""
+
+import re
+
+import jax
+
+_COLLECTIVE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start|-done)?\b")
+_COMPUTE_RE = re.compile(r"\b(fusion|dot|convolution|custom-call)\b")
+
+
+def _schedule_lines(hlo_text):
+    """Instruction lines of the entry computation in schedule order."""
+    lines = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        if "=" in line and not line.startswith(("HloModule", "//", "#")):
+            lines.append(line)
+    return lines
+
+
+def analyze_hlo_overlap(hlo_text):
+    lines = _schedule_lines(hlo_text)
+    collectives = 0
+    async_pairs = 0
+    overlapped = 0
+    open_windows = {}  # op name → compute count since start
+    for line in lines:
+        m = _COLLECTIVE_RE.search(line)
+        if m and m.group(2) == "-start":
+            name = line.split("=", 1)[0].strip().lstrip("%")
+            open_windows[name] = 0
+            collectives += 1
+            async_pairs += 1
+            continue
+        if m and m.group(2) == "-done":
+            # operand name appears after the op
+            for name in list(open_windows):
+                if name in line:
+                    if open_windows.pop(name) > 0:
+                        overlapped += 1
+                    break
+            continue
+        if m and m.group(2) is None:
+            collectives += 1
+        if _COMPUTE_RE.search(line):
+            for name in open_windows:
+                open_windows[name] += 1
+    return {"collectives": collectives, "async_pairs": async_pairs,
+            "overlapped_pairs": overlapped}
+
+
+def measure_tp_overlap(fn, *args, **kwargs):
+    """Compile ``fn`` (e.g. an engine micro-step closure) and report the
+    collective-overlap structure of its optimized schedule."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    texts = compiled.as_text()
+    if isinstance(texts, (list, tuple)):
+        texts = "\n".join(texts)
+    if not _COLLECTIVE_RE.search(texts or ""):
+        # some backends (CPU) print thunks, not HLO — recompile with a dump
+        # and read the post-optimization module
+        import glob
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="ds_tpu_overlap_")
+        lowered.compile(compiler_options={"xla_dump_to": tmp})
+        parts = [open(p).read() for p in
+                 sorted(glob.glob(f"{tmp}/*after_optimizations.txt"))]
+        texts = "\n".join(parts) or texts
+    report = analyze_hlo_overlap(texts)
+    report["backend"] = jax.default_backend()
+    report["overlapped"] = (report["async_pairs"] > 0
+                            and report["overlapped_pairs"] > 0)
+    return report
+
+
+def DominoTransformerLayer(block_cls, *args, **kwargs):
+    """Alias documenting the design decision (see package docstring): the
+    standard block compiled under jit IS the overlap-scheduled form on TPU.
+    Returns the block unchanged so reference-shaped code keeps working."""
+    return block_cls(*args, **kwargs)

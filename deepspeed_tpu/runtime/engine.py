@@ -22,6 +22,7 @@ subclasses; the optimizer is an optax-style transform from ``deepspeed_tpu.ops``
 """
 
 import os
+import tempfile
 from functools import partial
 
 import numpy as np
@@ -134,6 +135,10 @@ class DeepSpeedEngine:
                 "mutually exclusive shard-group factorings")
         zp_size = (zc.mics_shard_size if zc.mics_shard_size and
                    zc.mics_shard_size > 1 else zc.zero_hpz_partition_size)
+        # multi-process rendezvous FIRST — the mesh below must see the
+        # federated device view (reference order: init_distributed :143
+        # before mesh :153)
+        dist.ensure_runtime_initialized()
         if not groups.mesh_is_initialized():
             groups.initialize_mesh(
                 pp=mc.pp, dp=None if mc.dp in (-1, None) else mc.dp,
@@ -199,8 +204,10 @@ class DeepSpeedEngine:
             stage=zc.stage, mesh=self.mesh, zero_axes=zero_axes,
             tp_rules=tp_rules,
             min_partition_size=max(1, zc.param_persistence_threshold // 8),
+            # NVMe residency is managed by the step-wired swapper, not by
+            # memory-kind annotations (those are for host-RAM offload)
             offload_optimizer=(zc.offload_optimizer is not None
-                               and zc.offload_optimizer.device != "none"),
+                               and str(zc.offload_optimizer.device) == "cpu"),
             offload_param=(zc.offload_param is not None
                            and zc.offload_param.device != "none"),
             # only when the config asked for it — a pre-initialized mesh may
@@ -231,6 +238,7 @@ class DeepSpeedEngine:
         self.opt_state = None
         self.grad_acc = None
         self.scale_state = None
+        self._configure_nvme_swapper(zc)
         if model_parameters is not None:
             self._install_parameters(model_parameters)
 
@@ -308,6 +316,15 @@ class DeepSpeedEngine:
                if isinstance(rng_or_seed, int) else rng_or_seed)
         variables = jax.eval_shape(self.module.init, rng, *sample_inputs, **kw)
         params_shape = variables["params"]
+        if self.mp_world_size > 1 and not self.plan.tp_rules:
+            # tp>1 with no hand-written rules: derive them from the model's
+            # dataflow (reference auto_tp.py:273 tp_parser analog)
+            from ..module_inject.tp_parser import derive_tp_rules_from_dataflow
+            self.plan.tp_rules = derive_tp_rules_from_dataflow(
+                lambda p, *i: self.module.apply({"params": p}, *i, **kw),
+                params_shape, *sample_inputs)
+            log_dist(f"AutoTP derived {len(self.plan.tp_rules)} sharding "
+                     f"rules from dataflow", ranks=[0])
         shardings = self.plan.master_shardings(params_shape)
 
         def init_fn(rng):
@@ -397,6 +414,59 @@ class DeepSpeedEngine:
             self.opt_state = jax.jit(
                 self._grad_transform.init,
                 out_shardings=self._opt_state_shardings(target))(target)
+            if self._nvme_swapper is not None:
+                # NVMe offload: state leaves HBM right away (reference
+                # stage3.py swaps states out at init, not lazily)
+                self._nvme_swap_out()
+
+    # ----------------------------------------------------- NVMe state offload
+    def _configure_nvme_swapper(self, zc):
+        """Optimizer-state NVMe offload (reference ``stage3.py:1926``
+        ``_optimizer_states_and_gradient_swap_in`` + ``swap_tensor/
+        partitioned_optimizer_swapper.py``): fp32 master + moments live on
+        disk between steps; ``step()`` swaps them in (async reads launched at
+        the last ``backward()`` so disk latency overlaps the bwd compute
+        tail) and swaps them back out after the update (async writes overlap
+        the next forward)."""
+        self._nvme_swapper = None
+        self._nvme_prefetch = None
+        self._state_on_nvme = False
+        oo = zc.offload_optimizer
+        if oo is not None and str(oo.device) == "nvme":
+            from .swap_tensor import PartitionedOptimizerSwapper
+            base = oo.nvme_path or os.path.join(
+                tempfile.gettempdir(), "ds_tpu_nvme")
+            swap_dir = os.path.join(
+                str(base), f"zero_stage_{zc.stage}",
+                f"rank{jax.process_index()}")
+            self._nvme_swapper = PartitionedOptimizerSwapper(swap_dir)
+            log_dist(f"NVMe optimizer-state offload → {swap_dir}", ranks=[0])
+
+    def _nvme_swap_out(self):
+        """Move (master, opt_state) HBM → disk; async writes, device buffers
+        released immediately (this is what shrinks the HBM footprint)."""
+        tree = {"master": self.master, "opt_state": self.opt_state}
+        host = jax.device_get(tree)
+        self.master = None
+        self.opt_state = None
+        self._state_on_nvme = True
+        self._nvme_swapper.swap_out_tree(host)
+
+    def _nvme_start_swap_in(self):
+        if self._nvme_prefetch is None:
+            self._nvme_prefetch = self._nvme_swapper.swap_in_tree_async()
+
+    def _ensure_state_resident(self):
+        """Bring NVMe-offloaded optimizer state back to (host→)device refs.
+        Used by step(), checkpointing, and fragment APIs."""
+        if self._nvme_swapper is None or not self._state_on_nvme:
+            return
+        self._nvme_start_swap_in()
+        tree = self._nvme_swapper.finish_swap_in(self._nvme_prefetch)
+        self._nvme_prefetch = None
+        self.master = tree["master"]
+        self.opt_state = tree["opt_state"]
+        self._state_on_nvme = False
 
     def _init_onebit_state(self):
         """Place the 1-bit optimizer state: moments replicated, per-worker
@@ -514,6 +584,22 @@ class DeepSpeedEngine:
         return NamedSharding(self.mesh, P(*spec))
 
     def shard_batch(self, *inputs):
+        """Place host batch arrays onto the mesh.
+
+        Single-process: ``device_put`` of the full global batch.
+        Multi-process (pods): each process passes its LOCAL shard of the
+        global batch — per-process data feeding, the reference's per-rank
+        ``DistributedSampler`` contract (rank = ``groups.
+        _get_data_parallel_rank()``) — and the global array is assembled
+        without any cross-host data movement via
+        ``jax.make_array_from_process_local_data``.
+        """
+        if jax.process_count() > 1:
+            arrays = [np.asarray(x) for x in inputs]
+            return tuple(
+                jax.make_array_from_process_local_data(
+                    self._batch_sharding(x), x)
+                for x in arrays)
         return tuple(
             jax.device_put(jnp.asarray(x), self._batch_sharding(jnp.asarray(x)))
             for x in inputs)
@@ -561,8 +647,10 @@ class DeepSpeedEngine:
             # qwZ: int8 param all-gather (straight-through bwd)
             from .zero.zeropp import quantized_weight_gather
             inner = apply_fn
+            qw_fmt = zc.zero_quantized_weights_format
             apply_fn = lambda params, *inputs: inner(
-                quantized_weight_gather(params, self.plan), *inputs)
+                quantized_weight_gather(params, self.plan,
+                                        wire_format=qw_fmt), *inputs)
         from .utils import make_scaled_loss_fn
         loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
@@ -699,6 +787,17 @@ class DeepSpeedEngine:
                                      output_file=fp.output_file)
         self.flops_profiler = prof
 
+    def start_device_trace(self, trace_dir):
+        """Capture a jax.profiler (xplane) trace of subsequent steps — the
+        per-module latency view (flax scope names survive into XLA metadata;
+        round-1 review: profiler depth beyond the analytic flops walk)."""
+        from ..profiling.flops_profiler import FlopsProfiler
+        self._trace_profiler = FlopsProfiler(self)
+        return self._trace_profiler.start_trace(trace_dir)
+
+    def stop_device_trace(self):
+        return self._trace_profiler.stop_trace()
+
     def __call__(self, *inputs, **kwargs):
         return self.forward(*inputs, **kwargs)
 
@@ -715,6 +814,12 @@ class DeepSpeedEngine:
                 self._acc_fn = self._accumulate_fn()
             self.grad_acc = self._acc_fn(self.grad_acc, self._stashed_grads)
         self._stashed_grads = None
+        if (self._nvme_swapper is not None and self._state_on_nvme
+                and self.is_gradient_accumulation_boundary()):
+            # last microbatch: start the async disk reads now so they overlap
+            # the backward compute tail (reference swap-in overlap,
+            # stage3.py:1926)
+            self._nvme_start_swap_in()
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -726,12 +831,16 @@ class DeepSpeedEngine:
             if self.grad_acc is None:
                 raise RuntimeError("step() at a grad-accum boundary without "
                                    "any backward() since the last boundary")
+            self._ensure_state_resident()
             apply = self._get_compiled_apply()
             (self.params, self.master, self.opt_state,
              self.scale_state, overflow, gnorm) = apply(
                 self.params, self.master, self.opt_state, self.grad_acc,
                 self.scale_state)
             self.grad_acc = None
+            if self._nvme_swapper is not None:
+                # updated state back to disk (async; overlaps next forward)
+                self._nvme_swap_out()
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if bool(overflow):
@@ -789,6 +898,7 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True, exclude_frozen_parameters=False):
         from .checkpoint_engine import save_engine_checkpoint
+        self._ensure_state_resident()
         return save_engine_checkpoint(self, save_dir, tag=tag,
                                       client_state=client_state,
                                       save_latest=save_latest)
@@ -830,6 +940,7 @@ class DeepSpeedEngine:
     def get_fp32_param(self, path=None):
         """Tensor-fragment API analog (reference utils/tensor_fragment.py):
         full fp32 weights as a host pytree."""
+        self._ensure_state_resident()
         src = self.master if self.master is not None else self.params
         return jax.tree_util.tree_map(lambda x: np.asarray(x, dtype=np.float32), src)
 

@@ -1,200 +1,25 @@
-"""Pipeline schedules — analog of reference ``runtime/pipe/schedule.py``
-(PipeSchedule ABC ``:11``, InferenceSchedule ``:135``, TrainSchedule ``:189``
-1F1B, DataParallelSchedule ``:301``; instruction taxonomy ``:327-480``).
+"""Pipeline schedules — instruction-stream view of pipeline execution.
 
-This file is deliberately framework-agnostic data (as the reference's is): a
-schedule yields lists of instructions per step; the engine decides how to
-execute them (eagerly with jitted per-instruction fns, or fused into a single
-scanned program for the TPU fast path)."""
+Role parity with reference ``runtime/pipe/schedule.py`` (``PipeSchedule``,
+``TrainSchedule``/1F1B, ``InferenceSchedule``, the ``PipeInstruction``
+taxonomy), but derived differently: instead of closed-form step↔microbatch
+index formulas, each stage's compute order is written down from the 1F1B
+invariants and a small dependency-driven clock simulation aligns the
+communication ticks across stages.  The result is a global schedule where
+every Send is emitted on the producer in the same tick as the consumer's
+Recv, which is what a synchronous pairwise executor needs.
 
-
-class PipeSchedule:
-    """Base: yields step_cmds lists; each cmd is a PipeInstruction."""
-
-    def __init__(self, micro_batches, stages, stage_id):
-        self.micro_batches = micro_batches
-        self.stages = stages
-        self.stage_id = stage_id
-        self.prev_stage = self.stage_id - 1
-        self.next_stage = self.stage_id + 1
-
-    def steps(self):
-        raise NotImplementedError
-
-    def num_pipe_buffers(self):
-        return self.micro_batches
-
-    def _valid_micro_batch(self, micro_batch_id):
-        return 0 <= micro_batch_id < self.micro_batches
-
-    def _valid_stage(self, stage_id):
-        return 0 <= stage_id < self.stages
-
-    @property
-    def stage(self):
-        return self.stage_id
-
-    @property
-    def num_stages(self):
-        return self.stages
-
-    @property
-    def num_micro_batches(self):
-        return self.micro_batches
-
-    @property
-    def is_first_stage(self):
-        return self.stage_id == 0
-
-    @property
-    def is_last_stage(self):
-        return self.stage_id == self.stages - 1
-
-    def __iter__(self):
-        self.it = None
-        return self
-
-    def __next__(self):
-        if self.it is None:
-            self.it = self.steps()
-        return next(self.it)
+On TPU the hot path does NOT interpret these streams — the fused shard_map
+program in ``pipe/engine.py`` is the executor, and XLA's scheduler overlaps
+the ppermutes.  The streams exist for parity tests, debugging, and as the
+reference-semantics oracle for the fused program.
+"""
 
 
-class InferenceSchedule(PipeSchedule):
-    """Reference ``:135``: forward-only streaming."""
-
-    def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
-            micro_batch_id = step_id - self.stage_id
-            cmds = []
-            if 0 <= prev_micro_batch_id < self.micro_batches:
-                buf = prev_micro_batch_id % self.num_pipe_buffers()
-                if not self.is_last_stage:
-                    cmds.append(SendActivation(buf))
-            if 0 <= micro_batch_id < self.micro_batches:
-                buf = micro_batch_id % self.num_pipe_buffers()
-                if self.is_first_stage or self.is_last_stage:
-                    cmds.append(LoadMicroBatch(buf))
-                if not self.is_first_stage:
-                    cmds.append(RecvActivation(buf))
-                cmds.append(ForwardPass(buf))
-            prev_micro_batch_id = micro_batch_id
-            yield cmds
-
-    def num_pipe_buffers(self):
-        return min(2, self.micro_batches)
-
-
-class TrainSchedule(PipeSchedule):
-    """Reference ``:189``: 1F1B — warmup fwds, steady 1F1B, drain bwds."""
-
-    def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-            if self._valid_micro_batch(prev_micro_batch_id):
-                prev_buffer = self._buffer_idx(prev_micro_batch_id)
-            if self._valid_micro_batch(micro_batch_id):
-                curr_buffer = self._buffer_idx(micro_batch_id)
-
-            cmds = []
-            # Exchange activations
-            if is_forward:
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(SendGrad(prev_buffer))
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(curr_buffer))
-            else:
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(RecvGrad(curr_buffer))
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(prev_buffer))
-
-            # Compute
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    if self.is_first_stage or self.is_last_stage:
-                        cmds.append(LoadMicroBatch(curr_buffer))
-                    cmds.append(ForwardPass(curr_buffer))
-                else:
-                    cmds.append(BackwardPass(curr_buffer))
-
-            # Model step at the end of the batch
-            if step_id == total_steps - 1:
-                cmds.append(ReduceTiedGrads())
-                cmds.append(ReduceGrads())
-                cmds.append(OptimizerStep())
-
-            prev_micro_batch_id = micro_batch_id
-            yield cmds
-
-    def _buffer_idx(self, micro_batch_id):
-        assert self._valid_micro_batch(micro_batch_id)
-        return micro_batch_id % self.num_pipe_buffers()
-
-    def _step_to_micro_batch(self, step_id):
-        """Reference ``:258``: map step index → (micro_batch, is_forward)."""
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        else:
-            assert False
-        return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stage_id // 2)
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return int(base - self.stage_id // 2)
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stages + (self.stage_id + 1) // 2)
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return int(base + self.stage_id // 2)
-
-    def num_pipe_buffers(self):
-        """Reference: stages - stage_id buffers needed, ≥2."""
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
-        return max(2, buffers)
-
-
-class DataParallelSchedule(PipeSchedule):
-    """Reference ``:301``: degenerate single-stage schedule."""
-
-    def steps(self):
-        for step_id in range(self.micro_batches):
-            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
-            if step_id == self.micro_batches - 1:
-                cmds.extend([ReduceGrads(), OptimizerStep()])
-            yield cmds
-
-    def num_pipe_buffers(self):
-        return 1
-
-
+# --------------------------------------------------------------------------
+# Instruction taxonomy (names are the reference's public vocabulary)
+# --------------------------------------------------------------------------
 class PipeInstruction:
-    """Reference ``:327``."""
 
     def __init__(self, **kwargs):
         self.name = self.__class__.__name__
@@ -203,13 +28,12 @@ class PipeInstruction:
             setattr(self, k, v)
 
     def __repr__(self):
-        if self.kwargs:
-            kw = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
-            return f"{self.name}({kw})"
-        return self.name
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})" if args else self.name
 
     def __eq__(self, other):
-        return (self.__class__ == other.__class__ and self.kwargs == other.kwargs)
+        return (self.__class__ == other.__class__
+                and self.kwargs == other.kwargs)
 
 
 class OptimizerStep(PipeInstruction):
@@ -225,6 +49,7 @@ class ReduceTiedGrads(PipeInstruction):
 
 
 class BufferOpInstruction(PipeInstruction):
+
     def __init__(self, buffer_id, **kwargs):
         super().__init__(buffer_id=buffer_id, **kwargs)
 
@@ -257,9 +82,188 @@ class RecvGrad(BufferOpInstruction):
     pass
 
 
-def _is_even(x):
-    return x % 2 == 0
+# --------------------------------------------------------------------------
+# Per-stage compute orders
+# --------------------------------------------------------------------------
+def one_f1b_order(micro_batches, stages, stage_id):
+    """The 1F1B compute order for one stage, from its defining invariants:
+
+    * warmup: stage s starts with ``stages - 1 - s`` forwards so the last
+      stage can begin alternating immediately (bounded in-flight work);
+    * steady state: strictly alternate forward/backward;
+    * drain: the backwards that warmup deferred.
+
+    Returns a list of ("F"|"B", microbatch_id).
+    """
+    M = micro_batches
+    warmup = min(stages - 1 - stage_id, M)
+    order = [("F", m) for m in range(warmup)]
+    for i in range(M - warmup):
+        order.append(("F", warmup + i))
+        order.append(("B", i))
+    for m in range(M - warmup, M):
+        order.append(("B", m))
+    return order
 
 
-def _is_odd(x):
-    return x % 2 != 0
+def forward_order(micro_batches, stages, stage_id):
+    """Forward-only streaming order (inference)."""
+    return [("F", m) for m in range(micro_batches)]
+
+
+def _simulate(orders, stages):
+    """Greedy clock simulation of per-stage compute orders under the data
+    dependencies F(m)@s ← F(m)@s-1 and B(m)@s ← B(m)@s+1 (+ F(m)@s).
+
+    Returns ``done``: {(kind, m, stage): tick}, and the tick count.  Each
+    stage runs at most one compute per tick, at the earliest tick whose
+    dependencies completed on a *strictly earlier* tick.
+    """
+    cursor = [0] * stages           # next event index per stage
+    done = {}
+    tick = 0
+    while any(cursor[s] < len(orders[s]) for s in range(stages)):
+        progressed = False
+        scheduled = []
+        for s in range(stages):
+            if cursor[s] >= len(orders[s]):
+                continue
+            kind, m = orders[s][cursor[s]]
+            if kind == "F":
+                dep = None if s == 0 else ("F", m, s - 1)
+            else:
+                dep = None if s == stages - 1 else ("B", m, s + 1)
+            dep_ok = dep is None or done.get(dep, tick) < tick
+            own_ok = kind != "B" or done.get(("F", m, s), tick) < tick
+            if dep_ok and own_ok:
+                scheduled.append((kind, m, s))
+        for kind, m, s in scheduled:
+            done[(kind, m, s)] = tick
+            cursor[s] += 1
+            progressed = True
+        tick += 1
+        if not progressed and tick > 4 * sum(map(len, orders)) + 8:
+            raise RuntimeError("pipeline schedule deadlock (bug)")
+    return done, tick
+
+
+# --------------------------------------------------------------------------
+# Schedules
+# --------------------------------------------------------------------------
+class PipeSchedule:
+    """Iterable of per-tick instruction lists for ``stage_id``."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    # -- geometry helpers
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self):
+        """In-flight microbatches at this stage: a microbatch's buffer is
+        live from its forward until its backward, and 1F1B keeps at most
+        ``stages - stage_id`` in flight (≥2 for double-buffered comm)."""
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _buffer(self, m):
+        return m % self.num_pipe_buffers()
+
+    # -- stream construction
+    def _orders(self):
+        raise NotImplementedError
+
+    def _tail(self):
+        """Instructions appended after the final compute tick."""
+        return []
+
+    def steps(self):
+        """Yield the per-tick instruction lists for this stage."""
+        make_order = self._orders()
+        orders = [make_order(s) for s in range(self.stages)]
+        done, ticks = _simulate(orders, self.stages)
+        by_tick = {}
+        for (kind, m, s), t in done.items():
+            by_tick.setdefault(t, []).append((kind, m, s))
+
+        for t in range(ticks):
+            cmds = []
+            events = sorted(by_tick.get(t, []))
+            mine = [(k, m) for (k, m, s) in events if s == self.stage_id]
+            # comm first: a Recv on this stage pairs with the producer's Send
+            # in the SAME tick (synchronous pairwise exchange)
+            for kind, m, s in events:
+                if kind == "F" and s == self.stage_id and s > 0:
+                    cmds.append(RecvActivation(self._buffer(m)))
+                if kind == "F" and s == self.stage_id + 1:
+                    cmds.append(SendActivation(self._buffer(m)))
+                if kind == "B" and s == self.stage_id and s < self.stages - 1:
+                    cmds.append(RecvGrad(self._buffer(m)))
+                if kind == "B" and s == self.stage_id - 1:
+                    cmds.append(SendGrad(self._buffer(m)))
+            for kind, m in mine:
+                if kind == "F":
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(self._buffer(m)))
+                    cmds.append(ForwardPass(self._buffer(m)))
+                else:
+                    cmds.append(BackwardPass(self._buffer(m)))
+            if t == ticks - 1:
+                cmds.extend(self._tail())
+            yield cmds
+
+    def __iter__(self):
+        return iter(list(self.steps()))
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B training schedule."""
+
+    def _orders(self):
+        return lambda s: one_f1b_order(self.micro_batches, self.stages, s)
+
+    def _tail(self):
+        return [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only streaming."""
+
+    def _orders(self):
+        return lambda s: forward_order(self.micro_batches, self.stages, s)
+
+    def num_pipe_buffers(self):
+        return min(2, self.micro_batches)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Single-stage degenerate schedule (gradient accumulation only)."""
+
+    def steps(self):
+        for m in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if m == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1

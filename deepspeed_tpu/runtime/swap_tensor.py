@@ -138,10 +138,20 @@ class PartitionedOptimizerSwapper:
         return handles
 
     def swap_in_tree(self):
+        return self.finish_swap_in(self.swap_in_tree_async())
+
+    def swap_in_tree_async(self):
+        """Kick off the disk reads; returns handles (callers start this at
+        the grad-accum boundary so reads overlap backward compute —
+        reference pipelined_optimizer_swapper overlap)."""
         if self._treedef is None:
             raise RuntimeError("nothing swapped out")
+        # writes must land before reads of the same files
+        self.swapper.synchronize()
         n = self._treedef.num_leaves
-        handles = [self.swapper.swap_in(f"opt_{i}") for i in range(n)]
+        return [self.swapper.swap_in(f"opt_{i}") for i in range(n)]
+
+    def finish_swap_in(self, handles):
         leaves = [h.wait() for h in handles]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 

@@ -84,6 +84,9 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     # ZeRO++ (reference stage3.py:123 kwargs + engine.py:906-913)
     zero_hpz_partition_size: int = Field(1, ge=0)
     zero_quantized_weights: bool = False
+    # qwZ wire format: int8 (reference default) | int4 | fp8 | fp6 | fp12
+    # (fp formats via ops/fp_quantizer — csrc/fp_quantizer analog)
+    zero_quantized_weights_format: str = "int8"
     zero_quantized_nontrainable_weights: bool = False
     zero_quantized_gradients: bool = False
 

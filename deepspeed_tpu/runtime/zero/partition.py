@@ -27,6 +27,27 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...utils.logging import logger
+
+_PINNED_HOST_OK = {}
+
+
+def _pinned_host_supported(mesh):
+    """Functional probe: memory_kind='pinned_host' may *construct* on any
+    backend but fail at SPMD compile (CPU does exactly this) — so compile a
+    one-op program once per backend and cache the verdict."""
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    if backend not in _PINNED_HOST_OK:
+        try:
+            s = NamedSharding(mesh, P(), memory_kind="pinned_host")
+            jax.jit(lambda: jnp.zeros((8, ), jnp.float32),
+                    out_shardings=s)()
+            _PINNED_HOST_OK[backend] = True
+        except Exception:
+            _PINNED_HOST_OK[backend] = False
+    return _PINNED_HOST_OK[backend]
+
 
 def shard_spec(shape, mesh: Mesh, axes, min_size=1, base_spec=None):
     """PartitionSpec sharding ``shape``'s largest divisible dim over ``axes``.
@@ -298,16 +319,26 @@ class ZeroPartitionPlan:
         # Host offload: params/optimizer state resident in pinned host memory,
         # streamed to device per use (reference ZeRO-Offload; SURVEY.md §7
         # "pinned-host offload → memory kinds").
-        return "pinned_host" if offload else None
+        if not offload:
+            return None
+        if not _pinned_host_supported(self.mesh):
+            # LOUD fallback (round-1 review): an "offload enabled" config
+            # silently running fully in HBM is an OOM trap at real scale
+            if not getattr(self, "_offload_fallback_warned", False):
+                self._offload_fallback_warned = True
+                logger.warning(
+                    "offload requested but memory_kind='pinned_host' does "
+                    "not compile on this platform — STATE STAYS IN DEVICE "
+                    "MEMORY; expect the HBM footprint of a non-offload run "
+                    "(use offload device 'nvme' for managed disk residency)")
+            return None
+        return "pinned_host"
 
     def _sharding(self, spec, offload=False, mesh=None):
         mesh = mesh if mesh is not None else self.mesh
         kind = self._memory_kind(offload)
         if kind is not None:
-            try:
-                return NamedSharding(mesh, spec, memory_kind=kind)
-            except Exception:
-                return NamedSharding(mesh, spec)
+            return NamedSharding(mesh, spec, memory_kind=kind)
         return NamedSharding(mesh, spec)
 
     def param_shardings(self, params):

@@ -64,34 +64,60 @@ def _strip_axes(spec, dim, axes):
     return P(*new)
 
 
-def quantized_all_gather(x, ax_names, dim, num_bits=8,
+# wire formats for qwZ payloads: name → (quantize, dequantize) closures.
+# "int8"/"int4" ride the blockwise integer kernels; "fp8"/"fp6"/"fp12" the FP
+# quantizer (reference csrc/fp_quantizer — fp6 packs 4 values → 3 bytes, so
+# the allgather volume drops to 3/8 of bf16).
+_FP_FORMATS = {"fp8": (8, 3), "fp6": (6, 2), "fp12": (12, 7)}
+
+
+def _wire_codec(wire_format, group_size):
+    if wire_format in ("int8", "int4"):
+        bits = 8 if wire_format == "int8" else 4
+        quant = lambda x: quantize_blockwise(x, num_bits=bits,
+                                             group_size=group_size,
+                                             use_pallas=False)
+        dequant = lambda q, s, m: dequantize_blockwise(q, s, m,
+                                                       use_pallas=False)
+        return quant, dequant
+    if wire_format in _FP_FORMATS:
+        from ...ops.fp_quantizer import dequantize_fp, quantize_fp
+        bits, man = _FP_FORMATS[wire_format]
+        quant = lambda x: quantize_fp(x, q_bits=bits, mantissa_bits=man,
+                                      group_size=group_size, use_pallas=False)
+        return quant, dequantize_fp
+    raise ValueError(f"unknown qwZ wire format {wire_format!r} "
+                     f"(have int8, int4, {', '.join(_FP_FORMATS)})")
+
+
+def quantized_all_gather(x, ax_names, dim, wire_format="int8",
                          group_size=DEFAULT_GROUP_SIZE):
-    """Inside-shard_map: int8-gather the local tile along mesh axes
+    """Inside-shard_map: quantize-gather the local tile along mesh axes
     ``ax_names``, reassembling the full dim in axis-index order (matches GSPMD
-    tiling order).  The wire payload is int8 values + one f32 scale per
-    ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu)."""
-    q, s, meta = quantize_blockwise(x, num_bits=num_bits,
-                                    group_size=group_size, use_pallas=False)
+    tiling order).  The wire payload is quantized values + one f32 scale per
+    ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu;
+    fp formats via csrc/fp_quantizer analog)."""
+    quant, dequant = _wire_codec(wire_format, group_size)
+    q, s, meta = quant(x)
     qg = jax.lax.all_gather(q, ax_names)
     sg = jax.lax.all_gather(s, ax_names)
-    parts = jax.vmap(lambda qq, ss: dequantize_blockwise(
-        qq, ss, meta, use_pallas=False))(qg, sg)
+    parts = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
     return jnp.concatenate(list(parts), axis=dim)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def _qdq_all_gather_st(x, ax_names, dim, num_bits, group_size):
-    """Straight-through quantized gather: forward is int8 gather; backward is
-    the exact VJP of a plain all-gather (reduce-scatter of the cotangent) —
-    the quantization rounding must not zero the gradient."""
-    return quantized_all_gather(x, ax_names, dim, num_bits, group_size)
+def _qdq_all_gather_st(x, ax_names, dim, wire_format, group_size):
+    """Straight-through quantized gather: forward is the quantized gather;
+    backward is the exact VJP of a plain all-gather (reduce-scatter of the
+    cotangent) — the quantization rounding must not zero the gradient."""
+    return quantized_all_gather(x, ax_names, dim, wire_format, group_size)
 
 
-def _qdq_fwd(x, ax_names, dim, num_bits, group_size):
-    return _qdq_all_gather_st(x, ax_names, dim, num_bits, group_size), None
+def _qdq_fwd(x, ax_names, dim, wire_format, group_size):
+    return _qdq_all_gather_st(x, ax_names, dim, wire_format, group_size), None
 
 
-def _qdq_bwd(ax_names, dim, num_bits, group_size, _, dy):
+def _qdq_bwd(ax_names, dim, wire_format, group_size, _, dy):
     return (jax.lax.psum_scatter(dy, ax_names, scatter_dimension=dim,
                                  tiled=True), )
 
@@ -99,12 +125,13 @@ def _qdq_bwd(ax_names, dim, num_bits, group_size, _, dy):
 _qdq_all_gather_st.defvjp(_qdq_fwd, _qdq_bwd)
 
 
-def quantized_weight_gather(params, plan, num_bits=8,
+def quantized_weight_gather(params, plan, wire_format="int8",
                             group_size=DEFAULT_GROUP_SIZE):
-    """qwZ in GSPMD mode: explicitly gather every ZeRO-sharded param with an
-    int8 payload; XLA sees already-replicated (over dp) values and inserts no
-    further gather.  Differentiable (straight-through; backward is the
-    standard reduce-scatter).  Usable both outside and inside ``jax.jit``."""
+    """qwZ in GSPMD mode: explicitly gather every ZeRO-sharded param with a
+    quantized payload; XLA sees already-replicated (over dp) values and
+    inserts no further gather.  Differentiable (straight-through; backward is
+    the standard reduce-scatter).  Usable both outside and inside
+    ``jax.jit``."""
     from .partition import path_str
     mesh = plan.param_mesh
 
@@ -116,7 +143,8 @@ def quantized_weight_gather(params, plan, num_bits=8,
         out_spec = _strip_axes(spec, dim, axes)
         # positional call: custom_vjp rejects kwargs for nondiff argnums
         fn = shard_map(
-            lambda t: _qdq_all_gather_st(t, axes, dim, num_bits, group_size),
+            lambda t: _qdq_all_gather_st(t, axes, dim, wire_format,
+                                         group_size),
             mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
         return fn(x)
 

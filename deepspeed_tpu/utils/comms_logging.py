@@ -39,12 +39,15 @@ def calc_bw_log(comm_op, size, duration, n):
 class CommsLogger:
 
     def __init__(self, enabled=False, verbose=False, prof_all=True, debug=False,
-                 prof_ops=None):
+                 prof_ops=None, sync_timing=False):
         self.enabled = enabled
         self.verbose = verbose
         self.prof_all = prof_all
         self.debug = debug
         self.prof_ops = prof_ops or []
+        # round-1 review: forcing block_until_ready on every logged
+        # collective serializes the async pipeline; sync timing is opt-in
+        self.sync_timing = sync_timing
         self.comms_dict = {}
 
     def configure(self, comms_config):
@@ -54,6 +57,8 @@ class CommsLogger:
             self.prof_all = comms_config.comms_logger.prof_all
             self.debug = comms_config.comms_logger.debug
             self.prof_ops = comms_config.comms_logger.prof_ops
+            self.sync_timing = getattr(comms_config.comms_logger,
+                                       "sync_timing", False)
 
     def start_profiling_op(self, op_name_list):
         self.prof_ops = list(set(self.prof_ops) | set(op_name_list))

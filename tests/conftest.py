@@ -24,6 +24,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: per-test engine rebuilds re-jit the same
+# programs; caching compiled executables across tests AND across pytest runs
+# is the difference between a ~10-minute and a ~2-minute suite on 1 CPU.
+_cache_dir = os.environ.get("DS_TPU_TEST_CACHE",
+                            os.path.join(os.path.dirname(__file__),
+                                         ".jax_cache"))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,207 @@
+"""Torch-DeepSpeed checkpoint migration (round-1 review item 7).
+
+The fixture writes a REAL-format ZeRO stage-2 checkpoint with torch.save —
+per-dp-rank ``*_optim_states.pt`` holding flat fp32 partitions, Adam moment
+flats, and ``param_slice_mappings`` with fragment addresses pickled under the
+``deepspeed.utils.tensor_fragment`` module path (exactly what the torch
+DeepSpeed emits, reference ``stage_1_and_2.py state_dict`` +
+``engine.py:2723`` naming) — then migrates it and resumes OUR engine from
+it, asserting weights, moments, and continued-training behavior.
+"""
+
+import collections
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.torch_migration import (
+    default_torch_to_flax, load_torch_deepspeed_checkpoint,
+    migrate_torch_checkpoint)
+from deepspeed_tpu.utils import groups
+
+D, H = 8, 12
+DP = 2  # fixture dp degree
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _reference_frag_module():
+    """A namedtuple pickled under the torch-DeepSpeed module path — SCOPED:
+    a fake ``deepspeed`` left in sys.modules breaks transformers'
+    find_spec probe in later tests."""
+    names = ("deepspeed", "deepspeed.utils",
+             "deepspeed.utils.tensor_fragment")
+    saved = {n: sys.modules.get(n) for n in names}
+    try:
+        for n in names:
+            sys.modules[n] = types.ModuleType(n)
+        frag = collections.namedtuple("fragment_address", ["numel", "start"])
+        frag.__module__ = names[-1]
+        sys.modules[names[-1]].fragment_address = frag
+        yield frag
+    finally:
+        for n, m in saved.items():
+            if m is None:
+                sys.modules.pop(n, None)
+            else:
+                sys.modules[n] = m
+
+
+def _write_fixture(root, seed=3):
+    """Handcraft a stage-2 checkpoint: 4 params, flattened+split over DP=2."""
+    with _reference_frag_module() as frag:
+        return _write_fixture_inner(root, seed, frag)
+
+
+def _write_fixture_inner(root, seed, frag):
+    rng = np.random.default_rng(seed)
+    params = collections.OrderedDict([
+        ("fc1.weight", rng.standard_normal((H, D)).astype(np.float32)),
+        ("fc1.bias", rng.standard_normal((H, )).astype(np.float32)),
+        ("fc2.weight", rng.standard_normal((D, H)).astype(np.float32)),
+        ("fc2.bias", rng.standard_normal((D, )).astype(np.float32)),
+    ])
+    moments = {
+        "exp_avg": {k: (0.01 * rng.standard_normal(v.shape)).astype(np.float32)
+                    for k, v in params.items()},
+        "exp_avg_sq": {k: (0.001 * rng.random(v.shape)).astype(np.float32)
+                       for k, v in params.items()},
+    }
+
+    tag = "global_step5"
+    os.makedirs(os.path.join(root, tag), exist_ok=True)
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write(tag)
+
+    torch.save(
+        {"module": {k: torch.tensor(v) for k, v in params.items()},
+         "global_steps": 5},
+        os.path.join(root, tag, "mp_rank_00_model_states.pt"))
+
+    # flatten in state-dict order, split into DP partitions (padded)
+    starts, offset = {}, 0
+    for k, v in params.items():
+        starts[k] = offset
+        offset += v.size
+    total = offset
+    P = -(-total // DP)
+
+    def flat_of(tree):
+        return np.concatenate([tree[k].reshape(-1) for k in params]
+                              + [np.zeros(DP * P - total, np.float32)])
+
+    flat = {"fp32": flat_of(params),
+            "exp_avg": flat_of(moments["exp_avg"]),
+            "exp_avg_sq": flat_of(moments["exp_avg_sq"])}
+
+    for r in range(DP):
+        lo, hi = r * P, (r + 1) * P
+        mapping = collections.OrderedDict()
+        for k, v in params.items():
+            s, e = starts[k], starts[k] + v.size
+            ov_lo, ov_hi = max(s, lo), min(e, hi)
+            if ov_lo < ov_hi:
+                mapping[k] = frag(numel=ov_hi - ov_lo, start=ov_lo - lo)
+        osd = {
+            "param_slice_mappings": [mapping],
+            "base_optimizer_state": {"state": [{
+                "exp_avg": torch.tensor(flat["exp_avg"][lo:hi]),
+                "exp_avg_sq": torch.tensor(flat["exp_avg_sq"][lo:hi]),
+                "step": torch.tensor(5),
+            }]},
+            "single_partition_of_fp32_groups":
+                [torch.tensor(flat["fp32"][lo:hi])],
+        }
+        torch.save(
+            {"optimizer_state_dict": osd},
+            os.path.join(root, tag,
+                         f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+    return params, moments
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, y):
+        h = jnp.tanh(nn.Dense(H, name="fc1")(x))
+        out = nn.Dense(D, name="fc2")(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def _teardown():
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+
+
+def test_migrate_layout(tmp_path):
+    ckpt = str(tmp_path / "torch_ckpt")
+    params, moments = _write_fixture(ckpt)
+    out = str(tmp_path / "universal")
+    migrate_torch_checkpoint(ckpt, out)
+    # torch [out,in] weights arrive transposed as flax kernels
+    k1 = np.load(os.path.join(out, "zero", "fc1", "kernel", "fp32.npy"))
+    np.testing.assert_allclose(k1, params["fc1.weight"].T)
+    b2 = np.load(os.path.join(out, "zero", "fc2", "bias", "fp32.npy"))
+    np.testing.assert_allclose(b2, params["fc2.bias"])
+    m = np.load(os.path.join(out, "zero", "fc2", "kernel", "exp_avg.npy"))
+    np.testing.assert_allclose(m, moments["exp_avg"]["fc2.weight"].T)
+
+
+@pytest.mark.parametrize("zero_stage", [0, 2])
+def test_resume_from_torch_checkpoint(tmp_path, zero_stage):
+    """Engine resumes from the migrated checkpoint: fp32 weights and Adam
+    moments land in master/opt_state at any ZeRO stage/mesh, and the loss
+    matches a torch forward on the same weights."""
+    ckpt = str(tmp_path / "torch_ckpt")
+    params, moments = _write_fixture(ckpt)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Net(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": zero_stage},
+                "mesh": {"dp": 8}})
+    rng = np.random.default_rng(0)
+    sample = rng.standard_normal((16, D)).astype(np.float32)
+    engine.initialize_parameters(0, sample, sample[:, :D])
+
+    load_torch_deepspeed_checkpoint(engine, ckpt)
+    assert engine.global_steps == 5
+
+    got = engine.get_fp32_param()
+    np.testing.assert_allclose(got["fc1"]["kernel"], params["fc1.weight"].T,
+                               rtol=1e-6)
+    np.testing.assert_allclose(got["fc2"]["bias"], params["fc2.bias"],
+                               rtol=1e-6)
+
+    # torch-side reference forward with the same weights
+    x = rng.standard_normal((4, D)).astype(np.float32)
+    h = np.tanh(x @ params["fc1.weight"].T + params["fc1.bias"])
+    ref_out = h @ params["fc2.weight"].T + params["fc2.bias"]
+    y = rng.standard_normal((4, D)).astype(np.float32)
+    ref_loss = float(np.mean((ref_out - y) ** 2))
+
+    engine.eval()
+    loss = engine(np.tile(x, (4, 1)), np.tile(y, (4, 1)))
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+
+    # migrated moments are live: a step changes weights without blowing up
+    engine.train()
+    loss = engine(np.tile(x, (4, 1)), np.tile(y, (4, 1)))
+    engine.backward(loss)
+    engine.step()
+    after = engine.get_fp32_param()
+    assert not np.allclose(after["fc1"]["kernel"], got["fc1"]["kernel"])
+    _teardown()

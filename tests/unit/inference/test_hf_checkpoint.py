@@ -1,0 +1,106 @@
+"""HF checkpoint ingestion + ragged serving parity vs transformers.
+
+Reference analog: ``inference/v2/checkpoint/huggingface_engine.py`` +
+``model_implementations/{llama_v2,mixtral,qwen_v2}`` — here verified by
+building a *tiny random* HF model with transformers (torch CPU), saving it in
+the real safetensors layout, loading through our checkpoint engine, and
+asserting logits parity and greedy-decode agreement.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.inference.v2 import build_hf_engine
+from deepspeed_tpu.inference.v2.checkpoint import HuggingFaceCheckpointEngine
+from deepspeed_tpu.inference.v2.model_implementations import (
+    build_model_and_params)
+
+ENGINE_CFG = dict(
+    dtype="float32",
+    state_manager=dict(max_tracked_sequences=8, max_ragged_batch_size=32,
+                       max_ragged_sequence_count=8, max_context=128,
+                       block_size=16, num_blocks=40))
+
+
+def _hf_llama(tmp_path, tie=False, model_type="llama"):
+    kw = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=128,
+              tie_word_embeddings=tie)
+    if model_type == "llama":
+        cfg = transformers.LlamaConfig(**kw)
+        cls = transformers.LlamaForCausalLM
+    elif model_type == "mistral":
+        cfg = transformers.MistralConfig(sliding_window=None, **kw)
+        cls = transformers.MistralForCausalLM
+    elif model_type == "qwen2":
+        cfg = transformers.Qwen2Config(**kw)
+        cls = transformers.Qwen2ForCausalLM
+    else:
+        cfg = transformers.MixtralConfig(num_local_experts=4,
+                                         num_experts_per_tok=2, **kw)
+        cls = transformers.MixtralForCausalLM
+    torch.manual_seed(7)
+    model = cls(cfg)
+    model.eval()
+    path = str(tmp_path / model_type)
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def _hf_logits(model, ids):
+    with torch.no_grad():
+        return model(torch.tensor(ids)).logits.float().numpy()
+
+
+@pytest.mark.parametrize("model_type", ["llama", "mistral", "qwen2",
+                                        "mixtral"])
+def test_hf_prefill_logits_parity(tmp_path, model_type):
+    """Full-sequence logits through our flax model == transformers."""
+    hf_model, path = _hf_llama(tmp_path, model_type=model_type)
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 17),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("model_type", ["llama", "mixtral"])
+def test_hf_ragged_greedy_decode_parity(tmp_path, model_type):
+    """build_hf_engine serves the checkpoint; greedy continuous-batching
+    decode matches transformers' greedy generate."""
+    hf_model, path = _hf_llama(tmp_path, model_type=model_type)
+    engine = build_hf_engine(path, engine_config=dict(ENGINE_CFG))
+
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 96, size=n).tolist() for n in (5, 11, 3)]
+    n_new = 8
+    ours = engine.generate(prompts, max_new_tokens=n_new)
+
+    for prompt, generated in zip(prompts, ours):
+        out = hf_model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            pad_token_id=0)
+        expected = out[0, len(prompt):].tolist()
+        assert generated == expected
+
+
+def test_hf_tied_embeddings(tmp_path):
+    hf_model, path = _hf_llama(tmp_path, tie=True)
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    assert "lm_head" not in params
+    ids = np.arange(12, dtype=np.int32)[None]
+    ours = np.asarray(model.apply({"params": params}, ids))
+    theirs = _hf_logits(hf_model, ids.astype(np.int64))
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_engine_rejects_nonlocal():
+    with pytest.raises(ValueError, match="local directory"):
+        HuggingFaceCheckpointEngine("meta-llama/Llama-2-7b-hf")

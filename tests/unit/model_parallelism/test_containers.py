@@ -1,0 +1,50 @@
+"""Injection-policy containers (reference ``module_inject/containers/`` +
+``replace_module.py:183``): arch lookup and checkpoint-backed injection."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.module_inject import (POLICIES, policy_for,
+                                         replace_transformer_layer)
+
+
+def test_policy_lookup_forms():
+    assert policy_for("llama").model_type == "llama"
+    assert policy_for({"model_type": "mixtral"}).model_type == "mixtral"
+    assert policy_for("no_such_arch") is None
+    assert set(POLICIES) >= {"llama", "llama2", "mistral", "qwen2", "mixtral"}
+
+
+def test_replace_from_config_dict():
+    cfg = dict(model_type="llama", vocab_size=64, hidden_size=32,
+               intermediate_size=64, num_hidden_layers=1,
+               num_attention_heads=4, num_key_value_heads=2)
+    model, params = replace_transformer_layer("llama", config=cfg,
+                                              dtype="float32")
+    assert params is None
+    import jax
+    import jax.numpy as jnp
+    p = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    out = model.apply(p, jnp.zeros((1, 8), jnp.int32))
+    assert out.shape == (1, 8, 64)
+
+
+def test_replace_from_checkpoint(tmp_path):
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg)
+    path = str(tmp_path / "ckpt")
+    hf.save_pretrained(path, safe_serialization=True)
+    model, params = replace_transformer_layer("llama", checkpoint_dir=path,
+                                              dtype="float32")
+    import numpy as np
+    ids = np.zeros((1, 6), np.int32)
+    ours = np.asarray(model.apply({"params": params}, ids))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)

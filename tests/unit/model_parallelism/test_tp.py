@@ -153,3 +153,67 @@ def test_inference_tp_rules_with_zero_placeholder():
     specs = jax.tree_util.tree_map(lambda x: x.sharding.spec, out)
     assert specs["layers_0"]["self_attn"]["q_proj"]["kernel"] == \
         jax.sharding.PartitionSpec(None, "tp", None)
+
+
+# ------------------------------------------------------- dataflow TP parser
+def test_dataflow_parser_matches_hand_rules():
+    """The jaxpr taint parser (reference tp_parser analog) must reproduce the
+    hand-written llama rules exactly and classify mixtral experts."""
+    from deepspeed_tpu.module_inject.tp_parser import (
+        TpParser, derive_tp_rules_from_dataflow)
+    from deepspeed_tpu.models import mixtral as mixtral_mod
+
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    m = llama.LlamaModel(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = jax.eval_shape(m.init, jax.random.PRNGKey(0), ids)["params"]
+    auto = derive_tp_rules_from_dataflow(
+        lambda p, x: m.apply({"params": p}, x), params, ids)
+    hand = llama.tp_rules(cfg)
+    for key, spec in hand.items():
+        assert auto.get(key) == spec, (key, auto.get(key), spec)
+
+    cfg2 = mixtral_mod.mixtral_tiny(dtype="float32", remat=False)
+    m2 = mixtral_mod.MixtralModel(cfg2)
+    params2 = jax.eval_shape(m2.init, jax.random.PRNGKey(0), ids)["params"]
+    classes = TpParser().parse(
+        lambda p, x: m2.apply({"params": p}, x), params2, ids)
+    col = {c.split("/")[-1] for c in classes["expert_column"]}
+    row = {c.split("/")[-1] for c in classes["expert_row"]}
+    assert col == {"w1", "w3"} and row == {"w2"}
+    routers = {c.split("/")[-2] for c in classes["router"]}
+    assert routers == {"gate"}
+
+
+def test_tp_rules_none_auto_derives():
+    """tp_rules=None with tp>1: the engine derives rules from dataflow and
+    the run matches the hand-rules run (VERDICT round-1 item 6)."""
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    dp = 4
+    def run(rules):
+        model = llama.LlamaModel(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, tp_rules=rules,
+            config={"train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "mesh": {"tp": 2, "dp": -1}})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           size=(GLOBAL_BATCH, 16)).astype(np.int32)
+        engine.initialize_parameters(0, ids, ids)
+        assert engine.plan.tp_rules, "no TP rules in effect"
+        losses = []
+        for _ in range(3):
+            loss = engine(ids, ids)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        import deepspeed_tpu.comm as dist
+        groups.reset_mesh()
+        dist.destroy_process_group()
+        return losses
+
+    auto_losses = run(None)
+    hand_losses = run(llama.tp_rules(cfg))
+    np.testing.assert_allclose(auto_losses, hand_losses, rtol=2e-4, atol=1e-5)

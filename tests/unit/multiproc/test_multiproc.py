@@ -1,0 +1,124 @@
+"""Multi-process correctness harness (reference ``DistributedExec``/
+``DistributedTest``, ``tests/unit/common.py:129``): launch 2 real processes
+× 4 virtual CPU devices over a jax.distributed coordinator and assert the
+ZeRO losses match a single-process 8-device run bit-for-bit-ish.
+
+This is the test the round-1 review flagged as missing: per-process data
+feeding (``make_array_from_process_local_data``), real dp ranks, and the
+distributed checkpoint path only exist when >1 process runs.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+WORKER = os.path.join(os.path.dirname(__file__), "worker_zero_parity.py")
+D = 16
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_workers(zero_stage, ckpt_dir="", timeout=420):
+    port = _free_port()
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                             "..", "..", ".."))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), "2", str(port),
+             str(zero_stage), ckpt_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n--- stdout\n{out}\n--- stderr\n{err[-3000:]}"
+    losses = None
+    for rc, out, err in outs:
+        for line in out.splitlines():
+            if line.startswith("LOSSES "):
+                losses = [float(v) for v in line.split()[1:]]
+    assert losses is not None, "rank 0 printed no LOSSES line"
+    return losses
+
+
+def _single_process_reference(zero_stage, with_ckpt=False, tmp_path=None):
+    """Same training run on the in-process 8-device mesh."""
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            h = jnp.tanh(nn.Dense(32, name="fc1")(x))
+            out = nn.Dense(D, name="fc2")(h)
+            return jnp.mean((out - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Net(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": zero_stage},
+                "mesh": {"dp": 8}})
+    rng = np.random.default_rng(0)
+    W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
+    sample = rng.standard_normal((8, D)).astype(np.float32)
+    engine.initialize_parameters(0, sample, sample @ W)
+
+    losses = []
+    for step in range(4):
+        if with_ckpt and step == 2:
+            engine.save_checkpoint(str(tmp_path / "sp_ckpt"), tag="mp")
+            engine.load_checkpoint(str(tmp_path / "sp_ckpt"), tag="mp")
+        x = rng.standard_normal((8, D)).astype(np.float32)
+        y = x @ W
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    return losses
+
+
+@pytest.mark.parametrize("zero_stage", [1, 3])
+def test_two_process_zero_matches_single_process(zero_stage, tmp_path):
+    got = _launch_workers(zero_stage)
+    ref = _single_process_reference(zero_stage)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_two_process_checkpoint_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "mp_ckpt")
+    got = _launch_workers(2, ckpt_dir=ckpt)
+    ref = _single_process_reference(2, with_ckpt=True, tmp_path=tmp_path)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+    assert os.path.isdir(ckpt)

@@ -1,0 +1,95 @@
+"""Multi-process worker: 2 processes × 4 virtual CPU devices = one 8-device
+mesh (the reference's ``DistributedExec`` spawns real processes the same way,
+``tests/unit/common.py:129``; rendezvous = jax.distributed coordinator
+instead of a torch FileStore).
+
+Each process feeds ITS dp shard of the global batch (per-process data
+loading, ``engine.shard_batch`` + ``groups._get_data_parallel_rank``), runs
+ZeRO training steps, and rank 0 prints per-step losses for the parent test
+to compare against a single-process run.  Optionally round-trips a
+checkpoint mid-run.
+
+Usage: worker_zero_parity.py <pid> <nproc> <port> <zero_stage> <ckpt_dir?>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    zero_stage = int(sys.argv[4])
+    ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else ""
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_COUNT"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    import deepspeed_tpu
+    from deepspeed_tpu.utils import groups
+
+    D = 16
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            h = jnp.tanh(nn.Dense(32, name="fc1")(x))
+            out = nn.Dense(D, name="fc2")(h)
+            return jnp.mean((out - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Net(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": zero_stage},
+                "mesh": {"dp": 8}})
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 8
+    dp_rank = groups._get_data_parallel_rank()
+    assert dp_rank == pid * 4, (dp_rank, pid)
+    local_rows = 8 // nproc
+
+    rng = np.random.default_rng(0)
+    W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
+    sample = rng.standard_normal((8, D)).astype(np.float32)
+    engine.initialize_parameters(0, sample, sample @ W)
+
+    def run_steps(n):
+        out = []
+        for _ in range(n):
+            x = rng.standard_normal((8, D)).astype(np.float32)
+            y = x @ W
+            sl = slice(dp_rank, dp_rank + local_rows)
+            loss = engine(x[sl], y[sl])
+            engine.backward(loss)
+            engine.step()
+            out.append(float(loss))
+        return out
+
+    losses = run_steps(2)
+    if ckpt_dir:
+        engine.save_checkpoint(ckpt_dir, tag="mp")
+        engine.load_checkpoint(ckpt_dir, tag="mp")
+    losses += run_steps(2)
+
+    if pid == 0:
+        print("LOSSES " + " ".join(f"{v:.8f}" for v in losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,22 +28,33 @@ def mse_loss(out, labels):
     return jnp.mean((out - labels) ** 2)
 
 
-def _make_module():
+def _make_module(n_layers=4):
     return PipelineModule(
-        layers=[LayerSpec(Block) for _ in range(4)],
+        layers=[LayerSpec(Block) for _ in range(n_layers)],
         loss_fn=mse_loss)
 
 
-def _run(pp, gas=4, steps=4, seed=0, lr=5e-3):
-    model = _make_module()
+def _make_engine(pp, gas=4, n_layers=4):
+    model = _make_module(n_layers)
     dp = 8 // pp
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={"train_micro_batch_size_per_gpu": 32 // dp // gas,
                 "gradient_accumulation_steps": gas,
-                "optimizer": {"type": "adam", "params": {"lr": lr}},
+                "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
                 "zero_optimization": {"stage": 1},
                 "mesh": {"pp": pp, "dp": -1}})
+    return engine
+
+
+def _teardown():
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+
+
+def _run(pp, gas=4, steps=4, seed=0, n_layers=4):
+    engine = _make_engine(pp, gas=gas, n_layers=n_layers)
     rng = np.random.default_rng(seed)
     W = rng.standard_normal((D, D)).astype(np.float32) * 0.3
     sample_x = rng.standard_normal((4, D)).astype(np.float32)
@@ -57,9 +68,7 @@ def _run(pp, gas=4, steps=4, seed=0, lr=5e-3):
 
     it = data_gen()
     losses = [float(engine.train_batch(it)) for _ in range(steps)]
-    import deepspeed_tpu.comm as dist
-    groups.reset_mesh()
-    dist.destroy_process_group()
+    _teardown()
     return losses
 
 
@@ -87,6 +96,53 @@ def test_train_schedule_instruction_stream():
         assert len(fwd) == 6
         assert len(bwd) == 6
         assert isinstance(cmds[-1], OptimizerStep)
+
+
+def test_pp_uneven_blocks():
+    """5 blocks on pp=2 (3+2 with one pad slot) matches pp=1 exactly."""
+    ref = _run(pp=1, n_layers=5)
+    got = _run(pp=2, n_layers=5)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_pipe_compile_size_flat_in_microbatches():
+    """The fused pipeline is a scan over ticks: the traced program must not
+    grow with M (round-1 weakness: unrolled loop, compile O(M·pp))."""
+    engine = _make_engine(pp=2, gas=2)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, D)).astype(np.float32)
+    engine.initialize_parameters(0, x, x)
+
+    def n_eqns(M):
+        loss = engine._pipe_loss_fn(M)
+        batch = jnp.zeros((M, 8, D), jnp.float32)
+        jaxpr = jax.make_jaxpr(loss)(engine.params, batch, batch)
+        return sum(1 for _ in jaxpr.jaxpr.eqns)
+
+    assert n_eqns(32) == n_eqns(4)
+    _teardown()
+
+
+def test_pipe_eval_batch_uses_pipeline():
+    """eval_batch runs the fused pipelined program (round 1 bypassed it) and
+    return_logits gathers the last stage's outputs."""
+    engine = _make_engine(pp=2, gas=2)
+    rng = np.random.default_rng(3)
+    W = rng.standard_normal((D, D)).astype(np.float32) * 0.3
+    x0 = rng.standard_normal((4, D)).astype(np.float32)
+    engine.initialize_parameters(0, x0, x0 @ W)
+
+    x = rng.standard_normal((8, D)).astype(np.float32)
+    loss, logits = engine.eval_batch(iter([(x, x @ W)]), return_logits=True)
+    # reference loss: run the plain (non-pipelined) apply on the same params
+    plain = engine._plain_gas_loss_fn()
+    expect = plain(engine.params, jnp.asarray(x)[None],
+                   jnp.asarray(x @ W)[None])
+    np.testing.assert_allclose(float(loss), float(expect), rtol=1e-5)
+    assert logits.shape == (8, D)
+    expect_mse = float(np.mean((np.asarray(logits) - (x @ W)) ** 2))
+    np.testing.assert_allclose(float(loss), expect_mse, rtol=1e-4)
+    _teardown()
 
 
 def test_partition_methods():

@@ -1,0 +1,63 @@
+"""Domino / TP-overlap measurement (reference ``runtime/domino`` —
+TPU answer: XLA latency-hiding scheduler + the evidence tool)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.domino import (DominoTransformerLayer,
+                                          measure_tp_overlap)
+from deepspeed_tpu.runtime.domino.overlap import analyze_hlo_overlap
+
+
+def test_measure_tp_overlap_reports_collectives():
+    """A TP matmul (row-parallel → psum) must show collectives in the
+    optimized module; on TPU they appear as async start/done pairs (asserted
+    structurally here on CPU: collectives > 0 and the report is shaped)."""
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("tp", ))
+    rng = np.random.default_rng(0)
+    W1 = jax.device_put(rng.standard_normal((64, 128)).astype(np.float32),
+                        NamedSharding(mesh, P(None, "tp")))
+    W2 = jax.device_put(rng.standard_normal((128, 64)).astype(np.float32),
+                        NamedSharding(mesh, P("tp", None)))
+
+    def step(x, w1, w2):
+        h = jnp.tanh(x @ w1)      # column-parallel
+        return (h @ w2).sum()     # row-parallel → all-reduce
+
+    x = np.ones((8, 64), np.float32)
+    report = measure_tp_overlap(step, x, W1, W2)
+    assert report["collectives"] >= 1, report
+    assert set(report) >= {"collectives", "async_pairs", "overlapped_pairs",
+                           "overlapped", "backend"}
+
+
+def test_analyze_hlo_overlap_detects_async_windows():
+    """Synthetic TPU-style schedule: start → compute → done counts as an
+    overlapped pair; a bare sync collective counts as non-async."""
+    hlo = """
+HloModule m
+  %ar = f32[8]{0} all-reduce-start(f32[8]{0} %p0), replica_groups={}
+  %f0 = f32[8]{0} fusion(f32[8]{0} %p1), kind=kLoop
+  %d = f32[8]{0} all-reduce-done(f32[8]{0} %ar)
+  %sync = f32[8]{0} all-gather(f32[8]{0} %p2), dimensions={0}
+"""
+    rep = analyze_hlo_overlap(hlo)
+    assert rep["async_pairs"] == 1
+    assert rep["overlapped_pairs"] == 1
+    assert rep["collectives"] == 2
+
+
+def test_domino_layer_alias():
+    import flax.linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    layer = DominoTransformerLayer(Block)
+    assert isinstance(layer, Block)

@@ -1,0 +1,162 @@
+"""NVMe optimizer-state offload wired into step() (reference
+``stage3.py:1926 _optimizer_states_and_gradient_swap_in`` +
+``swap_tensor/partitioned_optimizer_swapper.py``; round-1 review item 8:
+"an offload test that asserts the footprint actually shrinks")."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import groups
+
+D = 32
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, y):
+        h = jnp.tanh(nn.Dense(64, name="fc1")(x))
+        out = nn.Dense(D, name="fc2")(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def _teardown():
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+
+
+def _make(tmp_path, nvme):
+    zero = {"stage": 2}
+    if nvme:
+        zero["offload_optimizer"] = {"device": "nvme",
+                                     "nvme_path": str(tmp_path / "swap")}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Net(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "zero_optimization": zero,
+                "mesh": {"dp": 8}})
+    rng = np.random.default_rng(0)
+    W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
+    sample = rng.standard_normal((16, D)).astype(np.float32)
+    engine.initialize_parameters(0, sample, sample @ W)
+    return engine, W
+
+
+def _train(engine, W, steps=4):
+    rng = np.random.default_rng(7)
+    losses = []
+    for _ in range(steps):
+        for _ in range(engine.gradient_accumulation_steps()):
+            x = rng.standard_normal((16, D)).astype(np.float32)
+            y = x @ W
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_nvme_offload_matches_hbm_run(tmp_path):
+    """Training through the NVMe swap path is numerically identical."""
+    engine, W = _make(tmp_path, nvme=True)
+    got = _train(engine, W)
+    _teardown()
+    engine2, W2 = _make(tmp_path, nvme=False)
+    ref = _train(engine2, W2)
+    _teardown()
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
+
+
+def test_nvme_offload_state_leaves_device(tmp_path):
+    """Between steps the master+moments hold no device buffers: engine refs
+    are dropped and the bytes live in swap files on disk."""
+    engine, W = _make(tmp_path, nvme=True)
+    _train(engine, W, steps=2)
+    # state is on disk, not referenced by the engine
+    assert engine._state_on_nvme
+    assert engine.master is None and engine.opt_state is None
+    swap_root = tmp_path / "swap"
+    files = [os.path.join(dp, f) for dp, _, fs in os.walk(swap_root)
+             for f in fs if f.endswith(".swp")]
+    assert files, "no swap files written"
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(engine.params))
+    swap_bytes = sum(os.path.getsize(f) for f in files)
+    # fp32 master + adam mu/nu ≈ 3 trees of n_params fp32
+    assert swap_bytes >= 3 * n_params * 4
+    # and resumability: checkpoint APIs transparently swap back in
+    fp32 = engine.get_fp32_param()
+    assert not engine._state_on_nvme
+    assert jax.tree_util.tree_leaves(fp32)
+    _teardown()
+
+
+def test_nvme_offload_live_device_bytes_shrink(tmp_path):
+    """jax.live_arrays() accounting: the offload run holds ~3 fp32 trees
+    fewer device bytes between steps than the HBM run."""
+
+    def measure(nvme):
+        engine, W = _make(tmp_path / ("a" if nvme else "b"), nvme)
+        _train(engine, W, steps=1)
+        live = sum(a.nbytes for a in jax.live_arrays()
+                   if a.dtype != jnp.int32)
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(engine.params))
+        _teardown()
+        del engine
+        return live, n_params
+
+    live_off, n_params = measure(True)
+    live_on, _ = measure(False)
+    # master + mu + nu = 3 fp32 copies moved off-device (per-device shard
+    # sizes don't matter here: live_arrays sums global logical bytes)
+    assert live_on - live_off >= 2.5 * n_params * 4, (live_on, live_off)
+
+
+def test_nvme_offload_with_pipeline_engine(tmp_path):
+    """pp>1 + NVMe offload: train_batch must swap state in/out (review
+    regression: step_fn got master=None and crashed)."""
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x + jnp.tanh(nn.Dense(D, name="fc")(x))
+
+    pm = PipelineModule(layers=[LayerSpec(Block) for _ in range(4)],
+                        loss_fn=lambda o, y: jnp.mean((o - y) ** 2))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pm,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1,
+                    "offload_optimizer": {"device": "nvme",
+                                          "nvme_path": str(tmp_path)}},
+                "mesh": {"pp": 2, "dp": -1}})
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((4, D)).astype(np.float32)
+    engine.initialize_parameters(0, x0, x0)
+
+    def gen():
+        while True:
+            x = rng.standard_normal((8, D)).astype(np.float32)
+            yield (x, 0.5 * x)
+
+    it = gen()
+    l0 = float(engine.train_batch(it))
+    l1 = float(engine.train_batch(it))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert engine._state_on_nvme and engine.master is None
+    _teardown()
