@@ -101,6 +101,56 @@ def run_bench(on_tpu: bool) -> dict:
     }
 
 
+def run_serve_bench(on_tpu: bool) -> dict:
+    """FastGen-v2 serving throughput: continuous batching over the ragged
+    engine with the paged KV cache (reference FastGen headline is effective
+    tokens/s; BASELINE.md row 'FastGen serving')."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", remat=False)
+        n_seqs, prompt_len, new_tokens = 32, 256, 64
+        sm = dict(max_tracked_sequences=64, max_ragged_batch_size=512,
+                  max_ragged_sequence_count=64, max_context=1024,
+                  block_size=128)
+    else:
+        cfg = llama.llama_tiny(dtype="float32", remat=False)
+        n_seqs, prompt_len, new_tokens = 4, 16, 8
+        sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+                  max_ragged_sequence_count=8, max_context=128,
+                  block_size=16, num_blocks=40)
+
+    model = llama.LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
+    eng = InferenceEngineV2(model, params=params,
+                            config=dict(dtype=cfg.dtype, state_manager=sm))
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
+               for _ in range(n_seqs)]
+    # warmup (compile prefill+decode shapes)
+    eng.generate(prompts[:2], max_new_tokens=2)
+    eng.flush(range(2))
+    t0 = time.perf_counter()
+    out = eng.generate(prompts, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    generated = sum(len(o) for o in out)
+    return {
+        "metric": "fastgen_serve_tokens_per_sec",
+        "value": round(generated / dt, 1),
+        "unit": (f"generated tokens/s (seqs={n_seqs} prompt={prompt_len} "
+                 f"new={new_tokens} backend={jax.default_backend()})"),
+        "vs_baseline": 0.0,  # no in-repo reference number (BASELINE.md)
+    }
+
+
 def _child_device():
     """Benchmark on the default platform (TPU when the tunnel is up)."""
     import jax
@@ -180,10 +230,22 @@ def main():
         }), flush=True)
 
 
+def _child_serve(force_cpu: bool):
+    import jax
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() not in ("cpu", )
+    print(json.dumps(run_serve_bench(on_tpu)), flush=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--mode":
         if sys.argv[2] == "device":
             _child_device()
+        elif sys.argv[2] == "serve":
+            _child_serve(force_cpu=False)
+        elif sys.argv[2] == "serve-cpu":
+            _child_serve(force_cpu=True)
         else:
             _child_cpu()
     else:

@@ -42,14 +42,46 @@ class DSElasticAgent:
         except ElasticityIncompatibleWorldSize:
             return False
 
-    def run(self, world_size):
+    def _elastic_env(self, world_size, coordinator=None):
+        """Worker env for the (possibly rescaled) world: rendezvous address
+        + recomputed batch schedule exported as DS_ELASTIC_* (the worker's
+        ``deepspeed.initialize`` resolves its micro-batch from these like the
+        reference reads torchelastic's rendezvous results)."""
+        env = dict(self.env)
+        env["WORLD_SIZE"] = str(world_size)
+        # must track the rescaled world — a stale inherited value would make
+        # survivors rendezvous for the OLD process count and hang forever
+        env["JAX_PROCESS_COUNT"] = str(world_size)
+        if coordinator is not None:
+            env["COORDINATOR_ADDRESS"] = coordinator
+            env["MASTER_ADDR"], _, port = coordinator.partition(":")
+            env["MASTER_PORT"] = port or env.get("MASTER_PORT", "29500")
+        if self.ds_config is not None:
+            final, _, micro = compute_elastic_config(
+                self.ds_config, world_size=world_size, return_microbatch=True)
+            env["DS_ELASTIC_TRAIN_BATCH_SIZE"] = str(final)
+            env["DS_ELASTIC_MICRO_BATCH_SIZE"] = str(micro)
+            env["DS_ELASTIC_WORLD_SIZE"] = str(world_size)
+        return env
+
+    def run(self, world_size, rescale=None, coordinator=None):
         """Supervise one local worker; restart on failure up to
-        max_restarts as long as the world size stays admissible."""
+        max_restarts as long as the world size stays admissible.
+
+        ``rescale``: optional callback ``(world_size, restart_count) →
+        (new_world_size, new_coordinator | None)`` consulted after each
+        failure — the TPU-pod rescale story (reference DSElasticAgent's
+        torchelastic rendezvous shrink): a dead host's capacity is dropped,
+        the batch schedule re-solves for the surviving chip count, and the
+        workers restart into a fresh jax.distributed rendezvous, resuming
+        from the latest checkpoint.
+        """
         while True:
             if not self._validate_world(world_size):
                 raise ElasticityIncompatibleWorldSize(
                     f"cannot run with world size {world_size}")
-            proc = subprocess.Popen(self.cmd, env=self.env)
+            env = self._elastic_env(world_size, coordinator)
+            proc = subprocess.Popen(self.cmd, env=env)
             while proc.poll() is None:
                 time.sleep(self.monitor_interval)
             if proc.returncode == 0:
@@ -58,6 +90,16 @@ class DSElasticAgent:
             if self.restart_count > self.max_restarts:
                 logger.error("elastic agent: max restarts exceeded")
                 return proc.returncode
+            if rescale is not None:
+                new_world, new_coord = rescale(world_size,
+                                               self.restart_count)
+                if new_world != world_size:
+                    logger.warning(
+                        "elastic agent: rescaling world %d → %d",
+                        world_size, new_world)
+                world_size = new_world
+                coordinator = new_coord or coordinator
             logger.warning(
-                "elastic agent: worker died rc=%s; restart %d/%d",
-                proc.returncode, self.restart_count, self.max_restarts)
+                "elastic agent: worker died rc=%s; restart %d/%d "
+                "(world=%d)", proc.returncode, self.restart_count,
+                self.max_restarts, world_size)

@@ -35,6 +35,19 @@ def build_hf_engine(path: str,
     return InferenceEngineV2(model, params=params, config=engine_config)
 
 
+class _ConfiguredCheckpoint(CheckpointEngineBase):
+    """Pairs any checkpoint engine with an explicit model config (some
+    engines expose ``model_config`` as a read-only property — never assign
+    onto them)."""
+
+    def __init__(self, inner, model_config):
+        self._inner = inner
+        self.model_config = model_config
+
+    def parameters(self):
+        return self._inner.parameters()
+
+
 def build_engine_from_checkpoint(checkpoint: CheckpointEngineBase,
                                  model_config: dict,
                                  engine_config: Optional[
@@ -44,7 +57,7 @@ def build_engine_from_checkpoint(checkpoint: CheckpointEngineBase,
     ``build_engine_from_ds_checkpoint``)."""
     if engine_config is None:
         engine_config = RaggedInferenceEngineConfig()
-    checkpoint.model_config = model_config
-    model, params = build_model_and_params(checkpoint,
-                                           dtype=engine_config.dtype)
+    model, params = build_model_and_params(
+        _ConfiguredCheckpoint(checkpoint, model_config),
+        dtype=engine_config.dtype)
     return InferenceEngineV2(model, params=params, config=engine_config)

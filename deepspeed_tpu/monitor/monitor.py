@@ -62,6 +62,38 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Reference ``monitor/monitor.py`` CometMonitor: comet_ml experiment
+    logging (optional dependency, degrades to disabled)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.experiment = None
+        if self.enabled:
+            try:
+                import comet_ml
+                self.experiment = comet_ml.Experiment(
+                    api_key=getattr(config, "api_key", None),
+                    project_name=getattr(config, "project", None),
+                    workspace=getattr(config, "workspace", None))
+                name = getattr(config, "experiment_name", None)
+                if name:
+                    self.experiment.set_name(name)
+            except Exception as e:
+                # Experiment() also raises on bad/missing API keys or no
+                # connectivity — a monitoring misconfig must not kill the
+                # training run
+                logger.warning("Comet monitor unavailable (%s: %s); "
+                               "disabling", type(e).__name__, e)
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.experiment is None:
+            return
+        for name, value, step in event_list:
+            self.experiment.log_metric(name, value, step=step)
+
+
 class csv_monitor(Monitor):
 
     def __init__(self, config):
@@ -93,8 +125,10 @@ class MonitorMaster(Monitor):
         super().__init__(monitor_config)
         self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
         self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.comet_monitor = CometMonitor(monitor_config.comet)
         self.csv_monitor = csv_monitor(monitor_config.csv_monitor)
         self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.comet_monitor.enabled
                         or self.csv_monitor.enabled)
 
     def write_events(self, event_list):
@@ -102,5 +136,7 @@ class MonitorMaster(Monitor):
             self.tb_monitor.write_events(event_list)
         if self.wandb_monitor.enabled:
             self.wandb_monitor.write_events(event_list)
+        if self.comet_monitor.enabled:
+            self.comet_monitor.write_events(event_list)
         if self.csv_monitor.enabled:
             self.csv_monitor.write_events(event_list)

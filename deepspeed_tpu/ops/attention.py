@@ -37,6 +37,9 @@ def _use_pallas():
     return not interpret_mode()
 
 
+_fallback_warned = False
+
+
 def attention_core(q, k, v, causal=True, softmax_scale=None):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere."""
     if _use_pallas():
@@ -44,6 +47,16 @@ def attention_core(q, k, v, causal=True, softmax_scale=None):
             from .pallas.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale)
-        except Exception:
-            pass
+        except Exception as e:
+            # LOUD: a silent fall-through here would quietly trade the flash
+            # kernel for O(S²)-memory XLA attention on real hardware
+            global _fallback_warned
+            if not _fallback_warned:
+                _fallback_warned = True
+                from ..utils.logging import logger
+                logger.warning(
+                    "Pallas flash attention failed on this platform "
+                    "(%s: %s) — falling back to XLA attention; expect "
+                    "lower MFU at long sequence lengths",
+                    type(e).__name__, e)
     return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)

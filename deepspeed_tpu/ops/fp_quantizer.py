@@ -223,7 +223,11 @@ def dequantize_fp(packed, scales, meta, use_pallas=None):
 
 
 class FP_Quantize:
-    """Reference ``deepspeed/ops/fp_quantizer/quantize.py`` API surface."""
+    """Reference ``deepspeed/ops/fp_quantizer/quantize.py`` API surface.
+
+    Stateless w.r.t. payloads: pass ``meta`` (third return of ``quantize``
+    with ``return_meta_tensor=True``) back into ``dequantize`` — one
+    instance may serve many tensors/formats concurrently."""
 
     def __init__(self, group_size=512):
         self.group_size = group_size
@@ -233,10 +237,23 @@ class FP_Quantize:
         packed, scales, meta = quantize_fp(
             input, q_bits=q_bits, mantissa_bits=q_mantisa_bits,
             group_size=self.group_size)
-        self._meta = meta
         if return_meta_tensor:
-            return packed, scales
+            return packed, scales, meta
+        self._last_meta = meta
         return packed, scales
 
-    def dequantize(self, input_q, scale=None, q_bits=8, q_mantisa_bits=3):
-        return dequantize_fp(input_q, scale, self._meta)
+    def dequantize(self, input_q, scale=None, meta=None, q_bits=8,
+                   q_mantisa_bits=3):
+        if meta is None:
+            meta = getattr(self, "_last_meta", None)
+            if meta is None:
+                raise ValueError(
+                    "dequantize needs the meta from quantize(..., "
+                    "return_meta_tensor=True) (or an immediately preceding "
+                    "quantize call on this instance)")
+            if meta[3] != q_bits or meta[4] != q_mantisa_bits:
+                raise ValueError(
+                    f"payload format ({q_bits},{q_mantisa_bits}) does not "
+                    f"match the last quantize call ({meta[3]},{meta[4]}) — "
+                    "pass meta explicitly")
+        return dequantize_fp(input_q, scale, meta)

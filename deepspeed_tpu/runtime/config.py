@@ -97,9 +97,17 @@ class MonitorConfig(DeepSpeedConfigModel):
         output_path: str = ""
         job_name: str = "DeepSpeedJobName"
 
+    class CometConfig(DeepSpeedConfigModel):
+        enabled: bool = False
+        api_key: Optional[str] = None
+        project: Optional[str] = None
+        workspace: Optional[str] = None
+        experiment_name: Optional[str] = None
+
     tensorboard: TensorBoardConfig = TensorBoardConfig()
     wandb: WandbConfig = WandbConfig()
     csv_monitor: CSVConfig = CSVConfig()
+    comet: CometConfig = CometConfig()
 
 
 class FlopsProfilerConfig(DeepSpeedConfigModel):
@@ -282,7 +290,7 @@ class DeepSpeedConfig:
         self.monitor_config = MonitorConfig(**{
             k: v
             for k, v in pd.items()
-            if k in ("tensorboard", "wandb", "csv_monitor")
+            if k in ("tensorboard", "wandb", "csv_monitor", "comet")
         })
         self.comms_config = CommsConfig(**pd.get("comms_logger", {})
                                         and {"comms_logger": pd.get("comms_logger")})
@@ -339,8 +347,21 @@ class DeepSpeedConfig:
 
     def resolve_batch_sizes(self, dp_world_size):
         """Complete the trinity given the DP degree (called by the engine once
-        the mesh is built).  Mirrors reference assertions (~config.py:837+)."""
+        the mesh is built).  Mirrors reference assertions (~config.py:837+).
+
+        Under elastic training the agent exports the re-solved schedule as
+        DS_ELASTIC_* env (reference: torchelastic rendezvous feeds the
+        elastic batch math into ``_configure_train_batch_size``); those
+        override the static JSON numbers so a rescaled restart picks up the
+        new world's batch sizes without editing the config file."""
+        import os as _os
         tb, mb, gas = self._raw_batch
+        if (self.elasticity_config is not None
+                and getattr(self.elasticity_config, "enabled", False)
+                and "DS_ELASTIC_TRAIN_BATCH_SIZE" in _os.environ):
+            tb = int(_os.environ["DS_ELASTIC_TRAIN_BATCH_SIZE"])
+            mb = int(_os.environ.get("DS_ELASTIC_MICRO_BATCH_SIZE", mb or 1))
+            gas = None  # derived from tb/(mb·dp) below
         if tb is not None and mb is not None and gas is not None:
             if tb != mb * gas * dp_world_size:
                 raise DeepSpeedConfigError(

@@ -288,23 +288,6 @@ def _get_data_parallel_rank():
         "device subset?")
 
 
-def _get_data_parallel_io_world_size():
-    """Number of distinct dp data shards fed at host level: dp coordinates
-    spanned per process tell how many processes share one shard."""
-    if jax.process_count() == 1:
-        return 1
-    st = get_mesh_state()
-    devs = st.mesh.devices
-    names = st.mesh.axis_names
-    dp_i = names.index(DP_AXIS)
-    ep_i = names.index(EP_AXIS)
-    ep = devs.shape[ep_i]
-    by_proc = {}
-    for coords in np.ndindex(devs.shape):
-        by_proc.setdefault(devs[coords].process_index, set()).add(
-            int(coords[dp_i]) * ep + int(coords[ep_i]))
-    ranks = {min(v) for v in by_proc.values()}
-    return len(ranks)
 
 
 def zero_sharding_axes(sequence_parallel=False):

@@ -86,3 +86,61 @@ def test_prefer_larger_batch():
     b_small, _ = compute_elastic_config({"elasticity": small})
     b_large, _ = compute_elastic_config({"elasticity": large})
     assert b_small <= b_large
+
+
+def test_elastic_agent_rescale(tmp_path):
+    """Agent restarts a failing worker into a SHRUNK world with recomputed
+    DS_ELASTIC_* batch env (TPU-pod rescale story, round-1 review §5)."""
+    import os
+    import sys
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    marker = tmp_path / "attempts.txt"
+    # worker: fails while WORLD_SIZE==8, succeeds at 4; records env each run
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"p = open({str(marker)!r}, 'a')\n"
+        "p.write(os.environ['WORLD_SIZE'] + ' ' +\n"
+        "        os.environ.get('DS_ELASTIC_TRAIN_BATCH_SIZE', '-') + ' ' +\n"
+        "        os.environ.get('DS_ELASTIC_MICRO_BATCH_SIZE', '-') + '\\n')\n"
+        "p.close()\n"
+        "sys.exit(1 if os.environ['WORLD_SIZE'] == '8' else 0)\n")
+
+    ds_config = {"elasticity": {"enabled": True,
+                                "max_train_batch_size": 64,
+                                "micro_batch_sizes": [2, 4],
+                                "min_gpus": 1, "max_gpus": 16,
+                                "version": 0.1}}
+    agent = DSElasticAgent([sys.executable, str(script)], dict(os.environ),
+                           ds_config=ds_config, monitor_interval=0.05)
+
+    def rescale(world, restarts):
+        return (4, "127.0.0.1:12345") if world == 8 else (world, None)
+
+    rc = agent.run(8, rescale=rescale)
+    assert rc == 0
+    runs = marker.read_text().strip().splitlines()
+    assert runs[0].split()[0] == "8"
+    w, tb, mb = runs[-1].split()
+    assert w == "4" and tb != "-" and int(tb) % (int(mb) * 4) == 0
+
+
+def test_elastic_env_overrides_batch_config(monkeypatch):
+    """DS_ELASTIC_* env overrides the static batch trinity when elasticity
+    is enabled (rescaled-restart path)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 16, "version": 0.1,
+                       "ignore_non_elastic_batch_info": True},
+    })
+    monkeypatch.setenv("DS_ELASTIC_TRAIN_BATCH_SIZE", "32")
+    monkeypatch.setenv("DS_ELASTIC_MICRO_BATCH_SIZE", "4")
+    cfg.resolve_batch_sizes(dp_world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2

@@ -78,12 +78,18 @@ def test_fp8_matches_native_cast():
 
 def test_fp_quantize_class_api():
     q = FP_Quantize(group_size=128)
-    x = jnp.asarray(np.random.default_rng(3).standard_normal((32, 128)),
-                    jnp.float32)
-    packed, scales = q.quantize(x, q_bits=6, q_mantisa_bits=2,
-                                return_meta_tensor=True)
-    back = q.dequantize(packed, scale=scales, q_bits=6, q_mantisa_bits=2)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((32, 128)), jnp.float32)
+    packed, scales, meta = q.quantize(x, q_bits=6, q_mantisa_bits=2,
+                                      return_meta_tensor=True)
+    # stateless: a second quantize in another format must not corrupt the
+    # first payload's dequantize (review regression)
+    x2 = jnp.asarray(rng.standard_normal((16, 128)), jnp.float32)
+    q.quantize(x2, q_bits=8, q_mantisa_bits=3)
+    back = q.dequantize(packed, scale=scales, meta=meta)
     assert back.shape == x.shape
+    with pytest.raises(ValueError, match="does not match"):
+        q.dequantize(packed, scale=scales, q_bits=6, q_mantisa_bits=2)
 
 
 @pytest.mark.parametrize("fmt", ["fp8", "fp6"])

@@ -1,0 +1,53 @@
+"""Monitor backends (reference ``monitor/monitor.py:30``): csv events on
+disk, Comet via a mocked comet_ml, master fan-out."""
+
+import sys
+import types
+
+from deepspeed_tpu.monitor.monitor import CometMonitor, MonitorMaster, csv_monitor
+from deepspeed_tpu.runtime.config import MonitorConfig
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "job"})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("Train/loss", 1.5, 10), ("Train/loss", 1.2, 20)])
+    out = tmp_path / "job" / "Train_loss.csv"
+    assert out.exists()
+    lines = out.read_text().strip().splitlines()
+    assert lines[0].startswith("step") and lines[-1] == "20,1.2"
+
+
+def test_comet_monitor_with_mock(monkeypatch, tmp_path):
+    logged = []
+
+    class FakeExperiment:
+        def __init__(self, **kw):
+            self.kw = kw
+
+        def set_name(self, name):
+            self.name = name
+
+        def log_metric(self, name, value, step=None):
+            logged.append((name, value, step))
+
+    fake = types.ModuleType("comet_ml")
+    fake.Experiment = FakeExperiment
+    monkeypatch.setitem(sys.modules, "comet_ml", fake)
+
+    cfg = MonitorConfig(comet={"enabled": True, "project": "p",
+                               "experiment_name": "e"})
+    mon = CometMonitor(cfg.comet)
+    assert mon.enabled
+    mon.write_events([("Train/lr", 0.1, 5)])
+    assert logged == [("Train/lr", 0.1, 5)]
+
+
+def test_comet_disabled_without_package():
+    cfg = MonitorConfig(comet={"enabled": True})
+    assert "comet_ml" not in sys.modules
+    mon = CometMonitor(cfg.comet)
+    assert not mon.enabled  # degrades with a warning

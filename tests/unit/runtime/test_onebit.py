@@ -50,19 +50,30 @@ def test_compressed_allreduce_error_feedback_converges():
                    in_specs=(P("dp", None), P("dp", None), P("dp", None)),
                    out_specs=(P("dp", None), P("dp", None), P("dp", None)),
                    check_vma=False)
-    we = jnp.zeros((n, we_size), jnp.float32)
-    se = jnp.zeros((n, se_size), jnp.float32)
-    cum = np.zeros(200)
+    we0 = jnp.zeros((n, we_size), jnp.float32)
+    se0 = jnp.zeros((n, se_size), jnp.float32)
     T = 30
-    for t in range(T):
-        out, we, se = fn(contributions, we, se)
-        out0 = np.asarray(out[0])
-        # identical on every worker
-        np.testing.assert_allclose(np.asarray(out), np.tile(out0, (n, 1)),
-                                   rtol=1e-6)
-        cum += out0
+
+    # ONE compiled program for the whole loop (an eager shard_map per
+    # iteration made this the slowest test in the suite by far)
+    @jax.jit
+    def run(we, se):
+        def step(carry, _):
+            we, se, cum = carry
+            out, we, se = fn(contributions, we, se)
+            return (we, se, cum + out), out
+        (_, _, cum), outs = jax.lax.scan(step, (we, se,
+                                                jnp.zeros((n, 200))), None,
+                                         length=T)
+        return cum, outs
+
+    cum, outs = run(we0, se0)
+    outs = np.asarray(outs)           # [T, n, 200]
+    # identical on every worker at every step
+    np.testing.assert_allclose(outs, np.tile(outs[:, :1], (1, n, 1)),
+                               rtol=1e-6)
     # cumulative average within a few quant-steps of the true mean
-    avg_err = np.abs(cum / T - true_mean).mean()
+    avg_err = np.abs(np.asarray(cum)[0] / T - true_mean).mean()
     scale = np.abs(true_mean).mean()
     assert avg_err < 0.35 * scale + 0.05, (avg_err, scale)
 
