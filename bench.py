@@ -33,52 +33,98 @@ import time
 
 import numpy as np
 
-DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "900"))
+# Generous device budget: the remote-TPU tunnel's compile RPC latency varies
+# wildly (measured: the same program compiles in ~3 min or >16 min depending
+# on time of day); the JSON line is still always emitted at the end.
+DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "1400"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "420"))
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache: repeat bench runs skip the multi-minute
+    TPU compile, which is the bulk of the wall-clock on this 1-core host."""
+    import jax
+    cache = os.environ.get(
+        "BENCH_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
 
 
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
 
     backend = jax.default_backend()
+    # (B, remat, policy) candidates, fastest first: measured on v5e-16G,
+    # remat-off at B=4 gives ~0.39 MFU vs ~0.33 for B=8+full-remat (recompute
+    # is not credited); larger B OOMs without remat, so fall back on
+    # ResourceExhausted.
     if on_tpu:
-        cfg = llama.LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
-            max_position_embeddings=2048, dtype="bfloat16", remat=True)
-        B, S, steps, warmup = 8, 2048, 10, 2
+        attempts = [(4, False, "none"), (8, True, "nothing_saveable")]
+        S, steps, warmup = 2048, 10, 2
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke mode (sanity only)
-        cfg = llama.llama_tiny(dtype="float32", remat=False)
-        B, S, steps, warmup = 4, 64, 3, 1
+        attempts = [(4, False, "none")]
+        S, steps, warmup = 64, 3, 1
         peak_flops = 1e12
 
-    model = llama.LlamaModel(cfg)
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        config={
-            "train_micro_batch_size_per_gpu": B,
-            "gradient_accumulation_steps": 1,
-            "optimizer": {"type": "fusedadam", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": on_tpu},
-            "zero_optimization": {"stage": 0},
-        })
+    for B, remat, policy in attempts:
+        try:
+            if on_tpu:
+                cfg = llama.LlamaConfig(
+                    vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                    num_hidden_layers=8, num_attention_heads=16,
+                    num_key_value_heads=16, max_position_embeddings=2048,
+                    dtype="bfloat16", remat=remat, remat_policy=policy)
+            else:
+                cfg = llama.llama_tiny(dtype="float32", remat=False)
+            model = llama.LlamaModel(cfg)
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model,
+                config={
+                    "train_micro_batch_size_per_gpu": B,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "fusedadam", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": on_tpu},
+                    "zero_optimization": {"stage": 0},
+                })
 
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
-    engine.initialize_parameters(0, ids, ids)
+            rng = np.random.default_rng(0)
+            ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+            engine.initialize_parameters(0, ids, ids)
 
-    def one_step():
-        loss = engine(ids, ids)
-        engine.backward(loss)
-        engine.step()
-        return loss
+            def one_step():
+                loss = engine(ids, ids)
+                engine.backward(loss)
+                engine.step()
+                return loss
 
-    for _ in range(warmup):
-        one_step()
-    jax.block_until_ready(engine.params)
+            for _ in range(warmup):
+                one_step()
+            jax.block_until_ready(engine.params)
+            break
+        except Exception as e:  # OOM → next (smaller-footprint) config
+            if "RESOURCE_EXHAUSTED" not in str(e) or \
+                    (B, remat, policy) == attempts[-1]:
+                raise
+            # drop every reference to the failed attempt's device buffers
+            # BEFORE the retry allocates, or both copies coexist and the
+            # fallback OOMs too
+            engine = model = ids = None
+            import gc
+            gc.collect()
+            groups.reset_mesh()
+            dist.destroy_process_group()
+            continue
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -154,6 +200,8 @@ def run_serve_bench(on_tpu: bool) -> dict:
 def _child_device():
     """Benchmark on the default platform (TPU when the tunnel is up)."""
     import jax
+    # NOTE: no persistent compile cache here — serializing executables
+    # through the remote-TPU (axon) proxy stalls for minutes per program
     backend = jax.default_backend()  # may block; parent's timeout bounds us
     on_tpu = backend not in ("cpu",)
     print(json.dumps(run_bench(on_tpu)), flush=True)
@@ -164,6 +212,7 @@ def _child_cpu():
     sitecustomize's jax_platforms='axon,cpu' override beats the env var)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
     print(json.dumps(run_bench(False)), flush=True)
 
 
@@ -184,8 +233,12 @@ def main():
     me = os.path.abspath(__file__)
     procs = {}
     for mode, timeout in (("device", DEVICE_TIMEOUT_S), ("cpu", CPU_TIMEOUT_S)):
+        # the fallback child runs at minimum priority: on a 1-core host a
+        # full-priority sibling doubles the device child's XLA compile time
+        # past its timeout
+        nice = [] if mode == "device" else ["nice", "-n", "19"]
         procs[mode] = (subprocess.Popen(
-            [sys.executable, me, "--mode", mode],
+            nice + [sys.executable, me, "--mode", mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True),
             timeout)
 
@@ -234,6 +287,7 @@ def _child_serve(force_cpu: bool):
     import jax
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+        _enable_compile_cache()
     on_tpu = jax.default_backend() not in ("cpu", )
     print(json.dumps(run_serve_bench(on_tpu)), flush=True)
 

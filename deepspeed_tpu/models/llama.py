@@ -49,6 +49,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
     use_ulysses: bool = False
+    sp_backend: str = "ulysses"  # "ulysses" (a2a reshard) | "ring" (ppermute)
 
     @property
     def head_dim(self):
@@ -152,18 +153,24 @@ class LlamaAttention(nn.Module):
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
 
-            # GQA: repeat kv heads up to H
-            if Hkv != H:
-                rep = H // Hkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-
-            if cfg.use_ulysses:
-                from ..sequence.layer import DistributedAttention
-                out = DistributedAttention()(q, k, v, causal=True)
+            if cfg.use_ulysses and cfg.sp_backend == "ring":
+                # ring handles Hkv < H internally — K/V circulate the ICI
+                # ring at native KV width (repeating first would multiply
+                # every ppermute hop's bytes by H/Hkv)
+                from ..sequence.ring_attention import RingAttention
+                out = RingAttention()(q, k, v, causal=True)
             else:
-                from ..ops.attention import attention_core
-                out = attention_core(q, k, v, causal=True)
+                # GQA: repeat kv heads up to H
+                if Hkv != H:
+                    rep = H // Hkv
+                    k = jnp.repeat(k, rep, axis=2)
+                    v = jnp.repeat(v, rep, axis=2)
+                if cfg.use_ulysses:
+                    from ..sequence.layer import DistributedAttention
+                    out = DistributedAttention()(q, k, v, causal=True)
+                else:
+                    from ..ops.attention import attention_core
+                    out = attention_core(q, k, v, causal=True)
 
         out = out.reshape(B, S, H * Dh)
         return dense(features=D, axis=-1, name="o_proj")(out)

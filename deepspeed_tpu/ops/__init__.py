@@ -1,2 +1,39 @@
 from .op_builder import (ALL_OPS, NativeOpBuilder, OpBuilder, PallasOpBuilder,
                          get_op_builder_class, register_op_builder)
+
+# importing the op modules populates ALL_OPS (ds_report's compat matrix —
+# reference op_builder/all_ops.py eagerly enumerates the same way).
+# cpu_optimizers LAST: registration is last-wins, and the native C++
+# builders must own the cpu_* names (lion.py also registers a cpu_lion)
+from . import adam, aio, lamb, lion  # noqa: F401, E402
+from . import cpu_optimizers  # noqa: F401, E402
+
+
+@register_op_builder
+class FlashAttentionBuilder(PallasOpBuilder):
+    NAME = "flash_attn"
+    MODULE = "deepspeed_tpu.ops.pallas.flash_attention"
+
+
+@register_op_builder
+class PagedAttentionBuilder(PallasOpBuilder):
+    NAME = "ragged_ops"  # reference inference-v2 kernel suite name
+    MODULE = "deepspeed_tpu.ops.pallas.paged_attention"
+
+
+@register_op_builder
+class QuantizerBuilder(PallasOpBuilder):
+    NAME = "quantizer"
+    MODULE = "deepspeed_tpu.ops.pallas.quantizer"
+
+
+@register_op_builder
+class FPQuantizerBuilder(PallasOpBuilder):
+    NAME = "fp_quantizer"
+    MODULE = "deepspeed_tpu.ops.fp_quantizer"
+
+
+@register_op_builder
+class SparseAttnBuilder(PallasOpBuilder):
+    NAME = "sparse_attn"
+    MODULE = "deepspeed_tpu.ops.sparse_attention"

@@ -133,8 +133,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         # backward needs no special-casing — exp(s − (−1e30)) at the dead
         # rows' masked positions is exp(−inf) = 0.
         lse = jnp.where(m == _NEG_INF, _DEAD_ROW_LSE, m + jnp.log(l_safe))
-        # lse output is packed [B,H,S] (S in lanes) — no 128-lane inflation
-        lse_ref[0] = _col_to_row(lse)
+        # lse output is packed [B,H,1,S] (S in lanes, unit sublane dim so the
+        # Mosaic block rule "dim -2 divisible by 8 OR equal to the array dim"
+        # holds) — no 128-lane inflation
+        lse_ref[0, 0] = _col_to_row(lse)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
@@ -158,11 +160,11 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, sq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, 1, sq_p), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -195,8 +197,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = _row_to_col(lse_ref[0])      # packed [1,bq] lanes → [bq,1]
-        delta = _row_to_col(delta_ref[0])
+        lse = _row_to_col(lse_ref[0, 0])   # packed [1,bq] lanes → [bq,1]
+        delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
@@ -232,8 +234,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = _row_to_col(lse_ref[0])      # packed [1,bq] lanes → [bq,1]
-        delta = _row_to_col(delta_ref[0])
+        lse = _row_to_col(lse_ref[0, 0])   # packed [1,bq] lanes → [bq,1]
+        delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
@@ -260,10 +262,12 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
     _, Hkv, sk_p, _ = k.shape
     nq, nk = sq_p // block_q, sk_p // block_k
     kv_head = lambda h: (h * Hkv) // Hq
-    # Per-row scalars stay packed [B,H,S] (S in lanes) — the kernels unpack a
-    # (1, block_q) row to a (block_q, 1) column with an MXU identity
-    # contraction instead of hauling 128 duplicated lanes through HBM.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # Per-row scalars stay packed [B,H,1,S] (S in lanes, unit sublane) — the
+    # kernels unpack a (1, block_q) row to a (block_q, 1) column with an MXU
+    # identity contraction instead of hauling 128 duplicated lanes through
+    # HBM.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]
 
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
@@ -279,8 +283,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), j, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -303,8 +307,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), i, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, j)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),

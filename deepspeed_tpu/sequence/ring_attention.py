@@ -1,0 +1,100 @@
+"""Ring attention — context-parallel long-sequence backend.
+
+The reference's sequence parallelism is Ulysses (all-to-all head↔sequence
+reshard, ``sequence/layer.py``) + FPDT chunking; it has no ring/blockwise CP
+(SURVEY.md §2.3).  On TPU a ring is the natural *additional* backend: K/V
+blocks rotate around the "sp" mesh axis via ``ppermute`` (neighbor ICI hops,
+bandwidth-optimal, overlapping compute), and each rank folds every block into
+its local queries with the flash-attention online-softmax recurrence — the
+S×S score matrix never exists, activation memory is O(S/sp), and unlike
+Ulysses the head count does NOT need to divide sp (MQA/GQA-friendly).
+
+Math (blockwise softmax rescaling) follows the published RingAttention /
+blockwise-parallel-transformer formulation; gradients fall out of AD through
+``lax.scan`` + ``ppermute``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, softmax_scale=None):
+    """Inside-shard_map ring attention.
+
+    q/k/v: local sequence shards [B, S_local, H(_kv), D]; returns
+    [B, S_local, H, D].  K/V circulate sp-1 hops; block (i) on rank r at step
+    t originated at rank (r - t) mod sp, which fixes the causal-mask offsets.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    r = jax.lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else D ** -0.5
+
+    n_kv = k.shape[2]
+    if n_kv != H:  # GQA/MQA: local repeat (no cross-rank constraint)
+        rep = H // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = r * Sl + jnp.arange(Sl)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def fold(k_cur, v_cur, src, m, l, acc):
+        """Online-softmax accumulation of one K/V block."""
+        s = jnp.einsum("bshd,bthd->bhst", q32,
+                       k_cur.astype(jnp.float32)) * scale  # [B,H,Sl,Sl]
+        if causal:
+            k_pos = src * Sl + jnp.arange(Sl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B,H,Sl]
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_safe))
+        l = alpha * l + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhst,bthd->bhsd", p, v_cur.astype(jnp.float32))
+        return m_new, l, acc
+
+    # local block first (no hop), then rotate-and-fold the remaining sp-1
+    # blocks — exactly sp-1 neighbor hops (a trailing rotate whose result is
+    # discarded would move two full K/V blocks per layer for nothing)
+    m0 = jnp.full((B, H, Sl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    m0, l0, acc0 = fold(k, v, r, m0, l0, acc0)
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        # one ICI hop; XLA overlaps the permute with this step's matmuls
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        m, l, acc = fold(k_cur, v_cur, (r - t) % sp, m, l, acc)
+        return (k_cur, v_cur, m, l, acc), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(1, sp))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)      # [B, Sl, H, D]
+
+
+from .layer import DistributedAttention
+
+
+class RingAttention(DistributedAttention):
+    """API twin of :class:`deepspeed_tpu.sequence.DistributedAttention` with
+    the ring backend: the GSPMD ``__call__`` wrapper (mesh lookup, sp==1
+    fallback, jit/shard_map cache) is inherited; only the inside-shard_map
+    body differs."""
+
+    def attend_local(self, q, k, v, causal=True, softmax_scale=None):
+        sp = jax.lax.axis_size(self.sp_axis)
+        if sp == 1:
+            return self.local_attn(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+        return ring_attention_local(q, k, v, self.sp_axis, causal=causal,
+                                    softmax_scale=softmax_scale)
