@@ -13,11 +13,15 @@ from typing import Dict, Iterable, Tuple
 
 import numpy as np
 
+from ....models.falcon import FalconConfig, FalconModel
 from ....models.llama import LlamaConfig, LlamaModel
 from ....models.mixtral import MixtralConfig, MixtralModel
+from ....models.opt import OPTConfig, OPTModel
+from ....models.phi import PhiConfig, PhiModel
 from ....utils.logging import logger
 
-SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral")
+SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
+                         "falcon", "opt", "phi", "qwen2_moe")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -146,6 +150,341 @@ def _ingest_mixtral(model_cfg: MixtralConfig,
     return tree
 
 
+def _qwen2_moe_config_from_hf(cfg: dict, dtype: str) -> MixtralConfig:
+    if cfg.get("decoder_sparse_step", 1) != 1 or cfg.get("mlp_only_layers"):
+        raise ValueError("qwen2_moe with dense interleaved layers "
+                         "(decoder_sparse_step != 1 / mlp_only_layers) is "
+                         "not supported")
+    base = _llama_config_from_hf(cfg, dtype)
+    from dataclasses import asdict
+    d = asdict(base)
+    d["attention_bias"] = True  # qwen2-moe carries q/k/v biases
+    d["intermediate_size"] = cfg["moe_intermediate_size"]
+    return MixtralConfig(
+        **d,
+        num_local_experts=cfg.get("num_experts", 60),
+        num_experts_per_tok=cfg.get("num_experts_per_tok", 4),
+        router_aux_loss_coef=cfg.get("router_aux_loss_coef", 0.001),
+        shared_expert_intermediate_size=cfg.get(
+            "shared_expert_intermediate_size", 0),
+        norm_topk_prob=cfg.get("norm_topk_prob", False))
+
+
+def _ingest_qwen2_moe(cfg: MixtralConfig, params_iter) -> dict:
+    """qwen2-moe → the MixtralModel tree: per-expert gate/up/down stacks
+    plus the dense shared expert and its sigmoid mix gate."""
+    shared = []
+
+    def stream():
+        for name, arr in params_iter:
+            if ".mlp.shared_expert" in name:
+                shared.append((name, arr))
+            elif ".mlp.experts." in name:
+                name2 = (name.replace(".mlp.experts.",
+                                      ".block_sparse_moe.experts.")
+                         .replace(".gate_proj.weight", ".w1.weight")
+                         .replace(".up_proj.weight", ".w3.weight")
+                         .replace(".down_proj.weight", ".w2.weight"))
+                yield name2, arr
+            elif name.endswith(".mlp.gate.weight"):
+                yield name.replace(".mlp.gate.",
+                                   ".block_sparse_moe.gate."), arr
+            else:
+                yield name, arr
+
+    tree = _ingest_mixtral(cfg, stream())
+    for name, arr in shared:
+        parts = name.removeprefix("model.").split(".")
+        layer = f"layers_{parts[1]}"
+        t = np.ascontiguousarray(arr.T)
+        if "shared_expert_gate" in name:
+            _set(tree, (layer, "moe", "shared_expert_gate", "kernel"), t)
+        else:
+            proj = parts[4].split("_")[0]            # gate | up | down
+            _set(tree, (layer, "moe", f"shared_{proj}_proj", "kernel"), t)
+    return tree
+
+
+def _opt_config_from_hf(cfg: dict, dtype: str) -> OPTConfig:
+    proj_dim = cfg.get("word_embed_proj_dim", cfg["hidden_size"])
+    if proj_dim != cfg["hidden_size"]:
+        raise ValueError(
+            f"OPT word_embed_proj_dim={proj_dim} != hidden_size="
+            f"{cfg['hidden_size']} (project_in/out variants like opt-350m "
+            "are not supported)")
+    return OPTConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        ffn_dim=cfg.get("ffn_dim", 4 * cfg["hidden_size"]),
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        do_layer_norm_before=cfg.get("do_layer_norm_before", True),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+        dtype=dtype, remat=False)
+
+
+def _ingest_opt(cfg: OPTConfig,
+                params_iter: Iterable[Tuple[str, np.ndarray]]) -> dict:
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name == "lm_head.weight":
+            if not cfg.tie_word_embeddings:
+                _set(tree, ("lm_head", "kernel"), np.ascontiguousarray(arr.T))
+            continue
+        name = name.removeprefix("model.decoder.")
+        if name == "embed_tokens.weight":
+            _set(tree, ("embed_tokens", "embedding"), arr)
+        elif name == "embed_positions.weight":
+            _set(tree, ("embed_positions", "embedding"), arr)
+        elif name.startswith("final_layer_norm."):
+            _set(tree, ("final_layer_norm",
+                        "scale" if name.endswith("weight") else "bias"), arr)
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"layers_{idx}"
+            if rest.startswith("self_attn."):
+                sub = rest.removeprefix("self_attn.")
+                if sub.startswith(("q_proj", "k_proj", "v_proj")):
+                    proj, kind = sub.split(".")
+                    if kind == "weight":
+                        D = arr.shape[1]
+                        _set(tree, (layer, proj, "kernel"),
+                             np.ascontiguousarray(arr.T).reshape(D, H, Dh))
+                    else:
+                        _set(tree, (layer, proj, "bias"),
+                             arr.reshape(H, Dh))
+                elif sub.startswith("out_proj"):
+                    kind = sub.split(".")[1]
+                    val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                           else arr)
+                    _set(tree, (layer, "out_proj",
+                                "kernel" if kind == "weight" else "bias"),
+                         val)
+            elif rest.split(".")[0] in ("self_attn_layer_norm",
+                                        "final_layer_norm"):
+                scope, kind = rest.split(".")
+                _set(tree, (layer, scope,
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif rest.startswith(("fc1", "fc2")):
+                proj, kind = rest.split(".")
+                val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                       else arr)
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"), val)
+            else:
+                logger.warning(f"HF opt ingest: skipping {name}")
+        else:
+            logger.warning(f"HF opt ingest: skipping {name}")
+    return tree
+
+
+def _phi_config_from_hf(cfg: dict, dtype: str) -> PhiConfig:
+    return PhiConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        num_key_value_heads=cfg.get("num_key_value_heads",
+                                    cfg["num_attention_heads"]),
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        partial_rotary_factor=cfg.get("partial_rotary_factor", 0.4),
+        dtype=dtype, remat=False)
+
+
+def _ingest_phi(cfg: PhiConfig,
+                params_iter: Iterable[Tuple[str, np.ndarray]]) -> dict:
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name.startswith("lm_head."):
+            _set(tree, ("lm_head", "kernel" if name.endswith("weight")
+                        else "bias"),
+                 np.ascontiguousarray(arr.T) if name.endswith("weight")
+                 else arr)
+            continue
+        name = name.removeprefix("model.")
+        if name == "embed_tokens.weight":
+            _set(tree, ("embed_tokens", "embedding"), arr)
+        elif name.startswith("final_layernorm."):
+            _set(tree, ("final_layernorm",
+                        "scale" if name.endswith("weight") else "bias"), arr)
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"layers_{idx}"
+            if rest.startswith("self_attn."):
+                sub = rest.removeprefix("self_attn.")
+                proj, kind = sub.split(".")
+                heads = H if proj in ("q_proj", "dense") else Hkv
+                if proj == "dense":
+                    val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                           else arr)
+                    _set(tree, (layer, "dense",
+                                "kernel" if kind == "weight" else "bias"),
+                         val)
+                elif kind == "weight":
+                    D = arr.shape[1]
+                    _set(tree, (layer, proj, "kernel"),
+                         np.ascontiguousarray(arr.T).reshape(D, heads, Dh))
+                else:
+                    _set(tree, (layer, proj, "bias"),
+                         arr.reshape(heads, Dh))
+            elif rest.startswith("mlp."):
+                proj, kind = rest.split(".")[1:]
+                val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                       else arr)
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"), val)
+            elif rest.startswith("input_layernorm."):
+                _set(tree, (layer, "input_layernorm",
+                            "scale" if rest.endswith("weight") else "bias"),
+                     arr)
+            else:
+                logger.warning(f"HF phi ingest: skipping {name}")
+        else:
+            logger.warning(f"HF phi ingest: skipping {name}")
+    return tree
+
+
+def _split_phi3_fused(params_iter, cfg: LlamaConfig):
+    """Phi-3 is the Llama architecture with FUSED projections
+    (``qkv_proj`` = [q;k;v], ``gate_up_proj`` = [gate;up], reference
+    ``model_implementations/phi3``): split them back into the llama naming
+    and let the llama ingest handle the rest."""
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    I = cfg.intermediate_size
+    for name, arr in params_iter:
+        if name.endswith("self_attn.qkv_proj.weight"):
+            base = name.replace("qkv_proj", "{}")
+            q, k, v = np.split(arr, [H * Dh, H * Dh + Hkv * Dh], axis=0)
+            yield base.format("q_proj"), q
+            yield base.format("k_proj"), k
+            yield base.format("v_proj"), v
+        elif name.endswith("mlp.gate_up_proj.weight"):
+            base = name.replace("gate_up_proj", "{}")
+            gate, up = np.split(arr, [I], axis=0)
+            yield base.format("gate_proj"), gate
+            yield base.format("up_proj"), up
+        else:
+            yield name, arr
+
+
+def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
+    if cfg.get("alibi"):
+        raise ValueError("falcon alibi variants are not supported "
+                         "(rotary models only)")
+    H = cfg["num_attention_heads"]
+    if cfg.get("new_decoder_architecture"):
+        num_kv = cfg.get("num_kv_heads", H)
+    else:
+        num_kv = 1 if cfg.get("multi_query", True) else H
+    return FalconConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=H,
+        num_kv_heads=num_kv,
+        ffn_hidden_size=cfg.get("ffn_hidden_size"),
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        new_decoder_architecture=cfg.get("new_decoder_architecture", False),
+        parallel_attn=cfg.get("parallel_attn", True),
+        bias=cfg.get("bias", False),
+        # HF falcon ties by default and OMITS the key from config.json
+        tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+        dtype=dtype, remat=False)
+
+
+def _split_falcon_qkv(arr, cfg: FalconConfig):
+    """The fused ``query_key_value`` weight's three layouts (HF
+    ``modeling_falcon._split_heads`` semantics): grouped (new arch),
+    multi-query (kv tail), or per-head interleaved (old multi-head)."""
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_kv_heads, cfg.head_dim)
+    D = arr.shape[-1]
+    if cfg.new_decoder_architecture:
+        g = H // Hkv
+        w = arr.reshape(Hkv, g + 2, Dh, D)
+        q = w[:, :g].reshape(H * Dh, D)
+        k = w[:, g].reshape(Hkv * Dh, D)
+        v = w[:, g + 1].reshape(Hkv * Dh, D)
+    elif Hkv == 1:
+        q, k, v = np.split(arr, [H * Dh, (H + 1) * Dh], axis=0)
+    else:
+        w = arr.reshape(H, 3, Dh, D)
+        q = w[:, 0].reshape(H * Dh, D)
+        k = w[:, 1].reshape(H * Dh, D)
+        v = w[:, 2].reshape(H * Dh, D)
+    return q, k, v
+
+
+def _ingest_falcon(cfg: FalconConfig,
+                   params_iter: Iterable[Tuple[str, np.ndarray]]) -> dict:
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_kv_heads, cfg.head_dim)
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name == "lm_head.weight":
+            if not cfg.tie_word_embeddings:
+                _set(tree, ("lm_head", "kernel"), np.ascontiguousarray(arr.T))
+            continue
+        name = name.removeprefix("transformer.")
+        if name == "word_embeddings.weight":
+            _set(tree, ("word_embeddings", "embedding"), arr)
+        elif name.startswith("ln_f."):
+            _set(tree, ("ln_f", "scale" if name.endswith("weight")
+                        else "bias"), arr)
+        elif name.startswith("h."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"h_{idx}"
+            if rest == "self_attention.query_key_value.weight":
+                q, k, v = _split_falcon_qkv(arr, cfg)
+                D = arr.shape[-1]
+                _set(tree, (layer, "q_proj", "kernel"),
+                     np.ascontiguousarray(q.T).reshape(D, H, Dh))
+                _set(tree, (layer, "k_proj", "kernel"),
+                     np.ascontiguousarray(k.T).reshape(D, k.shape[0] // Dh,
+                                                       Dh))
+                _set(tree, (layer, "v_proj", "kernel"),
+                     np.ascontiguousarray(v.T).reshape(D, v.shape[0] // Dh,
+                                                       Dh))
+            elif rest == "self_attention.query_key_value.bias":
+                # bias=True variants (falcon-rw): split like the weight
+                q, k, v = _split_falcon_qkv(arr[:, None], cfg)
+                _set(tree, (layer, "q_proj", "bias"), q.reshape(H, Dh))
+                _set(tree, (layer, "k_proj", "bias"),
+                     k.reshape(k.shape[0] // Dh, Dh))
+                _set(tree, (layer, "v_proj", "bias"),
+                     v.reshape(v.shape[0] // Dh, Dh))
+            elif rest == "self_attention.dense.weight":
+                _set(tree, (layer, "dense", "kernel"),
+                     np.ascontiguousarray(arr.T))
+            elif rest == "self_attention.dense.bias":
+                _set(tree, (layer, "dense", "bias"), arr)
+            elif rest.startswith("mlp."):
+                proj, kind = rest.split(".")[1:]
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"),
+                     np.ascontiguousarray(arr.T) if kind == "weight"
+                     else arr)
+            elif rest.split(".")[0] in ("input_layernorm", "ln_attn",
+                                        "ln_mlp",
+                                        "post_attention_layernorm"):
+                scope, kind = rest.split(".")
+                _set(tree, (layer, scope,
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF falcon ingest: skipping {name}")
+        else:
+            logger.warning(f"HF falcon ingest: skipping {name}")
+    return tree
+
+
 def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
     """(model, params) from a checkpoint engine with a ``model_config`` dict
     (HF ``config.json``).  Reference analog: ``engine_factory.build_hf_engine``
@@ -160,11 +499,30 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _mixtral_config_from_hf(hf_cfg, dtype)
         params = _ingest_mixtral(cfg, checkpoint_engine.parameters())
         model = MixtralModel(cfg)
+    elif model_type == "qwen2_moe":
+        cfg = _qwen2_moe_config_from_hf(hf_cfg, dtype)
+        params = _ingest_qwen2_moe(cfg, checkpoint_engine.parameters())
+        model = MixtralModel(cfg)
+    elif model_type == "falcon":
+        cfg = _falcon_config_from_hf(hf_cfg, dtype)
+        params = _ingest_falcon(cfg, checkpoint_engine.parameters())
+        model = FalconModel(cfg)
+    elif model_type == "opt":
+        cfg = _opt_config_from_hf(hf_cfg, dtype)
+        params = _ingest_opt(cfg, checkpoint_engine.parameters())
+        model = OPTModel(cfg)
+    elif model_type == "phi":
+        cfg = _phi_config_from_hf(hf_cfg, dtype)
+        params = _ingest_phi(cfg, checkpoint_engine.parameters())
+        model = PhiModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
-        params = _ingest_llama(cfg, checkpoint_engine.parameters())
+        source = checkpoint_engine.parameters()
+        if model_type == "phi3":
+            source = _split_phi3_fused(source, cfg)
+        params = _ingest_llama(cfg, source)
         model = LlamaModel(cfg)
-    if cfg.sliding_window:
+    if getattr(cfg, "sliding_window", 0):
         logger.info(f"{model_type}: sliding_window={cfg.sliding_window} "
                     "(enforced in the ragged attention path)")
     return model, params

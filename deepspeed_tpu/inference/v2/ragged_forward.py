@@ -83,18 +83,54 @@ def _qkv(h, proj, dtype):
     return y
 
 
+def _lin(h, p, dtype):
+    """Plain linear with optional bias."""
+    y = h @ p["kernel"].astype(dtype)
+    return y + p["bias"].astype(dtype) if "bias" in p else y
+
+
+def _attn_cfg_view(cfg, sliding_window=0):
+    """The subset of model config the shared attention block reads —
+    one adapter for every non-llama architecture."""
+    import types
+    return types.SimpleNamespace(
+        num_attention_heads=cfg.num_attention_heads, head_dim=cfg.head_dim,
+        sliding_window=sliding_window, dtype=cfg.dtype)
+
+
+def _head_logits(params, x, last_token_idx, embed_key="embed_tokens"):
+    """logits_gather epilogue shared by the zoo steps: gather each slot's
+    last token, tied-embedding or lm_head projection (with optional bias)."""
+    xl = x[last_token_idx].astype(jnp.float32)
+    if "lm_head" in params:
+        logits = xl @ params["lm_head"]["kernel"].astype(jnp.float32)
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+        return logits
+    return xl @ params[embed_key]["embedding"].T.astype(jnp.float32)
+
+
 def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
-                            positions, cos, sin, *, cfg, block_size):
+                            positions, cos, sin, *, cfg, block_size,
+                            rotary=True, rotary_dim=None):
     """Shared attention sub-block: qkv → rotary → cache scatter → paged
     attention → output projection.  Returns (attn_out [T, D], new kv_layer).
-    kv_layer: [2, num_blocks, bs, Hkv, Dh]."""
+    kv_layer: [2, num_blocks, bs, Hkv, Dh].  ``rotary_dim`` < head_dim →
+    partial rotary (phi family)."""
     dtype = jnp.dtype(cfg.dtype)
     H, Dh = cfg.num_attention_heads, cfg.head_dim
     q = _qkv(h, lp_attn["q_proj"], dtype)
     k = _qkv(h, lp_attn["k_proj"], dtype)
     v = _qkv(h, lp_attn["v_proj"], dtype)
-    q = _rotary(q, cos, sin, positions)
-    k = _rotary(k, cos, sin, positions)
+    if rotary:
+        if rotary_dim and rotary_dim < Dh:
+            rot = lambda x: jnp.concatenate(
+                [_rotary(x[..., :rotary_dim], cos, sin, positions),
+                 x[..., rotary_dim:]], axis=-1)
+            q, k = rot(q), rot(k)
+        else:
+            q = _rotary(q, cos, sin, positions)
+            k = _rotary(k, cos, sin, positions)
     kv_layer = kv_layer.at[0, blk, off].set(k.astype(kv_layer.dtype))
     kv_layer = kv_layer.at[1, blk, off].set(v.astype(kv_layer.dtype))
     out = _paged_attention(q, kv_layer[0], kv_layer[1], tables_t,
@@ -201,12 +237,168 @@ def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         moe = lp["moe"]
         router_logits = (h2.astype(jnp.float32)
                          @ moe["gate"]["kernel"].astype(jnp.float32))
-        x = x + moe_apply(h2, router_logits,
-                          moe["w1"].astype(dtype), moe["w2"].astype(dtype),
-                          moe["w3"].astype(dtype), cfg.num_experts_per_tok)
+        moe_out = moe_apply(h2, router_logits,
+                            moe["w1"].astype(dtype), moe["w2"].astype(dtype),
+                            moe["w3"].astype(dtype), cfg.num_experts_per_tok,
+                            norm_topk=getattr(cfg, "norm_topk_prob", True))
+        if "shared_gate_proj" in moe:  # qwen2-moe dense shared expert
+            g = h2 @ moe["shared_gate_proj"]["kernel"].astype(dtype)
+            u = h2 @ moe["shared_up_proj"]["kernel"].astype(dtype)
+            sh = (jax.nn.silu(g) * u) @ moe["shared_down_proj"][
+                "kernel"].astype(dtype)
+            mix = jax.nn.sigmoid(
+                h2.astype(jnp.float32)
+                @ moe["shared_expert_gate"]["kernel"].astype(jnp.float32))
+            moe_out = moe_out + (mix * sh.astype(jnp.float32)).astype(
+                moe_out.dtype)
+        x = x + moe_out
 
     return _lm_head(params, x, last_token_idx, cfg), kv_data
 
 
+def _layernorm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"]
+            + p["bias"]).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
+                   donate_argnums=(1, ))
+def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
+                       block_tables, last_token_idx, *, cfg, block_size):
+    """One ragged engine iteration for Falcon (reference
+    ``inference/v2/model_implementations/falcon/``): parallel-block layout —
+    attention and the GELU MLP read the same layernormed input and add into
+    the residual together."""
+    dtype = jnp.dtype(cfg.dtype)
+    eps = cfg.layer_norm_epsilon
+    cos, sin = _rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                           cfg.rope_theta)
+    cos = jnp.asarray(cos, jnp.float32)
+    sin = jnp.asarray(sin, jnp.float32)
+
+    x = params["word_embeddings"]["embedding"][token_ids].astype(dtype)
+    tables_t = block_tables[seq_slots]
+    blk = tables_t[jnp.arange(token_ids.shape[0]), positions // block_size]
+    off = positions % block_size
+    acfg = _attn_cfg_view(cfg)
+
+    for l in range(cfg.num_hidden_layers):
+        lp = params[f"h_{l}"]
+        if cfg.new_decoder_architecture:
+            h_attn = _layernorm(x, lp["ln_attn"], eps)
+            h_mlp = _layernorm(x, lp["ln_mlp"], eps)
+        else:
+            h_attn = h_mlp = _layernorm(x, lp["input_layernorm"], eps)
+        attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
+                       "v_proj": lp["v_proj"], "o_proj": lp["dense"]}
+        attn_out, kv_layer = _ragged_attention_block(
+            attn_params, h_attn, kv_data[l], blk, off, tables_t, positions,
+            cos, sin, cfg=acfg, block_size=block_size)
+        kv_data = kv_data.at[l].set(kv_layer)
+        if "bias" in lp["dense"]:
+            attn_out = attn_out + lp["dense"]["bias"].astype(dtype)
+        if not cfg.parallel_attn:
+            x = x + attn_out
+            h_mlp = _layernorm(x, lp["post_attention_layernorm"], eps)
+        mlp = _lin(jax.nn.gelu(_lin(h_mlp, lp["dense_h_to_4h"], dtype)),
+                   lp["dense_4h_to_h"], dtype)
+        x = (x + attn_out + mlp) if cfg.parallel_attn else (x + mlp)
+
+    x = _layernorm(x, params["ln_f"], eps)
+    return _head_logits(params, x, last_token_idx,
+                        embed_key="word_embeddings"), kv_data
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
+                   donate_argnums=(1, ))
+def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
+                    block_tables, last_token_idx, *, cfg, block_size):
+    """One ragged engine iteration for OPT (reference
+    ``inference/v2/model_implementations/opt/``): learned positions (+2
+    offset), pre-LN blocks, ReLU MLP, no rotary."""
+    from ...models.opt import OPT_POSITION_OFFSET
+
+    dtype = jnp.dtype(cfg.dtype)
+    eps = cfg.layer_norm_eps
+
+    x = (params["embed_tokens"]["embedding"][token_ids]
+         + params["embed_positions"]["embedding"][
+             positions + OPT_POSITION_OFFSET]).astype(dtype)
+    tables_t = block_tables[seq_slots]
+    blk = tables_t[jnp.arange(token_ids.shape[0]), positions // block_size]
+    off = positions % block_size
+    acfg = _attn_cfg_view(cfg)
+
+    for l in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{l}"]
+        h = _layernorm(x, lp["self_attn_layer_norm"], eps) \
+            if cfg.do_layer_norm_before else x
+        attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
+                       "v_proj": lp["v_proj"], "o_proj": lp["out_proj"]}
+        attn_out, kv_layer = _ragged_attention_block(
+            attn_params, h, kv_data[l], blk, off, tables_t, positions,
+            None, None, cfg=acfg, block_size=block_size, rotary=False)
+        kv_data = kv_data.at[l].set(kv_layer)
+        if "bias" in lp["out_proj"]:
+            attn_out = attn_out + lp["out_proj"]["bias"].astype(dtype)
+        x = x + attn_out
+        if not cfg.do_layer_norm_before:
+            x = _layernorm(x, lp["self_attn_layer_norm"], eps)
+        h = _layernorm(x, lp["final_layer_norm"], eps) \
+            if cfg.do_layer_norm_before else x
+        x = x + _lin(jax.nn.relu(_lin(h, lp["fc1"], dtype)), lp["fc2"],
+                     dtype)
+        if not cfg.do_layer_norm_before:
+            x = _layernorm(x, lp["final_layer_norm"], eps)
+
+    if cfg.do_layer_norm_before:
+        x = _layernorm(x, params["final_layer_norm"], eps)
+    return _head_logits(params, x, last_token_idx), kv_data
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
+                   donate_argnums=(1, ))
+def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
+                    block_tables, last_token_idx, *, cfg, block_size):
+    """One ragged engine iteration for Phi-2 (reference
+    ``inference/v2/model_implementations/phi/``): parallel block, partial
+    rotary, LayerNorm, biased linears (incl. lm_head)."""
+    dtype = jnp.dtype(cfg.dtype)
+    eps = cfg.layer_norm_eps
+    rd = cfg.rotary_dim
+    cos, sin = _rope_freqs(rd, cfg.max_position_embeddings, cfg.rope_theta)
+    cos = jnp.asarray(cos, jnp.float32)
+    sin = jnp.asarray(sin, jnp.float32)
+
+    x = params["embed_tokens"]["embedding"][token_ids].astype(dtype)
+    tables_t = block_tables[seq_slots]
+    blk = tables_t[jnp.arange(token_ids.shape[0]), positions // block_size]
+    off = positions % block_size
+    acfg = _attn_cfg_view(cfg)
+
+    for l in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{l}"]
+        h = _layernorm(x, lp["input_layernorm"], eps)
+        attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
+                       "v_proj": lp["v_proj"], "o_proj": lp["dense"]}
+        attn_out, kv_layer = _ragged_attention_block(
+            attn_params, h, kv_data[l], blk, off, tables_t, positions,
+            cos, sin, cfg=acfg, block_size=block_size, rotary_dim=rd)
+        kv_data = kv_data.at[l].set(kv_layer)
+        if "bias" in lp["dense"]:
+            attn_out = attn_out + lp["dense"]["bias"].astype(dtype)
+        mlp = _lin(jax.nn.gelu(_lin(h, lp["fc1"], dtype)), lp["fc2"], dtype)
+        x = x + attn_out + mlp
+
+    x = _layernorm(x, params["final_layernorm"], eps)
+    return _head_logits(params, x, last_token_idx), kv_data
+
+
 RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step,
-                   "MixtralModel": mixtral_ragged_step}
+                   "MixtralModel": mixtral_ragged_step,
+                   "FalconModel": falcon_ragged_step,
+                   "OPTModel": opt_ragged_step,
+                   "PhiModel": phi_ragged_step}

@@ -34,7 +34,11 @@ class MixtralConfig(LlamaConfig):
     num_local_experts: int = 8
     num_experts_per_tok: int = 2
     router_aux_loss_coef: float = 0.02
-    sliding_window: int = 0  # 0 → disabled
+    # qwen2-moe extensions: a dense "shared expert" runs for every token,
+    # mixed in via a sigmoid gate; norm_topk_prob=False keeps raw top-k
+    # routing probs (mixtral renormalizes)
+    shared_expert_intermediate_size: int = 0  # 0 → no shared expert
+    norm_topk_prob: bool = True
 
 
 def mixtral_tiny(**overrides):
@@ -59,7 +63,7 @@ def moe_expert_ffn(x_sorted, group_sizes, w1, w2, w3):
     return jax.lax.ragged_dot(nn.silu(gate) * up, w2, group_sizes)
 
 
-def moe_apply(x, router_logits, w1, w2, w3, k):
+def moe_apply(x, router_logits, w1, w2, w3, k, norm_topk=True):
     """Exact (no-drop) top-k MoE: route, sort token-copies by expert, grouped
     matmul, weighted scatter-add back.  x: [T, D] → [T, D].
     """
@@ -67,7 +71,8 @@ def moe_apply(x, router_logits, w1, w2, w3, k):
     E = w1.shape[0]
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     topw, topi = jax.lax.top_k(probs, k)              # [T, k]
-    topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    if norm_topk:
+        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
 
     flat_expert = topi.reshape(-1)                    # [T*k]
     order = jnp.argsort(flat_expert)                  # stable
@@ -115,7 +120,24 @@ class MixtralSparseMoeBlock(nn.Module):
         w2 = self.param("w2", init, (E, I, D), jnp.float32)
         out = moe_apply(tokens, router_logits,
                         w1.astype(dtype), w2.astype(dtype), w3.astype(dtype),
-                        cfg.num_experts_per_tok)
+                        cfg.num_experts_per_tok,
+                        norm_topk=cfg.norm_topk_prob)
+        if cfg.shared_expert_intermediate_size:
+            # qwen2-moe shared expert: dense SwiGLU on every token, mixed in
+            # through a per-token sigmoid gate
+            Is = cfg.shared_expert_intermediate_size
+            dense = lambda f, name: nn.Dense(f, use_bias=False, dtype=dtype,
+                                             param_dtype=jnp.float32,
+                                             name=name)
+            gate_s = dense(Is, "shared_gate_proj")(tokens)
+            up_s = dense(Is, "shared_up_proj")(tokens)
+            shared = dense(D, "shared_down_proj")(nn.silu(gate_s) * up_s)
+            mix = nn.Dense(1, use_bias=False, dtype=jnp.float32,
+                           param_dtype=jnp.float32,
+                           name="shared_expert_gate")(
+                               tokens.astype(jnp.float32))
+            out = out + (jax.nn.sigmoid(mix) * shared.astype(
+                jnp.float32)).astype(out.dtype)
         self.sow("intermediates", "router_logits", router_logits)
         return out.reshape(B, S, D)
 

@@ -43,6 +43,20 @@ def _mixtral_factory(hf_cfg, dtype="bfloat16"):
     return MixtralModel(_mixtral_config_from_hf(hf_cfg, dtype))
 
 
+def _falcon_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _falcon_config_from_hf)
+    from ..models.falcon import FalconModel
+    return FalconModel(_falcon_config_from_hf(hf_cfg, dtype))
+
+
+def _opt_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _opt_config_from_hf)
+    from ..models.opt import OPTModel
+    return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
+
+
 # arch aliases the reference keeps one container file per entry for
 # (containers/llama.py, llama2, distil_llama, …): here one policy serves a
 # family because the flax model is config-parametrized.
@@ -51,7 +65,10 @@ POLICIES = {
     "llama2": InjectionPolicy("llama", _llama_factory),
     "mistral": InjectionPolicy("mistral", _llama_factory),
     "qwen2": InjectionPolicy("qwen2", _llama_factory),
+    "phi3": InjectionPolicy("phi3", _llama_factory),
     "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
+    "falcon": InjectionPolicy("falcon", _falcon_factory),
+    "opt": InjectionPolicy("opt", _opt_factory),
 }
 
 
