@@ -26,8 +26,36 @@ SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
 
+def _rope_scaling_fields(cfg: dict) -> dict:
+    """Map HF ``rope_scaling`` onto LlamaConfig's scalar fields.
+
+    Supported: linear, llama3 (Llama-3.1+).  Anything else (longrope/yarn/
+    dynamic — e.g. Phi-3 128k) raises rather than silently serving with
+    unscaled RoPE and garbage logits."""
+    rs = cfg.get("rope_scaling") or {}
+    stype = rs.get("rope_type", rs.get("type", "none")) or "none"
+    if stype in ("none", "default"):
+        return {}
+    if stype == "linear":
+        return {"rope_scaling_type": "linear",
+                "rope_scaling_factor": float(rs["factor"])}
+    if stype == "llama3":
+        return {
+            "rope_scaling_type": "llama3",
+            "rope_scaling_factor": float(rs["factor"]),
+            "rope_low_freq_factor": float(rs.get("low_freq_factor", 1.0)),
+            "rope_high_freq_factor": float(rs.get("high_freq_factor", 4.0)),
+            "rope_original_max_position":
+                int(rs.get("original_max_position_embeddings", 8192)),
+        }
+    raise ValueError(
+        f"unsupported rope_scaling type {stype!r} "
+        f"({cfg.get('model_type')}): only linear/llama3 are implemented")
+
+
 def _llama_config_from_hf(cfg: dict, dtype: str) -> LlamaConfig:
     return LlamaConfig(
+        **_rope_scaling_fields(cfg),
         vocab_size=cfg["vocab_size"],
         hidden_size=cfg["hidden_size"],
         intermediate_size=cfg["intermediate_size"],
@@ -62,11 +90,16 @@ def _set(tree: dict, path: Tuple[str, ...], value):
     node[path[-1]] = value
 
 
-def _attn_param(arr, key, H, Dh):
-    """q/k/v/o torch weights → DenseGeneral kernels/biases."""
-    if key == "o_proj.weight":          # [D, H*Dh] → [H*Dh, D]
-        return ("o_proj", "kernel"), np.ascontiguousarray(arr.T)
-    proj, kind = key.split(".")         # {q,k,v}_proj, weight|bias
+def _attn_param(arr, key, H, Dh, out_name="o_proj"):
+    """q/k/v/output torch weights → DenseGeneral kernels/biases.
+
+    ``out_name`` is the architecture's output-projection name (llama
+    ``o_proj``, phi ``dense``, opt ``out_proj``)."""
+    proj, kind = key.split(".", 1)      # proj, weight|bias
+    if proj == out_name:                # weight [D, H*Dh] → [H*Dh, D]
+        if kind == "weight":
+            return (proj, "kernel"), np.ascontiguousarray(arr.T)
+        return (proj, "bias"), arr
     if kind == "bias":                  # [H*Dh] → [H, Dh]
         return (proj, "bias"), arr.reshape(H, Dh)
     D = arr.shape[1]                    # weight [H*Dh, D] → [D, H, Dh]
@@ -246,22 +279,13 @@ def _ingest_opt(cfg: OPTConfig,
             layer = f"layers_{idx}"
             if rest.startswith("self_attn."):
                 sub = rest.removeprefix("self_attn.")
-                if sub.startswith(("q_proj", "k_proj", "v_proj")):
-                    proj, kind = sub.split(".")
-                    if kind == "weight":
-                        D = arr.shape[1]
-                        _set(tree, (layer, proj, "kernel"),
-                             np.ascontiguousarray(arr.T).reshape(D, H, Dh))
-                    else:
-                        _set(tree, (layer, proj, "bias"),
-                             arr.reshape(H, Dh))
-                elif sub.startswith("out_proj"):
-                    kind = sub.split(".")[1]
-                    val = (np.ascontiguousarray(arr.T) if kind == "weight"
-                           else arr)
-                    _set(tree, (layer, "out_proj",
-                                "kernel" if kind == "weight" else "bias"),
-                         val)
+                proj = sub.split(".", 1)[0]
+                if proj not in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                    logger.warning(f"HF opt ingest: skipping {name}")
+                    continue
+                path, value = _attn_param(arr, sub, H, Dh,
+                                          out_name="out_proj")
+                _set(tree, (layer,) + path, value)
             elif rest.split(".")[0] in ("self_attn_layer_norm",
                                         "final_layer_norm"):
                 scope, kind = rest.split(".")
@@ -281,6 +305,9 @@ def _ingest_opt(cfg: OPTConfig,
 
 
 def _phi_config_from_hf(cfg: dict, dtype: str) -> PhiConfig:
+    if _rope_scaling_fields(cfg):
+        raise ValueError("rope_scaling is not supported for phi "
+                         "(PhiConfig has no scaling fields)")
     return PhiConfig(
         vocab_size=cfg["vocab_size"],
         hidden_size=cfg["hidden_size"],
@@ -293,6 +320,7 @@ def _phi_config_from_hf(cfg: dict, dtype: str) -> PhiConfig:
         layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
         rope_theta=cfg.get("rope_theta", 10000.0),
         partial_rotary_factor=cfg.get("partial_rotary_factor", 0.4),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
         dtype=dtype, remat=False)
 
 
@@ -302,11 +330,17 @@ def _ingest_phi(cfg: PhiConfig,
                   cfg.head_dim)
     tree: Dict = {}
     for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES):  # e.g. persisted rotary inv_freq
+            continue
         if name.startswith("lm_head."):
-            _set(tree, ("lm_head", "kernel" if name.endswith("weight")
-                        else "bias"),
-                 np.ascontiguousarray(arr.T) if name.endswith("weight")
-                 else arr)
+            if not cfg.tie_word_embeddings:
+                _set(tree, ("lm_head", "kernel" if name.endswith("weight")
+                            else "bias"),
+                     np.ascontiguousarray(arr.T) if name.endswith("weight")
+                     else arr)
+            elif name.endswith("bias"):
+                # tying shares only the weight; the bias stays live
+                _set(tree, ("lm_head_bias",), arr)
             continue
         name = name.removeprefix("model.")
         if name == "embed_tokens.weight":
@@ -319,21 +353,14 @@ def _ingest_phi(cfg: PhiConfig,
             layer = f"layers_{idx}"
             if rest.startswith("self_attn."):
                 sub = rest.removeprefix("self_attn.")
-                proj, kind = sub.split(".")
+                proj = sub.split(".", 1)[0]
+                if proj not in ("q_proj", "k_proj", "v_proj", "dense"):
+                    logger.warning(f"HF phi ingest: skipping {name}")
+                    continue
                 heads = H if proj in ("q_proj", "dense") else Hkv
-                if proj == "dense":
-                    val = (np.ascontiguousarray(arr.T) if kind == "weight"
-                           else arr)
-                    _set(tree, (layer, "dense",
-                                "kernel" if kind == "weight" else "bias"),
-                         val)
-                elif kind == "weight":
-                    D = arr.shape[1]
-                    _set(tree, (layer, proj, "kernel"),
-                         np.ascontiguousarray(arr.T).reshape(D, heads, Dh))
-                else:
-                    _set(tree, (layer, proj, "bias"),
-                         arr.reshape(heads, Dh))
+                path, value = _attn_param(arr, sub, heads, Dh,
+                                          out_name="dense")
+                _set(tree, (layer,) + path, value)
             elif rest.startswith("mlp."):
                 proj, kind = rest.split(".")[1:]
                 val = (np.ascontiguousarray(arr.T) if kind == "weight"
@@ -376,6 +403,16 @@ def _split_phi3_fused(params_iter, cfg: LlamaConfig):
 
 
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
+    if _rope_scaling_fields(cfg):
+        raise ValueError("rope_scaling is not supported for falcon "
+                         "(FalconConfig has no scaling fields)")
+    if (cfg.get("new_decoder_architecture")
+            and cfg.get("num_ln_in_parallel_attn") == 1):
+        # falcon-11B layout: one shared pre-layernorm instead of
+        # ln_attn/ln_mlp — the model/ragged step read the two-LN layout
+        raise ValueError("falcon with new_decoder_architecture and "
+                         "num_ln_in_parallel_attn=1 (e.g. falcon-11B) is "
+                         "not supported")
     if cfg.get("alibi"):
         raise ValueError("falcon alibi variants are not supported "
                          "(rotary models only)")

@@ -107,7 +107,10 @@ def _head_logits(params, x, last_token_idx, embed_key="embed_tokens"):
         if "bias" in params["lm_head"]:
             logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
         return logits
-    return xl @ params[embed_key]["embedding"].T.astype(jnp.float32)
+    logits = xl @ params[embed_key]["embedding"].T.astype(jnp.float32)
+    if "lm_head_bias" in params:  # tied phi: weight shared, bias live
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits
 
 
 def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
@@ -137,8 +140,10 @@ def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
                            positions, block_size,
                            window=getattr(cfg, "sliding_window", 0))
     o = out.reshape(out.shape[0], H * Dh)
-    return jnp.einsum("tf,fd->td", o,
-                      lp_attn["o_proj"]["kernel"].astype(dtype)), kv_layer
+    o = jnp.einsum("tf,fd->td", o, lp_attn["o_proj"]["kernel"].astype(dtype))
+    if "bias" in lp_attn["o_proj"]:
+        o = o + lp_attn["o_proj"]["bias"].astype(dtype)
+    return o, kv_layer
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size"),
@@ -162,7 +167,8 @@ def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
     H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                   cfg.head_dim)
     eps = cfg.rms_norm_eps
-    cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
+    cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta,
+                           cfg.rope_scaling)
     cos = jnp.asarray(cos, jnp.float32)
     sin = jnp.asarray(sin, jnp.float32)
 
@@ -215,7 +221,7 @@ def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
     dtype = jnp.dtype(cfg.dtype)
     eps = cfg.rms_norm_eps
     cos, sin = _rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
-                           cfg.rope_theta)
+                           cfg.rope_theta, cfg.rope_scaling)
     cos = jnp.asarray(cos, jnp.float32)
     sin = jnp.asarray(sin, jnp.float32)
 
@@ -298,8 +304,6 @@ def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
             attn_params, h_attn, kv_data[l], blk, off, tables_t, positions,
             cos, sin, cfg=acfg, block_size=block_size)
         kv_data = kv_data.at[l].set(kv_layer)
-        if "bias" in lp["dense"]:
-            attn_out = attn_out + lp["dense"]["bias"].astype(dtype)
         if not cfg.parallel_attn:
             x = x + attn_out
             h_mlp = _layernorm(x, lp["post_attention_layernorm"], eps)
@@ -342,8 +346,6 @@ def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
             attn_params, h, kv_data[l], blk, off, tables_t, positions,
             None, None, cfg=acfg, block_size=block_size, rotary=False)
         kv_data = kv_data.at[l].set(kv_layer)
-        if "bias" in lp["out_proj"]:
-            attn_out = attn_out + lp["out_proj"]["bias"].astype(dtype)
         x = x + attn_out
         if not cfg.do_layer_norm_before:
             x = _layernorm(x, lp["self_attn_layer_norm"], eps)
@@ -388,8 +390,6 @@ def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
             attn_params, h, kv_data[l], blk, off, tables_t, positions,
             cos, sin, cfg=acfg, block_size=block_size, rotary_dim=rd)
         kv_data = kv_data.at[l].set(kv_layer)
-        if "bias" in lp["dense"]:
-            attn_out = attn_out + lp["dense"]["bias"].astype(dtype)
         mlp = _lin(jax.nn.gelu(_lin(h, lp["fc1"], dtype)), lp["fc2"], dtype)
         x = x + attn_out + mlp
 

@@ -115,9 +115,9 @@ class FalconBlock(nn.Module):
                            name="dense_h_to_4h")(mlp_in))
         mlp = dense(features=D, name="dense_4h_to_h")(h4)
 
-        if cfg.parallel_attn:
-            return x + attn + mlp
-        return (x + attn) + mlp
+        # sequential vs parallel differ only in mlp_in above; the residual
+        # sum is the same either way
+        return x + attn + mlp
 
 
 class FalconModel(nn.Module):

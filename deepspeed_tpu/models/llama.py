@@ -45,6 +45,14 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     sliding_window: int = 0       # 0 → full causal (Mistral sets 4096)
     attention_bias: bool = False  # Qwen2-style q/k/v biases
+    # RoPE scaling (HF rope_scaling): "none" | "linear" | "llama3".
+    # Scalar fields (not a dict) so the frozen config stays hashable as a
+    # flax static attribute.
+    rope_scaling_type: str = "none"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     dtype: str = "bfloat16"
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
@@ -54,6 +62,15 @@ class LlamaConfig:
     @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_scaling(self):
+        """Scaling tuple for :func:`_rope_freqs`, or None when unscaled."""
+        if self.rope_scaling_type == "none":
+            return None
+        return (self.rope_scaling_type, self.rope_scaling_factor,
+                self.rope_low_freq_factor, self.rope_high_freq_factor,
+                self.rope_original_max_position)
 
 
 def llama_7b(**overrides):
@@ -79,8 +96,28 @@ def llama_tiny(**overrides):
                           **overrides})
 
 
-def _rope_freqs(head_dim, max_len, theta):
+def _rope_freqs(head_dim, max_len, theta, scaling=None):
+    """cos/sin tables; ``scaling`` is ``LlamaConfig.rope_scaling`` —
+    ``(type, factor, low_freq_factor, high_freq_factor, original_max)``.
+
+    "linear" divides all frequencies by ``factor``; "llama3" is the HF
+    piecewise rule (frequencies below the low-freq wavelength are scaled by
+    ``factor``, above high-freq kept, smooth interpolation between)."""
     inv = 1.0 / (theta**(np.arange(0, head_dim, 2) / head_dim))
+    if scaling is not None:
+        stype, factor, low_f, high_f, orig_max = scaling
+        if stype == "linear":
+            inv = inv / factor
+        elif stype == "llama3":
+            wavelen = 2 * np.pi / inv
+            low_wavelen = orig_max / low_f
+            high_wavelen = orig_max / high_f
+            smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+            smoothed = ((1 - smooth) / factor + smooth) * inv
+            inv = np.where(wavelen > low_wavelen, inv / factor,
+                           np.where(wavelen < high_wavelen, inv, smoothed))
+        else:
+            raise ValueError(f"unsupported rope scaling type {stype!r}")
     t = np.arange(max_len)
     freqs = np.outer(t, inv)  # [S, Dh/2]
     return np.cos(freqs), np.sin(freqs)
@@ -131,7 +168,8 @@ class LlamaAttention(nn.Module):
         k = qkv(features=(Hkv, Dh), name="k_proj")(x)
         v = qkv(features=(Hkv, Dh), name="v_proj")(x)
 
-        cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = _rope_freqs(Dh, cfg.max_position_embeddings, cfg.rope_theta,
+                               cfg.rope_scaling)
         cos, sin = jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32)
 
         if decode:

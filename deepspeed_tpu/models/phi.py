@@ -125,9 +125,15 @@ class PhiModel(nn.Module):
             x = block(cfg, name=f"layers_{i}")(x, decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
                          param_dtype=jnp.float32, name="final_layernorm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=True, dtype=jnp.float32,
-                          param_dtype=jnp.float32,
-                          name="lm_head")(x.astype(jnp.float32))
+        if cfg.tie_word_embeddings:
+            # HF ties only the weight; the lm_head bias stays a live param
+            bias = self.param("lm_head_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+            logits = embed.attend(x.astype(jnp.float32)) + bias
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=True,
+                              dtype=jnp.float32, param_dtype=jnp.float32,
+                              name="lm_head")(x.astype(jnp.float32))
         if labels is None:
             return logits
         from ..sequence.cross_entropy import softmax_cross_entropy_with_logits

@@ -57,6 +57,20 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _phi_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _phi_config_from_hf)
+    from ..models.phi import PhiModel
+    return PhiModel(_phi_config_from_hf(hf_cfg, dtype))
+
+
+def _qwen2_moe_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _qwen2_moe_config_from_hf)
+    from ..models.mixtral import MixtralModel
+    return MixtralModel(_qwen2_moe_config_from_hf(hf_cfg, dtype))
+
+
 # arch aliases the reference keeps one container file per entry for
 # (containers/llama.py, llama2, distil_llama, …): here one policy serves a
 # family because the flax model is config-parametrized.
@@ -67,8 +81,10 @@ POLICIES = {
     "qwen2": InjectionPolicy("qwen2", _llama_factory),
     "phi3": InjectionPolicy("phi3", _llama_factory),
     "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
+    "qwen2_moe": InjectionPolicy("qwen2_moe", _qwen2_moe_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
+    "phi": InjectionPolicy("phi", _phi_factory),
 }
 
 

@@ -37,3 +37,9 @@ class FPQuantizerBuilder(PallasOpBuilder):
 class SparseAttnBuilder(PallasOpBuilder):
     NAME = "sparse_attn"
     MODULE = "deepspeed_tpu.ops.sparse_attention"
+
+
+@register_op_builder
+class EvoformerAttnBuilder(PallasOpBuilder):
+    NAME = "evoformer_attn"
+    MODULE = "deepspeed_tpu.ops.deepspeed4science.evoformer_attn"

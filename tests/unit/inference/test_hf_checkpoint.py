@@ -182,3 +182,75 @@ def test_decode_logits_match_full_forward(tmp_path, model_type):
         full = hf_model(torch.tensor([prompt + [tok1]])).logits[0, -1]
     np.testing.assert_allclose(decode_logits, full.float().numpy(),
                                atol=3e-3, rtol=3e-3)
+
+
+def test_hf_rope_scaling_llama3_parity(tmp_path):
+    """Llama-3.1-style rope_scaling (llama3 piecewise) must match HF."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64})
+    torch.manual_seed(7)
+    hf_model = transformers.LlamaForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "llama3-scaled")
+    hf_model.save_pretrained(path, safe_serialization=True)
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    assert model.config.rope_scaling_type == "llama3"
+    # long enough that scaled vs unscaled frequencies actually diverge
+    ids = np.random.default_rng(0).integers(0, 96, size=(1, 100),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_rejects_longrope(tmp_path):
+    """Phi-3 128k (longrope) must be rejected loudly, not served wrong."""
+    cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, original_max_position_embeddings=64,
+        pad_token_id=0,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 4, "long_factor": [2.0] * 4})
+    torch.manual_seed(7)
+    model = transformers.Phi3ForCausalLM(cfg)
+    path = str(tmp_path / "phi3-longrope")
+    model.save_pretrained(path, safe_serialization=True)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        build_model_and_params(HuggingFaceCheckpointEngine(path),
+                               dtype="float32")
+
+
+def test_hf_phi_tied_embeddings(tmp_path):
+    """Tied phi shares the lm_head weight but keeps its live bias."""
+    cfg = transformers.PhiConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, pad_token_id=0,
+        tie_word_embeddings=True)
+    torch.manual_seed(3)
+    hf_model = transformers.PhiForCausalLM(cfg)
+    with torch.no_grad():  # a zero bias would hide the dropped-bias bug
+        hf_model.lm_head.bias.normal_()
+    hf_model.eval()
+    path = str(tmp_path / "phi-tied")
+    hf_model.save_pretrained(path, safe_serialization=True)
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    assert "lm_head" not in params and "lm_head_bias" in params
+    ids = np.random.default_rng(0).integers(0, 96, size=(1, 13),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+    # and through the ragged serving path (_head_logits tied branch)
+    eng = build_hf_engine(path, engine_config=dict(ENGINE_CFG))
+    eng.put([0], [ids[0].tolist()])
+    out = eng.schedule_step()
+    assert out[0] == int(np.argmax(theirs[0, -1]))

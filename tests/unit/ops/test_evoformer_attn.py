@@ -1,0 +1,91 @@
+"""Evoformer attention numerics vs a naive reference (mirrors reference
+``tests/unit/ops/deepspeed4science/test_DS4Sci_EvoformerAttention.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.deepspeed4science import (DS4Sci_EvoformerAttention,
+                                                 evoformer_attention)
+
+
+def naive(q, k, v, biases, scale):
+    # reference attention_reference: transpose to [*, H, L, D], bias add,
+    # softmax over keys
+    qh = jnp.moveaxis(q, -2, -3).astype(jnp.float32)
+    kh = jnp.moveaxis(k, -2, -3).astype(jnp.float32)
+    vh = jnp.moveaxis(v, -2, -3).astype(jnp.float32)
+    a = jnp.einsum("...qd,...kd->...qk", qh, kh) * scale
+    for b in biases:
+        a = a + b.astype(jnp.float32)
+    p = jax.nn.softmax(a, axis=-1)
+    return jnp.moveaxis(p @ vh, -3, -2)
+
+
+def _make(shape, dtype, with_biases=True, seed=0):
+    B, N, L, H, D = shape
+    rng = np.random.default_rng(seed)
+    r = lambda *s: jnp.asarray(rng.standard_normal(s), dtype=dtype)
+    q, k, v = r(B, N, L, H, D), r(B, N, L, H, D), r(B, N, L, H, D)
+    biases = []
+    if with_biases:
+        biases = [r(B, N, 1, 1, L), r(B, 1, H, L, L)]
+    return q, k, v, biases
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("shape", [(1, 3, 24, 4, 8), (2, 2, 40, 2, 16)])
+def test_matches_naive(dtype, shape):
+    q, k, v, biases = _make(shape, dtype)
+    scale = 1.0 / np.sqrt(shape[-1])
+    out = DS4Sci_EvoformerAttention(q, k, v, biases)
+    ref = naive(q, k, v, biases, scale)
+    tol = 2e-2 if dtype == "bfloat16" else 2e-5
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=tol, rtol=tol)
+
+
+def test_chunked_matches_direct():
+    q, k, v, biases = _make((1, 2, 50, 2, 8), "float32")
+    direct = evoformer_attention(q, k, v, biases, block_q=64)
+    chunked = evoformer_attention(q, k, v, biases, block_q=16)  # 50 → 4 blocks
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(direct),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_no_bias_and_one_bias():
+    q, k, v, biases = _make((1, 2, 20, 2, 8), "float32")
+    scale = 1.0 / np.sqrt(8)
+    for bs in ([], [biases[0]], [None, biases[1]]):
+        out = DS4Sci_EvoformerAttention(q, k, v, list(bs))
+        ref = naive(q, k, v, [b for b in bs if b is not None], scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("block_q", [64, 8])
+def test_gradients_match_naive(block_q):
+    q, k, v, biases = _make((1, 2, 24, 2, 8), "float32")
+    scale = 1.0 / np.sqrt(8)
+
+    def loss_mine(q, k, v, b1, b2):
+        return jnp.sum(evoformer_attention(q, k, v, [b1, b2],
+                                           block_q=block_q) ** 2)
+
+    def loss_ref(q, k, v, b1, b2):
+        return jnp.sum(naive(q, k, v, [b1, b2], scale) ** 2)
+
+    g_mine = jax.grad(loss_mine, argnums=(0, 1, 2, 3, 4))(q, k, v, *biases)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q, k, v, *biases)
+    for gm, gr, name in zip(g_mine, g_ref, "qkv12"):
+        np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+def test_jit_compiles():
+    q, k, v, biases = _make((1, 2, 20, 2, 8), "bfloat16")
+    f = jax.jit(lambda q, k, v, b1, b2:
+                evoformer_attention(q, k, v, [b1, b2]))
+    out = f(q, k, v, *biases)
+    assert out.shape == q.shape and out.dtype == q.dtype
