@@ -304,10 +304,18 @@ def _ingest_opt(cfg: OPTConfig,
     return tree
 
 
+def _reject_rope_scaling(cfg: dict, arch: str):
+    """phi/falcon configs have no scaling fields — reject ANY rope_scaling
+    with an arch-accurate message (not the linear/llama3 hint)."""
+    rs = cfg.get("rope_scaling") or {}
+    stype = rs.get("rope_type", rs.get("type", "none")) or "none"
+    if stype not in ("none", "default"):
+        raise ValueError(f"rope_scaling ({stype!r}) is not supported for "
+                         f"{arch}")
+
+
 def _phi_config_from_hf(cfg: dict, dtype: str) -> PhiConfig:
-    if _rope_scaling_fields(cfg):
-        raise ValueError("rope_scaling is not supported for phi "
-                         "(PhiConfig has no scaling fields)")
+    _reject_rope_scaling(cfg, "phi")
     return PhiConfig(
         vocab_size=cfg["vocab_size"],
         hidden_size=cfg["hidden_size"],
@@ -403,9 +411,7 @@ def _split_phi3_fused(params_iter, cfg: LlamaConfig):
 
 
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
-    if _rope_scaling_fields(cfg):
-        raise ValueError("rope_scaling is not supported for falcon "
-                         "(FalconConfig has no scaling fields)")
+    _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
             and cfg.get("num_ln_in_parallel_attn") == 1):
         # falcon-11B layout: one shared pre-layernorm instead of

@@ -26,7 +26,6 @@ Both gradients flow (the reference computes ``dB1``/``dB2`` when requested;
 here autodiff does, summing over broadcast axes automatically).
 """
 
-import functools
 import math
 
 import jax
@@ -125,8 +124,3 @@ def DS4Sci_EvoformerAttention(Q, K, V, biases):
         assert b.shape[-1] == L and b.ndim == Q.ndim, (
             f"bias shape {b.shape} incompatible with Q {Q.shape}")
     return evoformer_attention(Q, K, V, biases=bs)
-
-
-@functools.partial(jax.jit, static_argnames=("softmax_scale",))
-def _jitted(q, k, v, biases, softmax_scale):
-    return evoformer_attention(q, k, v, biases, softmax_scale)

@@ -1,0 +1,17 @@
+"""Structural checks for the decoder fusion analysis tool."""
+
+from deepspeed_tpu.profiling.kernel_bench import fusion_report, stage_timing
+
+
+def test_fusion_report_structure():
+    rep = fusion_report(256, 4, 64)
+    assert rep["fusions"] > 0
+    # rotary and silu must be fused into neighbors even on CPU — no
+    # standalone sin/cos-multiply or logistic kernels
+    assert rep["standalone"]["rotary(sin/cos mul)"] == 0
+    assert rep["standalone"]["silu(logistic)"] == 0
+
+
+def test_stage_timing_runs():
+    tim = stage_timing(256, 4, 64, iters=2)
+    assert tim["fused_ms"] > 0 and tim["staged_ms"] > 0
