@@ -26,6 +26,13 @@ SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
 
+def _rope_scaling_type(cfg: dict) -> str:
+    """The HF rope_scaling type, handling both key spellings ('rope_type'
+    new, 'type' old); 'none' when absent."""
+    rs = cfg.get("rope_scaling") or {}
+    return rs.get("rope_type", rs.get("type", "none")) or "none"
+
+
 def _rope_scaling_fields(cfg: dict) -> dict:
     """Map HF ``rope_scaling`` onto LlamaConfig's scalar fields.
 
@@ -33,7 +40,7 @@ def _rope_scaling_fields(cfg: dict) -> dict:
     dynamic — e.g. Phi-3 128k) raises rather than silently serving with
     unscaled RoPE and garbage logits."""
     rs = cfg.get("rope_scaling") or {}
-    stype = rs.get("rope_type", rs.get("type", "none")) or "none"
+    stype = _rope_scaling_type(cfg)
     if stype in ("none", "default"):
         return {}
     if stype == "linear":
@@ -307,8 +314,7 @@ def _ingest_opt(cfg: OPTConfig,
 def _reject_rope_scaling(cfg: dict, arch: str):
     """phi/falcon configs have no scaling fields — reject ANY rope_scaling
     with an arch-accurate message (not the linear/llama3 hint)."""
-    rs = cfg.get("rope_scaling") or {}
-    stype = rs.get("rope_type", rs.get("type", "none")) or "none"
+    stype = _rope_scaling_type(cfg)
     if stype not in ("none", "default"):
         raise ValueError(f"rope_scaling ({stype!r}) is not supported for "
                          f"{arch}")

@@ -57,7 +57,8 @@ def kv_cache_update(module, k, v, rotate_fn=None):
     return cached_key.value, cached_value.value, idx
 
 
-def decode_attention(q, k_full, v_full, start_index, softmax_scale=None):
+def decode_attention(q, k_full, v_full, start_index, softmax_scale=None,
+                     window=0):
     """Attention of S query tokens (global positions ``start_index + s``)
     over a full-length KV buffer, masked so query s sees keys
     ``j <= start_index + s``.  Degenerates to plain causal attention for the
@@ -79,6 +80,8 @@ def decode_attention(q, k_full, v_full, start_index, softmax_scale=None):
     key_pos = jnp.arange(L)[None, :]
     query_pos = start_index + jnp.arange(S)[:, None]
     mask = key_pos <= query_pos                      # [S, L]
+    if window:  # sliding window: only the last `window` keys are visible
+        mask &= key_pos > query_pos - window
     scores = jnp.where(mask[None, None, None], scores,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -96,6 +96,30 @@ def llama_tiny(**overrides):
                           **overrides})
 
 
+def mistral_7b(**overrides):
+    """Mistral-7B-v0.1: llama architecture + GQA + 4096 sliding window."""
+    return LlamaConfig(**{**dict(vocab_size=32000, hidden_size=4096,
+                                 intermediate_size=14336,
+                                 num_hidden_layers=32,
+                                 num_attention_heads=32,
+                                 num_key_value_heads=8,
+                                 sliding_window=4096, rope_theta=10000.0,
+                                 max_position_embeddings=32768),
+                          **overrides})
+
+
+def qwen2_7b(**overrides):
+    """Qwen2-7B: llama architecture + GQA + q/k/v biases."""
+    return LlamaConfig(**{**dict(vocab_size=152064, hidden_size=3584,
+                                 intermediate_size=18944,
+                                 num_hidden_layers=28,
+                                 num_attention_heads=28,
+                                 num_key_value_heads=4,
+                                 attention_bias=True, rope_theta=1e6,
+                                 max_position_embeddings=131072),
+                          **overrides})
+
+
 def _rope_freqs(head_dim, max_len, theta, scaling=None):
     """cos/sin tables; ``scaling`` is ``LlamaConfig.rope_scaling`` —
     ``(type, factor, low_freq_factor, high_freq_factor, original_max)``.
@@ -186,12 +210,17 @@ class LlamaAttention(nn.Module):
                 q, cos, sin,
                 positions=start + jnp.arange(S)[None, :])
             # GQA handled inside decode_attention (no cache-wide repeat)
-            out = decode_attention(q, k, v, start)
+            out = decode_attention(q, k, v, start,
+                                   window=cfg.sliding_window)
         else:
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
 
             if cfg.use_ulysses and cfg.sp_backend == "ring":
+                if cfg.sliding_window:
+                    raise NotImplementedError(
+                        "sliding_window is not supported by the ring SP "
+                        "backend; use sp_backend='ulysses'")
                 # ring handles Hkv < H internally — K/V circulate the ICI
                 # ring at native KV width (repeating first would multiply
                 # every ppermute hop's bytes by H/Hkv)
@@ -205,10 +234,12 @@ class LlamaAttention(nn.Module):
                     v = jnp.repeat(v, rep, axis=2)
                 if cfg.use_ulysses:
                     from ..sequence.layer import DistributedAttention
-                    out = DistributedAttention()(q, k, v, causal=True)
+                    out = DistributedAttention()(q, k, v, causal=True,
+                                                 window=cfg.sliding_window)
                 else:
                     from ..ops.attention import attention_core
-                    out = attention_core(q, k, v, causal=True)
+                    out = attention_core(q, k, v, causal=True,
+                                         window=cfg.sliding_window)
 
         out = out.reshape(B, S, H * Dh)
         return dense(features=D, axis=-1, name="o_proj")(out)

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_attention(q, k, v, causal=True, softmax_scale=None):
+def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0):
     """Reference XLA path [B, S, H, D] (fp32 softmax accumulation)."""
     B, S, H, D = q.shape
     scale = softmax_scale if softmax_scale is not None else D**-0.5
@@ -22,6 +22,10 @@ def _xla_attention(q, k, v, causal=True, softmax_scale=None):
     if causal:
         Sk = k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)
+        if window:
+            # sliding window: each query sees only the last `window` keys
+            mask &= ~jnp.tril(jnp.ones((S, Sk), dtype=bool),
+                              k=Sk - S - window)
         logits = jnp.where(mask[None, None], logits,
                            jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -40,13 +44,15 @@ def _use_pallas():
 _fallback_warned = False
 
 
-def attention_core(q, k, v, causal=True, softmax_scale=None):
-    """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere."""
+def attention_core(q, k, v, causal=True, softmax_scale=None, window=0):
+    """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
+    ``window`` > 0 = sliding-window causal attention (Mistral)."""
     if _use_pallas():
         try:
             from .pallas.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=causal,
-                                   softmax_scale=softmax_scale)
+                                   softmax_scale=softmax_scale,
+                                   window=window)
         except Exception as e:
             # LOUD: a silent fall-through here would quietly trade the flash
             # kernel for O(S²)-memory XLA attention on real hardware
@@ -59,4 +65,5 @@ def attention_core(q, k, v, causal=True, softmax_scale=None):
                     "(%s: %s) — falling back to XLA attention; expect "
                     "lower MFU at long sequence lengths",
                     type(e).__name__, e)
-    return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                          window=window)

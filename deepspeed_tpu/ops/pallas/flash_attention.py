@@ -63,30 +63,41 @@ def _row_to_col(row):
                                preferred_element_type=jnp.float32)
 
 
-def _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k):
+def _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
+                window=0):
     """Validity mask for one (block_q, block_k) score tile.  ``sq``/``sk`` are
-    the *unpadded* lengths, so the zero-padded K tail is always excluded."""
+    the *unpadded* lengths, so the zero-padded K tail is always excluded.
+    ``window`` > 0 additionally limits each query to the last ``window`` keys
+    (Mistral sliding window; requires causal)."""
     col = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     mask = col < sk
     if causal:
         row = q_start + jax.lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 0)
         mask = jnp.logical_and(mask, row + (sk - sq) >= col)
+        if window:
+            mask = jnp.logical_and(mask, col > row + (sk - sq) - window)
     return mask
 
 
-def _block_live(q_start, k_start, causal, sq, sk, block_q):
-    """Whether this K block contributes at all (static-shape early-out)."""
+def _block_live(q_start, k_start, causal, sq, sk, block_q, block_k=None,
+                window=0):
+    """Whether this K block contributes at all (static-shape early-out).
+    With a sliding window, K blocks entirely older than the newest query's
+    window are dead — the block-skip that makes window cost O(S·W)."""
     live = k_start < sk
     if causal:
         live = jnp.logical_and(live,
                                k_start <= q_start + block_q - 1 + (sk - sq))
+        if window:
+            live = jnp.logical_and(
+                live, k_start + block_k - 1 > q_start + (sk - sq) - window)
     return live
 
 
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, sq, sk, block_q, block_k):
+                scale, causal, sq, sk, block_q, block_k, window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -98,13 +109,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     q_start, k_start = iq * block_q, ik * block_k
 
-    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q,
+                         block_k, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
+                           window)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]
@@ -139,7 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, 0] = _col_to_row(lse)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
+def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
     """Core on padded [B,H,S,D] inputs; sq/sk are the unpadded lengths."""
     B, Hq, sq_p, D = q.shape
     _, Hkv, sk_p, _ = k.shape
@@ -147,7 +160,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
     kv_head = lambda h: (h * Hkv) // Hq
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+                               sq=sq, sk=sk, block_q=block_q,
+                               block_k=block_k, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, Hq, nq, nk),
@@ -181,7 +195,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
 
 # --------------------------------------------------------------------- bwd
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, sq, sk, block_q, block_k):
+                   acc_ref, *, scale, causal, sq, sk, block_q, block_k,
+                   window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -191,7 +206,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     q_start, k_start = iq * block_q, ik * block_k
 
-    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q,
+                         block_k, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -201,7 +217,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
+                           window)
         # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
         # are all masked, so the select discards whatever exp produced
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -217,7 +234,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     dv_ref, dk_acc, dv_acc, *, scale, causal, sq, sk, block_q,
-                    block_k):
+                    block_k, window):
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -228,7 +245,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
     q_start, k_start = iq * block_q, ik * block_k
 
-    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q))
+    @pl.when(_block_live(q_start, k_start, causal, sq, sk, block_q,
+                         block_k, window))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -238,7 +256,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k)
+        mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
+                           window)
         # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
         # are all masked, so the select discards whatever exp produced
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -257,7 +276,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
+def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
+         sk, window):
     B, Hq, sq_p, D = q.shape
     _, Hkv, sk_p, _ = k.shape
     nq, nk = sq_p // block_q, sk_p // block_k
@@ -274,7 +294,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, sq=sq,
-                          sk=sk, block_q=block_q, block_k=block_k),
+                          sk=sk, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(B, Hq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -298,7 +319,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
     # KV heads afterwards — the GQA head fan-in.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, sq=sq,
-                          sk=sk, block_q=block_q, block_k=block_k),
+                          sk=sk, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(B, Hq, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -333,36 +355,43 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk):
 
 
 # ------------------------------------------------------------------ public
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, sq, sk):
-    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, sq, sk):
-    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk)
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, sq, sk, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, sq, sk, window, res, do):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk)
+    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk,
+                window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=True, softmax_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    window=0):
     """[B, S, H, D] flash attention with GQA (Hkv | Hq) support.
 
     Differentiable (custom VJP with flash recomputation).  S and D need not be
-    block-aligned; inputs are zero-padded and masked internally.
+    block-aligned; inputs are zero-padded and masked internally.  ``window``
+    > 0 restricts each query to the last ``window`` keys (Mistral sliding
+    window) with dead K blocks skipped — requires ``causal``.
     """
     B, sq, Hq, D = q.shape
     _, sk, Hkv, _ = k.shape
     if Hq % Hkv:
         raise ValueError(f"q heads {Hq} not a multiple of kv heads {Hkv}")
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     scale = float(softmax_scale) if softmax_scale is not None else D**-0.5
     block_q = max(16, min(block_q, sq))
     block_k = max(16, min(block_k, sk))
@@ -370,5 +399,6 @@ def flash_attention(q, k, v, causal=True, softmax_scale=None,
     qt = _pad_to(_pad_to(q.transpose(0, 2, 1, 3), 2, block_q), 3, 128)
     kt = _pad_to(_pad_to(k.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
     vt = _pad_to(_pad_to(v.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
-    o = _flash(qt, kt, vt, bool(causal), scale, block_q, block_k, sq, sk)
+    o = _flash(qt, kt, vt, bool(causal), scale, block_q, block_k, sq, sk,
+               int(window))
     return o[:, :, :sq, :D].transpose(0, 2, 1, 3)

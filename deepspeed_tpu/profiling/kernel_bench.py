@@ -112,13 +112,13 @@ def fusion_report(D=1024, H=8, S=512, dtype=jnp.bfloat16):
     kernels = len(re.findall(r"(?:fusion|custom-call|dot|convolution)\(",
                              entry)) or len(fusions)
     standalone = {}
+    bodies = re.split(r"\n\n", hlo)
     for fam, pat in (("rsqrt(norm)", r"rsqrt"), ("rotary(sin/cos mul)",
                                                  r"sine|cosine"),
                      ("softmax(exp)", r"exponential"),
                      ("silu(logistic)", r"logistic")):
         # a family is "standalone" if some fusion contains it but no dot —
         # crude but effective: look at each fused computation body
-        bodies = re.split(r"\n\n", hlo)
         alone = sum(1 for b in bodies
                     if re.search(pat, b) and "fused" in b.split("{")[0]
                     and " dot(" not in b and "custom-call" not in b)

@@ -25,19 +25,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import groups
 
 
-def _default_attention(q, k, v, causal=True, softmax_scale=None):
-    """Local attention core [B, S, H, D] — plain XLA implementation.  The
-    pallas flash kernel (ops/pallas/flash_attention.py) slots in here on TPU."""
-    B, S, H, D = q.shape
-    scale = softmax_scale if softmax_scale is not None else D**-0.5
-    # [B, H, S, S]
-    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
-    if causal:
-        Sk = k.shape[1]
-        mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthd->bshd", probs, v)
+def _default_attention(q, k, v, causal=True, softmax_scale=None, window=0):
+    """Local attention core [B, S, H, D].  After the Ulysses a2a the
+    sequence axis is global, so causal/sliding-window masks apply directly;
+    one shared implementation with attention_core's XLA path."""
+    from ..ops.attention import _xla_attention
+    return _xla_attention(q, k, v, causal=causal,
+                          softmax_scale=softmax_scale, window=window)
 
 
 def single_all_to_all(x, scatter_idx, gather_idx, axis_name):
