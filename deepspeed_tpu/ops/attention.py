@@ -48,11 +48,17 @@ def attention_core(q, k, v, causal=True, softmax_scale=None, window=0):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
     ``window`` > 0 = sliding-window causal attention (Mistral)."""
     if _use_pallas():
+        from .pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                             DEFAULT_BLOCK_Q,
+                                             flash_attention)
+        # parse OUTSIDE the fallback guard — a malformed env value should
+        # fail fast, not silently disable the flash kernel
+        bq = int(os.environ.get("DS_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+        bk = int(os.environ.get("DS_TPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
         try:
-            from .pallas.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale,
-                                   window=window)
+                                   window=window, block_q=bq, block_k=bk)
         except Exception as e:
             # LOUD: a silent fall-through here would quietly trade the flash
             # kernel for O(S²)-memory XLA attention on real hardware

@@ -457,8 +457,10 @@ class DeepSpeedEngine:
             self._nvme_prefetch = self._nvme_swapper.swap_in_tree_async()
 
     def _ensure_state_resident(self):
-        """Bring NVMe-offloaded optimizer state back to (host→)device refs.
-        Used by step(), checkpointing, and fragment APIs."""
+        """Bring offloaded state (host via offload_states, or NVMe) back to
+        device refs.  Used by step(), checkpointing, and fragment APIs."""
+        if getattr(self, "_host_offloaded", None):
+            self.reload_states()
         if self._nvme_swapper is None or not self._state_on_nvme:
             return
         self._nvme_start_swap_in()
@@ -888,11 +890,62 @@ class DeepSpeedEngine:
         return total / self.gradient_accumulation_steps()
 
     def _check_params(self):
+        offloaded = getattr(self, "_host_offloaded", None)
+        if offloaded and "params" in offloaded:
+            # forward needs ONLY the params back; master/opt_state stay on
+            # host until step()/checkpointing asks (the point of offloading
+            # optimizer state is running generation forwards without it)
+            host, shardings = offloaded.pop("params")
+            self.params = jax.tree_util.tree_map(jax.device_put, host,
+                                                 shardings)
         if self.params is None:
             raise RuntimeError(
                 "engine has no parameters — pass model_parameters to "
                 "initialize() or call engine.initialize_parameters(seed, "
                 "*sample_inputs) first")
+
+    # ------------------------------------------------- state offload on demand
+    _OFFLOAD_STATE_ATTRS = {"optim_states": "opt_state",
+                            "hp_params": "master",
+                            "lp_params": "params"}
+
+    def offload_states(self, include=None, device="cpu", pin_memory=True,
+                       non_blocking=False):
+        """Move engine states to host memory on demand (reference
+        ``engine.py:3720``; used by RLHF-style flows to free HBM between
+        phases).  ``include``: subset of {"optim_states", "hp_params",
+        "lp_params"}; default all.  States return via :meth:`reload_states`
+        (or automatically on the next forward/step)."""
+        if str(device) not in ("cpu", "OffloadDeviceEnum.cpu"):
+            raise ValueError(f"only host offload is supported, got {device}")
+        if getattr(self, "_state_on_nvme", False):
+            raise RuntimeError("states already offloaded to NVMe")
+        names = (set(include) if include is not None
+                 else set(self._OFFLOAD_STATE_ATTRS))
+        self._host_offloaded = getattr(self, "_host_offloaded", None) or {}
+        for name in names:
+            # accept both "optim_states" and OffloadStateTypeEnum.optim_states
+            attr = self._OFFLOAD_STATE_ATTRS.get(str(name).split(".")[-1])
+            if attr is None:
+                raise ValueError(
+                    f"unknown state {name!r} "
+                    f"(have: {sorted(self._OFFLOAD_STATE_ATTRS)})")
+            tree = getattr(self, attr)
+            if tree is None or attr in self._host_offloaded:
+                continue
+            shardings = jax.tree_util.tree_map(lambda x: x.sharding, tree)
+            host = jax.device_get(tree)   # commits to host numpy
+            setattr(self, attr, None)     # release the HBM buffers
+            self._host_offloaded[attr] = (host, shardings)
+
+    def reload_states(self, non_blocking=False):
+        """Reload offloaded states to their original device shardings
+        (reference ``engine.py:3747``)."""
+        for attr, (host, shardings) in (getattr(self, "_host_offloaded",
+                                                None) or {}).items():
+            setattr(self, attr, jax.tree_util.tree_map(
+                jax.device_put, host, shardings))
+        self._host_offloaded = {}
 
     # ----------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
