@@ -131,3 +131,45 @@ def add_config_arguments(parser):
 def argparse_suppress():
     import argparse
     return argparse.SUPPRESS
+
+
+def default_inference_config():
+    """Default DeepSpeedInferenceConfig as a dict (reference
+    ``deepspeed/__init__.py:284``)."""
+    from .inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()
+
+
+def is_compile_supported():
+    """Reference ``runtime/compiler.py`` — torch.compile availability.  On
+    TPU every engine step is already XLA-compiled; always True."""
+    return True
+
+
+# lazy conveniences mirroring the reference's top-level namespace
+def __getattr__(name):
+    if name == "OnDevice":
+        from .utils.init_on_device import OnDevice
+        return OnDevice
+    if name in ("DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig"):
+        from .ops import transformer
+        return getattr(transformer, name)
+    if name in ("PipelineModule", "LayerSpec", "TiedLayerSpec"):
+        from .runtime import pipe
+        return getattr(pipe, name)
+    if name == "DeepSpeedEngine":
+        from .runtime.engine import DeepSpeedEngine
+        return DeepSpeedEngine
+    if name == "InferenceEngine":
+        from .inference.engine import InferenceEngine
+        return InferenceEngine
+    if name == "DeepSpeedConfig":
+        from .runtime.config import DeepSpeedConfig
+        return DeepSpeedConfig
+    if name in ("replace_transformer_layer", "revert_transformer_layer"):
+        from . import module_inject
+        return getattr(module_inject, name)
+    if name == "zero":
+        from .runtime import zero
+        return zero
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

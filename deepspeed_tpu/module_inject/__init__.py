@@ -1,5 +1,6 @@
 from .auto_tp import AutoTP, shard_params_for_tp
 from .containers import (InjectionPolicy, POLICIES, policy_for,
-                         replace_transformer_layer)
+                         replace_transformer_layer,
+                         revert_transformer_layer)
 from .layers import ColumnParallelLinear, RowParallelLinear, LinearAllreduce, LinearLayer
 from .tp_parser import TpParser, derive_tp_rules_from_dataflow

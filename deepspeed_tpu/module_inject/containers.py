@@ -126,3 +126,16 @@ def replace_transformer_layer(arch_or_model, checkpoint_dir=None,
     model = policy.model_factory(config, dtype=dtype)
     logger.info(f"injected TPU-optimized {policy.model_type} implementation")
     return model, None
+
+
+def revert_transformer_layer(orig_model, replaced=None, config=None):
+    """Reference ``module_inject/__init__`` ``revert_transformer_layer``:
+    swap fused inference modules back to the original implementation.
+
+    Here injection returns a NEW (model, params) pair and never mutates the
+    user's module, so reverting is returning the original object — there is
+    no fused-module state to unwind (XLA fusion is a compiler artifact of
+    the replaced model's jit, not a module swap)."""
+    logger.info("revert_transformer_layer: injection is non-mutating on "
+                "TPU; returning the original model")
+    return orig_model

@@ -43,3 +43,9 @@ class SparseAttnBuilder(PallasOpBuilder):
 class EvoformerAttnBuilder(PallasOpBuilder):
     NAME = "evoformer_attn"
     MODULE = "deepspeed_tpu.ops.deepspeed4science.evoformer_attn"
+
+
+@register_op_builder
+class TransformerBuilder(PallasOpBuilder):
+    NAME = "transformer"  # reference training transformer kernel suite
+    MODULE = "deepspeed_tpu.ops.transformer"

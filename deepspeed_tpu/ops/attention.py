@@ -44,32 +44,43 @@ def _use_pallas():
 _fallback_warned = False
 
 
+def _warn_fallback(e):
+    """LOUD once: silently trading the flash kernel for O(S²)-memory XLA
+    attention would destroy MFU on real hardware."""
+    global _fallback_warned
+    if not _fallback_warned:
+        _fallback_warned = True
+        from ..utils.logging import logger
+        logger.warning(
+            "Pallas flash attention unavailable/failed on this platform "
+            "(%s: %s) — falling back to XLA attention; expect lower MFU "
+            "at long sequence lengths", type(e).__name__, e)
+
+
 def attention_core(q, k, v, causal=True, softmax_scale=None, window=0):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
     ``window`` > 0 = sliding-window causal attention (Mistral)."""
     if _use_pallas():
-        from .pallas.flash_attention import (DEFAULT_BLOCK_K,
-                                             DEFAULT_BLOCK_Q,
-                                             flash_attention)
-        # parse OUTSIDE the fallback guard — a malformed env value should
-        # fail fast, not silently disable the flash kernel
-        bq = int(os.environ.get("DS_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
-        bk = int(os.environ.get("DS_TPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
         try:
-            return flash_attention(q, k, v, causal=causal,
-                                   softmax_scale=softmax_scale,
-                                   window=window, block_q=bq, block_k=bk)
-        except Exception as e:
-            # LOUD: a silent fall-through here would quietly trade the flash
-            # kernel for O(S²)-memory XLA attention on real hardware
-            global _fallback_warned
-            if not _fallback_warned:
-                _fallback_warned = True
-                from ..utils.logging import logger
-                logger.warning(
-                    "Pallas flash attention failed on this platform "
-                    "(%s: %s) — falling back to XLA attention; expect "
-                    "lower MFU at long sequence lengths",
-                    type(e).__name__, e)
+            from .pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                                 DEFAULT_BLOCK_Q,
+                                                 flash_attention)
+        except Exception as e:  # import failure → documented XLA fallback
+            flash_attention = None
+            _warn_fallback(e)
+        if flash_attention is not None:
+            # parse OUTSIDE the kernel-fallback guard — a malformed env
+            # value should fail fast, not silently disable the kernel
+            bq = int(os.environ.get("DS_TPU_FLASH_BLOCK_Q",
+                                    DEFAULT_BLOCK_Q))
+            bk = int(os.environ.get("DS_TPU_FLASH_BLOCK_K",
+                                    DEFAULT_BLOCK_K))
+            try:
+                return flash_attention(q, k, v, causal=causal,
+                                       softmax_scale=softmax_scale,
+                                       window=window, block_q=bq,
+                                       block_k=bk)
+            except Exception as e:
+                _warn_fallback(e)
     return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
                           window=window)

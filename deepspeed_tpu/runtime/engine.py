@@ -904,6 +904,16 @@ class DeepSpeedEngine:
                 "initialize() or call engine.initialize_parameters(seed, "
                 "*sample_inputs) first")
 
+    def compile(self, backend=None, compile_kwargs=None) -> None:
+        """Reference ``engine.py:3696`` (torch.compile wrapper).  Every
+        train/eval step here is already traced+compiled by XLA under jit, so
+        this only records the request for API parity."""
+        self._is_compiled = True
+
+    @property
+    def is_compiled(self) -> bool:
+        return getattr(self, "_is_compiled", False)
+
     # ------------------------------------------------- state offload on demand
     _OFFLOAD_STATE_ATTRS = {"optim_states": "opt_state",
                             "hp_params": "master",

@@ -78,6 +78,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         return self._inference_engine
 
     def _generation_params(self):
+        self._check_params()   # restores host-offloaded params if needed
         params = self.params
         if self._lora_params is not None and not self._lora_fused:
             from ..linear import merge_lora

@@ -1,0 +1,68 @@
+"""OnDevice — construct models without materializing weights.
+
+Reference ``deepspeed/utils/init_on_device.py`` (``OnDevice`` meta-device
+context): patches torch tensor constructors so huge models build with no
+storage.  The JAX analog is ``jax.eval_shape``; inside ``OnDevice(
+device="meta")`` every flax ``Module.init`` returns a tree of
+``jax.ShapeDtypeStruct`` — shapes and dtypes, zero bytes — which is exactly
+what ``engine.initialize_parameters`` / checkpoint restore consume to
+materialize directly into the sharded layout.
+
+With a real ``device``, ``init`` simply runs under ``jax.default_device``.
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        abstract = model.init(rng, sample)     # ShapeDtypeStructs
+"""
+
+import contextlib
+
+import jax
+
+
+class OnDevice:
+    """Context manager: abstract (meta) or device-targeted flax init."""
+
+    _active = None
+
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._stack = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        self._stack = contextlib.ExitStack()
+        if self.device == "meta":
+            import flax.linen as nn
+            orig_init = nn.Module.init
+            me = self
+
+            def abstract_init(module, rngs, *args, **kwargs):
+                out = jax.eval_shape(
+                    lambda r, *a: orig_init(module, r, *a, **kwargs),
+                    rngs, *args)
+                if me.dtype is not None:
+                    out = jax.tree_util.tree_map(
+                        lambda s: jax.ShapeDtypeStruct(s.shape, me.dtype)
+                        if jax.numpy.issubdtype(s.dtype,
+                                                jax.numpy.floating) else s,
+                        out)
+                return out
+
+            nn.Module.init = abstract_init
+            self._stack.callback(setattr, nn.Module, "init", orig_init)
+        else:
+            dev = (self.device if not isinstance(self.device, str)
+                   else jax.devices(self.device)[0])
+            self._stack.enter_context(jax.default_device(dev))
+        OnDevice._active = self
+        self._stack.callback(setattr, OnDevice, "_active", None)
+        return self
+
+    def __exit__(self, *exc):
+        stack, self._stack = self._stack, None
+        if stack is not None:
+            stack.close()
+        return False

@@ -48,13 +48,26 @@ def _set_leaf(tree, name, value):
         jax.tree_util.tree_structure(tree), leaves)
 
 
+def _resident(engine):
+    """Bring offloaded state (host offload_states / NVMe) back before any
+    fragment access — reference fragment APIs always see live tensors."""
+    ensure = getattr(engine, "_ensure_state_resident", None)
+    if ensure is not None:
+        ensure()
+    if getattr(engine, "_host_offloaded", None):
+        engine.reload_states()
+    return engine
+
+
 def parameter_names(engine):
+    _resident(engine)
     return sorted(_flat_with_names(engine.params).keys())
 
 
 # ------------------------------------------------------------------ getters
 def safe_get_full_fp32_param(engine, name):
     """Full fp32 master weight (reference tensor_fragment.py:187)."""
+    _resident(engine)
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
@@ -64,6 +77,7 @@ def safe_get_full_fp32_param(engine, name):
 
 def safe_get_full_grad(engine, name):
     """Full accumulated gradient, unscaled (reference :158)."""
+    _resident(engine)
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
@@ -74,6 +88,7 @@ def safe_get_full_grad(engine, name):
 
 def safe_get_full_optimizer_state(engine, name, state_key):
     """Full optimizer state tensor, e.g. ``exp_avg`` (reference :214)."""
+    _resident(engine)
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)
@@ -89,6 +104,7 @@ def safe_get_full_optimizer_state(engine, name, state_key):
 def safe_set_full_fp32_param(engine, name, value):
     """Overwrite the fp32 master weight (and refresh the compute-dtype copy)
     preserving sharding (reference :241)."""
+    _resident(engine)
     plan = engine.plan
     if engine.master is not None:
         old = _lookup(engine.master, name)
@@ -104,6 +120,7 @@ def safe_set_full_fp32_param(engine, name, value):
 
 def safe_set_full_optimizer_state(engine, name, state_key, value):
     """Overwrite one optimizer-state tensor (reference :262)."""
+    _resident(engine)
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)
@@ -159,6 +176,7 @@ def _local_block(leaf, dtype=np.float32):
 
 def safe_get_local_fp32_param(engine, name):
     """This host's shard of the fp32 master (reference ZeRO-3 local API :280)."""
+    _resident(engine)
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
@@ -167,6 +185,7 @@ def safe_get_local_fp32_param(engine, name):
 
 
 def safe_get_local_grad(engine, name):
+    _resident(engine)
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
@@ -178,6 +197,7 @@ def safe_get_local_grad(engine, name):
 
 
 def safe_get_local_optimizer_state(engine, name, state_key):
+    _resident(engine)
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)

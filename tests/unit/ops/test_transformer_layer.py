@@ -1,0 +1,83 @@
+"""DeepSpeedTransformerLayer / OnDevice / top-level API parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+
+
+def _cfg(**kw):
+    return DeepSpeedTransformerConfig(
+        batch_size=2, hidden_size=32, heads=4, num_hidden_layers=2,
+        bf16=False, **kw)
+
+
+@pytest.mark.parametrize("preln", [True, False])
+def test_transformer_layer_forward_and_grad(preln):
+    cfg = _cfg(pre_layer_norm=preln)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 10, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    out = layer.apply({"params": params}, x)
+    assert out.shape == x.shape
+
+    g = jax.grad(lambda p: jnp.sum(
+        layer.apply({"params": p}, x) ** 2))(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in flat)
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in flat)
+
+
+def test_transformer_layer_mask():
+    cfg = _cfg()
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 8, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    mask = jnp.ones((2, 8), jnp.int32).at[:, 6:].set(0)
+    out_m = layer.apply({"params": params}, x, mask)
+    # masked keys must not influence unmasked outputs
+    x2 = x.at[:, 6:].set(99.0)
+    out_m2 = layer.apply({"params": params}, x2, mask)
+    np.testing.assert_allclose(np.asarray(out_m[:, :6]),
+                               np.asarray(out_m2[:, :6]), atol=1e-5)
+
+
+def test_transformer_config_from_dict_ignores_cuda_knobs():
+    cfg = DeepSpeedTransformerConfig.from_dict(
+        {"hidden_size": 64, "heads": 8, "stochastic_mode": True,
+         "unknown_key": 1})
+    assert cfg.hidden_size == 64 and cfg.ffn_size == 256
+
+
+def test_on_device_meta_init():
+    from deepspeed_tpu.utils.init_on_device import OnDevice
+    from deepspeed_tpu.models import llama
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        abstract = model.init(jax.random.PRNGKey(0), ids)
+    leaves = jax.tree_util.tree_leaves(abstract)
+    assert leaves and all(isinstance(l, jax.ShapeDtypeStruct)
+                          for l in leaves)
+    assert any(l.dtype == jnp.bfloat16 for l in leaves)
+    # patching is undone on exit
+    real = model.init(jax.random.PRNGKey(0), ids)
+    assert not isinstance(jax.tree_util.tree_leaves(real)[0],
+                          jax.ShapeDtypeStruct)
+
+
+def test_top_level_exports():
+    assert deepspeed_tpu.is_compile_supported() is True
+    assert isinstance(deepspeed_tpu.default_inference_config(), dict)
+    assert deepspeed_tpu.OnDevice is not None
+    assert deepspeed_tpu.DeepSpeedTransformerLayer is DeepSpeedTransformerLayer
+    assert callable(deepspeed_tpu.revert_transformer_layer)
+    m = object()
+    assert deepspeed_tpu.revert_transformer_layer(m) is m

@@ -276,3 +276,36 @@ def test_offload_reload_states():
 
     with pytest.raises(ValueError, match="unknown state"):
         engine.offload_states(include=["bogus"])
+
+
+def test_fragment_api_after_offload():
+    """Fragment getters/setters must see live state after offload_states."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils.tensor_fragment import (
+        parameter_names, safe_get_full_fp32_param, safe_set_full_fp32_param)
+
+    cfg = llama.llama_tiny(dtype="bfloat16", remat=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(16, 16)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    l = engine(ids, ids); engine.backward(l); engine.step()
+
+    name = parameter_names(engine)[0]
+    before = safe_get_full_fp32_param(engine, name)
+    engine.offload_states()
+    # getter restores residency and returns the fp32 master, not bf16 params
+    after = safe_get_full_fp32_param(engine, name)
+    np.testing.assert_array_equal(before, after)
+    assert engine.master is not None  # master (not params) was consulted
+
+    engine.offload_states()
+    safe_set_full_fp32_param(engine, name, np.zeros_like(before))
+    assert np.abs(safe_get_full_fp32_param(engine, name)).max() == 0
