@@ -75,23 +75,49 @@ class Autotuner:
             cands = [cands[i] for i in idx]
         return cands
 
+    def _mesh_candidates(self):
+        """Mesh factorizations to explore (the reference tunes these only by
+        re-launching whole jobs; in-process SPMD can rebuild the mesh per
+        trial).  Default: dp-only plus 2-way tp and sp splits when the
+        device count allows."""
+        if self.cfg.mesh_candidates is not None:
+            return self.cfg.mesh_candidates
+        if not self.cfg.tune_mesh:
+            return [None]
+        import jax
+        n = len(jax.devices())
+        cands = [{"dp": -1}]
+        if n % 2 == 0 and n > 1:
+            cands.append({"dp": -1, "tp": 2})
+            cands.append({"dp": -1, "sp": 2})
+        if n % 4 == 0 and n > 2:
+            cands.append({"dp": -1, "tp": 4})
+            cands.append({"dp": -1, "tp": 2, "sp": 2})
+        return cands
+
     def build_tuning_space(self):
-        """ZeRO-stage × mbs grid (reference config_templates per stage)."""
+        """ZeRO-stage × mbs (× mesh) grid (reference config_templates per
+        stage; mesh is the TPU extension)."""
         stages = self.cfg.zero_stages
         if stages is None:
             stages = [0, 1, 2, 3]
         if self.cfg.fast:
             stages = stages[:2]
         exps = []
-        for stage, mbs in itertools.product(stages,
-                                            self._micro_batch_candidates()):
+        for stage, mbs, mesh in itertools.product(
+                stages, self._micro_batch_candidates(),
+                self._mesh_candidates()):
             ds = dict(self.base_config)
             ds.pop("autotuning", None)
             ds = json.loads(json.dumps(ds))  # deep copy
             ds.setdefault("zero_optimization", {})["stage"] = stage
             ds["train_micro_batch_size_per_gpu"] = mbs
             ds.pop("train_batch_size", None)
-            exps.append({"name": f"z{stage}_mbs{mbs}", "ds_config": ds})
+            name = f"z{stage}_mbs{mbs}"
+            if mesh is not None:
+                ds["mesh"] = dict(mesh)
+                name += "_" + "x".join(f"{k}{v}" for k, v in mesh.items())
+            exps.append({"name": name, "ds_config": ds})
         return exps
 
     # ----------------------------------------------------------- experiment
@@ -110,6 +136,9 @@ class Autotuner:
             batch = self.batch_fn(mbs * engine.dp_world_size)
             if not isinstance(batch, tuple):
                 batch = (batch, )
+            if engine.params is None:
+                # flax module without explicit parameters: born-sharded init
+                engine.initialize_parameters(0, *batch)
             warmup = max(1, self.cfg.start_profile_step - 1)
             steps = max(self.steps_per_trial, warmup + 1)
             t0 = None

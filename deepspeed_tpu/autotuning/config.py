@@ -27,3 +27,9 @@ class AutotuningConfig(DeepSpeedConfigModel):
     mp_size: int = 1
     model_info: Optional[Dict] = None
     zero_stages: Optional[List[int]] = None  # TPU addition: restrict space
+    # TPU addition: also explore mesh factorizations (the launcher-level
+    # knob the reference cannot tune in-process).  Candidates are dicts for
+    # the config's "mesh" key, e.g. [{"dp": -1}, {"dp": -1, "tp": 2}];
+    # None + tune_mesh=True → derived from the device count.
+    tune_mesh: bool = False
+    mesh_candidates: Optional[List[Dict]] = None

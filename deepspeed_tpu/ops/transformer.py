@@ -70,32 +70,57 @@ class DeepSpeedTransformerConfig:
             return cls.from_dict(json.load(f))
 
 
+def _dense(cfg, n, name):
+    return nn.Dense(n, dtype=cfg.dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+class _FFN(nn.Module):
+    """gelu MLP sub-block — a Module (not a closure) so gelu_checkpoint can
+    wrap it with nn.remat (jax.checkpoint over flax submodule creation
+    leaks tracers)."""
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, h, deterministic):
+        cfg = self.config
+        inner = nn.gelu(_dense(cfg, cfg.ffn_size, "inter")(h))
+        out = _dense(cfg, cfg.hidden_size, "output")(inner)
+        if cfg.hidden_dropout_ratio > 0 and not deterministic:
+            out = nn.Dropout(cfg.hidden_dropout_ratio)(
+                out, deterministic=False)
+        return out
+
+
 class DeepSpeedTransformerLayer(nn.Module):
     """BERT-style encoder layer (reference ``transformer.py:296``).
 
-    ``__call__(hidden_states, attention_mask=None, deterministic=True)`` →
+    ``__call__(hidden_states, attention_mask=None, deterministic=None)`` →
     hidden states ``[B, S, D]`` (tuple if ``config.return_tuple``).
     ``attention_mask``: additive mask broadcastable to ``[B, 1, S, S]`` or a
-    boolean/0-1 key mask ``[B, S]``.
+    boolean/0-1 key mask ``[B, S]``.  ``deterministic`` defaults to
+    ``not config.training`` so ported reference scripts get dropout during
+    training without extra plumbing.
     """
     config: DeepSpeedTransformerConfig
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None,
-                 deterministic=True):
+                 deterministic=None):
         cfg = self.config
+        if deterministic is None:
+            deterministic = not cfg.training
         D, H = cfg.hidden_size, cfg.heads
         Dh = D // H
         dtype = cfg.dtype
-        init = nn.initializers.normal(cfg.initializer_range)
-        dense = lambda n, name: nn.Dense(n, dtype=dtype,
-                                         param_dtype=jnp.float32,
-                                         kernel_init=init, name=name)
+        dense = lambda n, name: _dense(cfg, n, name)
         ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                        dtype=dtype, param_dtype=jnp.float32,
                                        name=name)
         x = hidden_states.astype(dtype)
         B, S, _ = x.shape
+        attn_drop = cfg.attn_dropout_ratio > 0 and not deterministic
 
         def attn_block(h):
             qkv = dense(3 * D, "attn_qkv")(h)
@@ -103,19 +128,22 @@ class DeepSpeedTransformerLayer(nn.Module):
             q = q.reshape(B, S, H, Dh)
             k = k.reshape(B, S, H, Dh)
             v = v.reshape(B, S, H, Dh)
-            if attention_mask is None:
+            if attention_mask is None and not attn_drop:
+                # flash/XLA core (no dropout support in the kernel)
                 from .attention import attention_core
                 out = attention_core(q, k, v, causal=False)
             else:
-                m = attention_mask
-                if m.ndim == 2:      # [B, S] key mask → additive
-                    m = jnp.where(m.astype(bool), 0.0,
-                                  jnp.finfo(jnp.float32).min)
-                    m = m[:, None, None, :]
                 logits = jnp.einsum("bshd,bthd->bhst", q, k) / Dh**0.5
-                logits = logits.astype(jnp.float32) + m.astype(jnp.float32)
+                logits = logits.astype(jnp.float32)
+                if attention_mask is not None:
+                    m = attention_mask
+                    if m.ndim == 2:      # [B, S] key mask → additive
+                        m = jnp.where(m.astype(bool), 0.0,
+                                      jnp.finfo(jnp.float32).min)
+                        m = m[:, None, None, :]
+                    logits = logits + m.astype(jnp.float32)
                 p = jax.nn.softmax(logits, axis=-1).astype(dtype)
-                if cfg.attn_dropout_ratio > 0 and not deterministic:
+                if attn_drop:
                     p = nn.Dropout(cfg.attn_dropout_ratio)(
                         p, deterministic=False)
                 out = jnp.einsum("bhst,bthd->bshd", p, v)
@@ -125,21 +153,14 @@ class DeepSpeedTransformerLayer(nn.Module):
                     out, deterministic=False)
             return out
 
-        def ffn_block(h):
-            inner = nn.gelu(dense(cfg.ffn_size, "inter")(h))
-            out = dense(D, "output")(inner)
-            if cfg.hidden_dropout_ratio > 0 and not deterministic:
-                out = nn.Dropout(cfg.hidden_dropout_ratio)(
-                    out, deterministic=False)
-            return out
-
-        if cfg.gelu_checkpoint:
-            ffn_block = jax.checkpoint(ffn_block)
+        ffn_cls = (nn.remat(_FFN, static_argnums=(2, ))
+                   if cfg.gelu_checkpoint else _FFN)
+        ffn = ffn_cls(cfg, name="ffn")
 
         if cfg.pre_layer_norm:
             x = x + attn_block(ln("attn_ln")(x))
-            x = x + ffn_block(ln("ffn_ln")(x))
+            x = x + ffn(ln("ffn_ln")(x), deterministic)
         else:
             x = ln("attn_ln")(x + attn_block(x))
-            x = ln("ffn_ln")(x + ffn_block(x))
+            x = ln("ffn_ln")(x + ffn(x, deterministic))
         return (x, ) if cfg.return_tuple else x

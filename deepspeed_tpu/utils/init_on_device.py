@@ -22,8 +22,6 @@ import jax
 class OnDevice:
     """Context manager: abstract (meta) or device-targeted flax init."""
 
-    _active = None
-
     def __init__(self, dtype=None, device="meta", enabled=True):
         self.dtype = dtype
         self.device = device
@@ -57,8 +55,6 @@ class OnDevice:
             dev = (self.device if not isinstance(self.device, str)
                    else jax.devices(self.device)[0])
             self._stack.enter_context(jax.default_device(dev))
-        OnDevice._active = self
-        self._stack.callback(setattr, OnDevice, "_active", None)
         return self
 
     def __exit__(self, *exc):

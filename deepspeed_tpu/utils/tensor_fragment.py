@@ -48,26 +48,44 @@ def _set_leaf(tree, name, value):
         jax.tree_util.tree_structure(tree), leaves)
 
 
-def _resident(engine):
-    """Bring offloaded state (host offload_states / NVMe) back before any
-    fragment access — reference fragment APIs always see live tensors."""
-    ensure = getattr(engine, "_ensure_state_resident", None)
-    if ensure is not None:
-        ensure()
-    if getattr(engine, "_host_offloaded", None):
-        engine.reload_states()
+def _resident(engine, *attrs):
+    """Restore ONLY the named offloaded trees ("params"/"master"/
+    "opt_state") to device before a fragment access — restoring everything
+    would re-fill the HBM that offload_states() just freed.  The NVMe path
+    stores master+opt_state as one unit, so either name triggers its
+    swap-in."""
+    off = getattr(engine, "_host_offloaded", None)
+    if off:
+        for attr in attrs:
+            if attr in off:
+                host, sh = off.pop(attr)
+                setattr(engine, attr, jax.tree_util.tree_map(
+                    jax.device_put, host, sh))
+    if ({"master", "opt_state"} & set(attrs)
+            and getattr(engine, "_state_on_nvme", False)):
+        engine._ensure_state_resident()
     return engine
 
 
+def _host_tree(engine, attr):
+    """The host copy of an offloaded tree, if present (no device transfer)."""
+    off = getattr(engine, "_host_offloaded", None) or {}
+    return off[attr][0] if attr in off else None
+
+
 def parameter_names(engine):
-    _resident(engine)
-    return sorted(_flat_with_names(engine.params).keys())
+    # tree structure only — the host copy suffices, no residency needed
+    params = engine.params if engine.params is not None \
+        else _host_tree(engine, "params")
+    return sorted(_flat_with_names(params).keys())
 
 
 # ------------------------------------------------------------------ getters
 def safe_get_full_fp32_param(engine, name):
     """Full fp32 master weight (reference tensor_fragment.py:187)."""
-    _resident(engine)
+    _resident(engine, "master")
+    if engine.master is None:
+        _resident(engine, "params")
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
@@ -77,7 +95,6 @@ def safe_get_full_fp32_param(engine, name):
 
 def safe_get_full_grad(engine, name):
     """Full accumulated gradient, unscaled (reference :158)."""
-    _resident(engine)
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
@@ -88,7 +105,7 @@ def safe_get_full_grad(engine, name):
 
 def safe_get_full_optimizer_state(engine, name, state_key):
     """Full optimizer state tensor, e.g. ``exp_avg`` (reference :214)."""
-    _resident(engine)
+    _resident(engine, "opt_state")
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)
@@ -104,7 +121,7 @@ def safe_get_full_optimizer_state(engine, name, state_key):
 def safe_set_full_fp32_param(engine, name, value):
     """Overwrite the fp32 master weight (and refresh the compute-dtype copy)
     preserving sharding (reference :241)."""
-    _resident(engine)
+    _resident(engine, "master", "params")  # writes both copies
     plan = engine.plan
     if engine.master is not None:
         old = _lookup(engine.master, name)
@@ -120,7 +137,7 @@ def safe_set_full_fp32_param(engine, name, value):
 
 def safe_set_full_optimizer_state(engine, name, state_key, value):
     """Overwrite one optimizer-state tensor (reference :262)."""
-    _resident(engine)
+    _resident(engine, "opt_state")
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)
@@ -176,7 +193,9 @@ def _local_block(leaf, dtype=np.float32):
 
 def safe_get_local_fp32_param(engine, name):
     """This host's shard of the fp32 master (reference ZeRO-3 local API :280)."""
-    _resident(engine)
+    _resident(engine, "master")
+    if engine.master is None:
+        _resident(engine, "params")
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
@@ -185,7 +204,6 @@ def safe_get_local_fp32_param(engine, name):
 
 
 def safe_get_local_grad(engine, name):
-    _resident(engine)
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
@@ -197,7 +215,7 @@ def safe_get_local_grad(engine, name):
 
 
 def safe_get_local_optimizer_state(engine, name, state_key):
-    _resident(engine)
+    _resident(engine, "opt_state")
     from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
     field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
     sub = getattr(engine.opt_state, field, None)

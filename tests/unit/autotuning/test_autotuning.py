@@ -91,3 +91,33 @@ def test_autotuner_end_to_end(tmp_path):
         int(np.prod(x.shape)) for x in
         [params["layer_0"]["w"], params["layer_0"]["b"],
          params["layer_1"]["w"], params["layer_1"]["b"]])
+
+
+def test_mesh_tuning_space_and_trial():
+    """tune_mesh explores mesh factorizations; the best trial still wins."""
+    import numpy as np
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from tests.unit.simple_model import make_simple_mlp_params, simple_mlp_apply
+
+    params = make_simple_mlp_params(16)
+    rng = np.random.default_rng(0)
+
+    def batch_fn(gbs):
+        x = rng.standard_normal((gbs, 16)).astype(np.float32)
+        return (x, 0.5 * x)
+
+    tuner = Autotuner(
+        simple_mlp_apply, base_config={
+            "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+            "gradient_accumulation_steps": 1,
+            "autotuning": {"enabled": True, "fast": True,
+                           "tune_mesh": True, "zero_stages": [1],
+                           "num_tuning_micro_batch_sizes": 1,
+                           "max_train_micro_batch_size_per_gpu": 2,
+                           "min_train_micro_batch_size_per_gpu": 2}},
+        model_parameters=params, batch_fn=batch_fn, steps_per_trial=3)
+    space = tuner.build_tuning_space()
+    names = [e["name"] for e in space]
+    assert any("tp2" in n for n in names), names
+    assert any("sp2" in n for n in names), names
+    assert any("ds_config" in e and e["ds_config"].get("mesh") for e in space)

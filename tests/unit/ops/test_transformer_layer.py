@@ -81,3 +81,40 @@ def test_top_level_exports():
     assert callable(deepspeed_tpu.revert_transformer_layer)
     m = object()
     assert deepspeed_tpu.revert_transformer_layer(m) is m
+
+
+def test_gelu_checkpoint_trains():
+    cfg = _cfg(gelu_checkpoint=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 8, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    g = jax.grad(lambda p: jnp.sum(layer.apply({"params": p}, x) ** 2))(params)
+    assert all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree_util.tree_leaves(g))
+    # remat must not change the math
+    cfg2 = _cfg(gelu_checkpoint=False)
+    out_remat = layer.apply({"params": params}, x)
+    out_plain = DeepSpeedTransformerLayer(cfg2).apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_remat), np.asarray(out_plain),
+                               atol=1e-6)
+
+
+def test_attn_dropout_applies_without_mask():
+    """training=True + attn dropout must perturb outputs even with no
+    attention mask (the flash path has no dropout — must be bypassed)."""
+    cfg = _cfg(attn_dropout_ratio=0.5, training=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 32)),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    # deterministic defaults to not cfg.training == False → dropout active
+    out1 = layer.apply({"params": params}, x,
+                       rngs={"dropout": jax.random.PRNGKey(1)})
+    out2 = layer.apply({"params": params}, x,
+                       rngs={"dropout": jax.random.PRNGKey(2)})
+    assert np.abs(np.asarray(out1) - np.asarray(out2)).max() > 1e-4
+    # eval call is deterministic
+    outs = [layer.apply({"params": params}, x, deterministic=True)
+            for _ in range(2)]
+    np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(outs[1]))
