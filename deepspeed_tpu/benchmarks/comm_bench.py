@@ -1,0 +1,125 @@
+"""Collective micro-benchmark — the ``ds_bench`` CLI.
+
+Reference: ``bin/ds_bench`` forwards to the DeepSpeedExamples communication
+suite (all_reduce/all_gather/all_to_all/pt2pt sweeps printing algbw/busbw
+per size, nccl-tests conventions).  Here the sweep runs in-process over the
+mesh's collectives (psum / all_gather / all_to_all / ppermute on a chosen
+axis), with the same bandwidth accounting as ``utils/comms_logging.get_bw``.
+
+    ds_bench                       # sweep all ops over the dp axis
+    ds_bench --op all_reduce --axis dp --maxsize 28
+    ds_bench --mesh dp=4,tp=2      # explicit mesh factorization
+
+Prints one table row per (op, size): latency, algbw, busbw.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all", "pt2pt")
+
+
+def _bench_one(op, axis, nbytes, mesh, iters, warmup):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = max(n, nbytes // 4 // n * n)  # fp32, divisible by axis size
+    x = jnp.arange(elems, dtype=jnp.float32)
+
+    def make(fn):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(axis),
+                                     out_specs=P(axis), check_vma=False))
+
+    if op == "all_reduce":
+        f = make(lambda t: jax.lax.psum(t, axis) / n)
+    elif op == "all_gather":
+        f = make(lambda t: jax.lax.all_gather(t, axis).reshape(-1)[:t.shape[0]])
+    elif op == "reduce_scatter":
+        f = make(lambda t: jax.lax.psum_scatter(
+            t.reshape(n, -1), axis, scatter_dimension=0,
+            tiled=False).reshape(-1))
+    elif op == "all_to_all":
+        f = make(lambda t: jax.lax.all_to_all(
+            t.reshape(n, -1), axis, split_axis=0, concat_axis=0,
+            tiled=False).reshape(-1))
+    elif op == "pt2pt":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        f = make(lambda t: jax.lax.ppermute(t, axis, perm))
+    else:
+        raise ValueError(op)
+
+    for _ in range(warmup):
+        out = f(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(x)
+    jax.block_until_ready(out)
+    lat = (time.perf_counter() - t0) / iters
+
+    from ..utils.comms_logging import calc_bw_log
+    size_bytes = elems * 4
+    algbw, busbw = calc_bw_log(op if op != "pt2pt" else "send", size_bytes,
+                               lat, n)
+    return size_bytes, lat, algbw, busbw
+
+
+def run(ops=OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
+        iters=20, warmup=3, print_fn=print):
+    """Sweep collectives over powers-of-two message sizes.  Returns rows of
+    (op, bytes, latency_s, algbw_gbps, busbw_gbps)."""
+    from ..utils import groups
+    if mesh_spec:
+        kw = {}
+        for part in mesh_spec.split(","):
+            k, v = part.split("=")
+            kw[k] = int(v)
+        groups.reset_mesh()
+        groups.initialize_mesh(**kw)
+    mesh = groups.get_mesh_state().mesh
+    if mesh.shape.get(axis, 1) < 2:
+        raise SystemExit(
+            f"axis {axis!r} has size {mesh.shape.get(axis, 1)} on mesh "
+            f"{dict(mesh.shape)} — nothing to benchmark (pass --mesh)")
+    rows = []
+    print_fn(f"# mesh={dict(mesh.shape)} axis={axis} dtype=fp32")
+    print_fn(f"{'op':<16}{'bytes':>12}{'latency_us':>14}"
+             f"{'algbw_Gbps':>12}{'busbw_Gbps':>12}")
+    for op in ops:
+        for p in range(minsize, maxsize + 1, 2):
+            size, lat, algbw, busbw = _bench_one(
+                op, axis, 1 << p, mesh, iters, warmup)
+            rows.append((op, size, lat, algbw, busbw))
+            print_fn(f"{op:<16}{size:>12}{lat * 1e6:>14.1f}"
+                     f"{algbw:>12.2f}{busbw:>12.2f}")
+    return rows
+
+
+def cli_main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ds_bench", description="collective micro-benchmarks over the "
+        "device mesh (reference bin/ds_bench)")
+    ap.add_argument("--op", choices=OPS, default=None,
+                    help="single op (default: all)")
+    ap.add_argument("--axis", default="dp")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh factorization, e.g. dp=4,tp=2")
+    ap.add_argument("--minsize", type=int, default=16,
+                    help="log2 of smallest message (default 16 = 64KiB)")
+    ap.add_argument("--maxsize", type=int, default=26,
+                    help="log2 of largest message (default 26 = 64MiB)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args(argv)
+    run(ops=(args.op, ) if args.op else OPS, axis=args.axis,
+        minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
+        iters=args.iters, warmup=args.warmup)
+
+
+if __name__ == "__main__":
+    cli_main()

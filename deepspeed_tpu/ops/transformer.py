@@ -155,7 +155,10 @@ class DeepSpeedTransformerLayer(nn.Module):
 
         ffn_cls = (nn.remat(_FFN, static_argnums=(2, ))
                    if cfg.gelu_checkpoint else _FFN)
-        ffn = ffn_cls(cfg, name="ffn")
+        ffn = ffn_cls(cfg)
+        # share the parent scope so the FFN's params stay at the layer's
+        # top level ("inter"/"output"), not nested under a submodule name
+        nn.share_scope(self, ffn)
 
         if cfg.pre_layer_norm:
             x = x + attn_block(ln("attn_ln")(x))

@@ -895,9 +895,10 @@ class DeepSpeedEngine:
             # forward needs ONLY the params back; master/opt_state stay on
             # host until step()/checkpointing asks (the point of offloading
             # optimizer state is running generation forwards without it)
-            host, shardings = offloaded.pop("params")
+            host, shardings = offloaded["params"]
             self.params = jax.tree_util.tree_map(jax.device_put, host,
                                                  shardings)
+            del offloaded["params"]  # only after the puts succeeded
         if self.params is None:
             raise RuntimeError(
                 "engine has no parameters — pass model_parameters to "

@@ -58,9 +58,11 @@ def _resident(engine, *attrs):
     if off:
         for attr in attrs:
             if attr in off:
-                host, sh = off.pop(attr)
+                host, sh = off[attr]
                 setattr(engine, attr, jax.tree_util.tree_map(
                     jax.device_put, host, sh))
+                del off[attr]  # only after the puts succeeded — a failed
+                # restore must not drop the sole (host) copy of the state
     if ({"master", "opt_state"} & set(attrs)
             and getattr(engine, "_state_on_nvme", False)):
         engine._ensure_state_resident()

@@ -94,12 +94,21 @@ def test_autotuner_end_to_end(tmp_path):
 
 
 def test_mesh_tuning_space_and_trial():
-    """tune_mesh explores mesh factorizations; the best trial still wins."""
+    """tune_mesh explores mesh factorizations; trials on a flax model run
+    (born-sharded init per candidate mesh) and a best config wins."""
     import numpy as np
+    import jax.numpy as jnp
+    import flax.linen as nn
     from deepspeed_tpu.autotuning.autotuner import Autotuner
-    from tests.unit.simple_model import make_simple_mlp_params, simple_mlp_apply
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
 
-    params = make_simple_mlp_params(16)
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            h = nn.tanh(nn.Dense(32, name="fc1")(x))
+            return jnp.mean((nn.Dense(16, name="fc2")(h) - y) ** 2)
+
     rng = np.random.default_rng(0)
 
     def batch_fn(gbs):
@@ -107,17 +116,22 @@ def test_mesh_tuning_space_and_trial():
         return (x, 0.5 * x)
 
     tuner = Autotuner(
-        simple_mlp_apply, base_config={
+        TinyMLP(), base_config={
             "optimizer": {"type": "adam", "params": {"lr": 0.01}},
             "gradient_accumulation_steps": 1,
             "autotuning": {"enabled": True, "fast": True,
                            "tune_mesh": True, "zero_stages": [1],
+                           "mesh_candidates": [{"dp": -1},
+                                               {"dp": -1, "sp": 2}],
                            "num_tuning_micro_batch_sizes": 1,
                            "max_train_micro_batch_size_per_gpu": 2,
                            "min_train_micro_batch_size_per_gpu": 2}},
-        model_parameters=params, batch_fn=batch_fn, steps_per_trial=3)
+        batch_fn=batch_fn, steps_per_trial=2)
     space = tuner.build_tuning_space()
     names = [e["name"] for e in space]
-    assert any("tp2" in n for n in names), names
     assert any("sp2" in n for n in names), names
-    assert any("ds_config" in e and e["ds_config"].get("mesh") for e in space)
+    best = tuner.tune()
+    assert best is not None
+    assert all(r["result"] is not None for r in tuner.results), tuner.results
+    groups.reset_mesh()
+    dist.destroy_process_group()

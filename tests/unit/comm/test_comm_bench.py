@@ -1,0 +1,30 @@
+"""ds_bench collective sweep (reference bin/ds_bench surface)."""
+
+import pytest
+
+from deepspeed_tpu.benchmarks.comm_bench import run
+
+
+def test_sweep_all_ops():
+    rows = run(axis="dp", minsize=12, maxsize=12, iters=2, warmup=1,
+               print_fn=lambda *a: None)
+    assert len(rows) == 5  # one size, all five ops
+    for op, size, lat, algbw, busbw in rows:
+        assert size >= 4096 and lat > 0 and algbw > 0 and busbw > 0
+
+
+def test_explicit_mesh_axis():
+    rows = run(ops=("all_to_all", ), axis="tp", mesh_spec="dp=2,tp=4",
+               minsize=12, maxsize=12, iters=2, warmup=1,
+               print_fn=lambda *a: None)
+    assert rows and rows[0][0] == "all_to_all"
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+
+
+def test_degenerate_axis_rejected():
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    with pytest.raises(SystemExit, match="nothing to benchmark"):
+        run(axis="pp", minsize=12, maxsize=12, print_fn=lambda *a: None)
+    groups.reset_mesh()
