@@ -93,7 +93,7 @@ def test_autotuner_end_to_end(tmp_path):
          params["layer_1"]["w"], params["layer_1"]["b"]])
 
 
-def test_mesh_tuning_space_and_trial():
+def test_mesh_tuning_space_and_trial(tmp_path):
     """tune_mesh explores mesh factorizations; trials on a flax model run
     (born-sharded init per candidate mesh) and a best config wins."""
     import numpy as np
@@ -121,6 +121,8 @@ def test_mesh_tuning_space_and_trial():
             "gradient_accumulation_steps": 1,
             "autotuning": {"enabled": True, "fast": True,
                            "tune_mesh": True, "zero_stages": [1],
+                           "results_dir": str(tmp_path / "results"),
+                           "exps_dir": str(tmp_path / "exps"),
                            "mesh_candidates": [{"dp": -1},
                                                {"dp": -1, "sp": 2}],
                            "num_tuning_micro_batch_sizes": 1,
