@@ -100,14 +100,59 @@ class InferenceEngineV2:
             self.state_manager.flush_sequence(uid)
 
     # -------------------------------------------------------------- schedule
+    def _atom_layout(self):
+        """Static (decode_cap, atom) region split used on prefill-heavy
+        steps: [0, decode_cap) single decode tokens (per-token paged
+        kernel), [decode_cap, T) prefill runs aligned to ``atom`` tiles
+        (atom-tiled kernel — the reference atom_builder analog).  Only two
+        layouts ever compile: this one and the flat (0, 0) legacy."""
+        sm = self._config.state_manager
+        atom = sm.prefill_atom_size
+        if not atom:
+            return (0, 0)
+        decode_cap = min(sm.max_ragged_sequence_count, self._budget // 2)
+        if self._budget - decode_cap < atom:
+            return (0, 0)  # no room for a prefill region
+        # the prefill region must be a whole number of atom tiles — grow
+        # the decode region to absorb the remainder
+        decode_cap = self._budget - (self._budget - decode_cap) // atom * atom
+        return (decode_cap, atom)
+
+    def _pick_layout(self):
+        """Per-step layout choice: atom regions only when prefill dominates
+        (a decode-heavy step keeps the flat layout — zero regression)."""
+        decode_cap, atom = self._atom_layout()
+        if not atom:
+            return (0, 0)
+        n_decode = n_prefill = 0
+        for seq in self.state_manager.tracked_sequences.values():
+            if seq.done:
+                continue
+            p = len(seq.pending())
+            if p == 1:
+                n_decode += 1
+            elif p > 1:
+                n_prefill += p
+        if n_prefill >= max(atom, n_decode):
+            return (decode_cap, atom)
+        return (0, 0)
+
     def _build_batch(self):
         """Pack the token budget: decode tokens first (latency), then
-        prefill chunks (throughput) — the reference scheduler's policy."""
+        prefill chunks (throughput) — the reference scheduler's policy.
+        With an atom layout, decode tokens fill the decode region and
+        prefill runs are atom-aligned in the prefill region."""
         T = self._budget
         sm = self.state_manager
-        toks, pos, slots = [], [], []
+        decode_cap, atom = layout = self._pick_layout()
+        toks = np.zeros(T, np.int32)
+        pos = np.zeros(T, np.int32)
+        slots = np.zeros(T, np.int32)  # slot 0 → garbage block
         finishing = []  # (seq, buffer index of its last scheduled token)
-        # decode tokens (1 pending) first — latency priority over prefill
+        placed = 0
+
+        d_cur = 0                      # decode-region cursor
+        p_cur = decode_cap             # prefill-region cursor (atom-aligned)
         order = sorted(sm.tracked_sequences.values(),
                        key=lambda s: len(s.pending()))
         for seq in order:
@@ -116,30 +161,43 @@ class InferenceEngineV2:
             pending = seq.pending()
             if not pending:
                 continue
-            room = T - len(toks)
-            if room <= 0:
-                break
+            if atom:
+                if len(pending) == 1 and d_cur < decode_cap:
+                    start, room = d_cur, 1
+                else:
+                    start = p_cur
+                    room = T - p_cur
+                if room <= 0:
+                    continue
+            else:
+                start = d_cur
+                room = T - d_cur
+                if room <= 0:
+                    break
             take = min(len(pending), room)
             sm.ensure_capacity(seq, seq.seen_tokens + take)
-            for i in range(take):
-                toks.append(pending[i])
-                pos.append(seq.seen_tokens + i)
-                slots.append(seq.slot)
+            toks[start:start + take] = pending[:take]
+            pos[start:start + take] = np.arange(
+                seq.seen_tokens, seq.seen_tokens + take)
+            slots[start:start + take] = seq.slot
             if take == len(pending):
-                finishing.append((seq, len(toks) - 1))
+                finishing.append((seq, start + take - 1))
             seq.seen_tokens += take
-        n = len(toks)
-        if n == 0:
+            placed += take
+            if atom:
+                if start < decode_cap:   # landed in the decode region
+                    d_cur += 1
+                else:
+                    # advance to the next atom boundary (intra-atom pads)
+                    p_cur = start + (-(-take // atom)) * atom
+            else:
+                d_cur += take
+        if placed == 0:
             return None
-        pad = T - n
-        toks += [0] * pad
-        pos += [0] * pad
-        slots += [0] * pad  # slot 0 → garbage block
         last_idx = np.zeros(sm.max_seqs, dtype=np.int32)
         for seq, idx in finishing:
             last_idx[seq.slot] = idx
-        return (np.asarray(toks, np.int32), np.asarray(pos, np.int32),
-                np.asarray(slots, np.int32), last_idx, finishing)
+        return toks, pos, slots, last_idx, finishing, layout
 
     def schedule_step(self, do_sample=False, temperature=1.0, rng=None):
         """One ragged iteration.  Returns {uid: sampled_next_token} for every
@@ -161,13 +219,13 @@ class InferenceEngineV2:
         batch = self._build_batch()
         if batch is None:
             return {}
-        toks, pos, slots, last_idx, finishing = batch
+        toks, pos, slots, last_idx, finishing, layout = batch
         logits, self._kv = self._step_fn(
             self.params, self._kv, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(slots),
             jnp.asarray(self.state_manager.block_table),
             jnp.asarray(last_idx), cfg=self.model_config,
-            block_size=self.kv_cache.block_size)
+            block_size=self.kv_cache.block_size, layout=layout)
         out = {}
         if finishing:
             lg = np.asarray(logits)
