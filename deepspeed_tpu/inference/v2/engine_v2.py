@@ -128,7 +128,8 @@ class InferenceEngineV2:
         for seq in self.state_manager.tracked_sequences.values():
             if seq.done:
                 continue
-            p = len(seq.pending())
+            # O(1) pending count — pending() slices the full token list
+            p = len(seq.tokens) - seq.seen_tokens
             if p == 1:
                 n_decode += 1
             elif p > 1:

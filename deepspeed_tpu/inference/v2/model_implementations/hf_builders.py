@@ -21,7 +21,7 @@ from ....models.phi import PhiConfig, PhiModel
 from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
-                         "falcon", "opt", "phi", "qwen2_moe")
+                         "falcon", "opt", "phi", "qwen2_moe", "qwen")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -416,6 +416,88 @@ def _split_phi3_fused(params_iter, cfg: LlamaConfig):
             yield name, arr
 
 
+def _qwen_config_from_hf(cfg: dict, dtype: str) -> LlamaConfig:
+    """Qwen v1 (reference ``model_implementations/qwen/``): the llama
+    architecture with a fused biased ``c_attn``, no GQA, and a split MLP
+    whose config ``intermediate_size`` counts BOTH halves (w1/w2 are each
+    half that width)."""
+    if _rope_scaling_type(cfg) not in ("none", "default"):
+        raise ValueError("rope_scaling is not supported for qwen v1")
+    if cfg.get("use_dynamic_ntk") or cfg.get("use_logn_attn"):
+        # official Qwen-7B/14B enable these for long contexts; serving
+        # without them silently degrades past seq_length — refuse instead
+        raise ValueError(
+            "qwen v1 with use_dynamic_ntk/use_logn_attn is not supported "
+            "(disable both in config.json to serve within seq_length)")
+    if not cfg.get("no_bias", True):
+        raise ValueError("qwen v1 with no_bias=false (biased mlp/output "
+                         "projections) is not supported")
+    return LlamaConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"] // 2,
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        num_key_value_heads=cfg["num_attention_heads"],
+        max_position_embeddings=cfg.get("seq_length", 2048),
+        rms_norm_eps=cfg.get("layer_norm_epsilon", 1e-6),
+        rope_theta=cfg.get("rotary_emb_base", 10000.0),
+        attention_bias=True,       # only c_attn carries a bias (no_bias
+        tie_word_embeddings=False,  # covers every other linear)
+        dtype=dtype, remat=False)
+
+
+def _ingest_qwen(cfg: LlamaConfig,
+                 params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """Rename/split the Qwen v1 layout into llama names and defer to
+    :func:`_ingest_llama`: ``c_attn`` [3D, D] splits to q/k/v (with bias),
+    ``mlp.w2`` is the gate (silu side), ``mlp.w1`` the up projection."""
+    D = cfg.hidden_size
+
+    def gen():
+        for name, arr in params_iter:
+            if name.endswith(_SKIP_SUFFIXES) or ".rotary_emb." in name:
+                continue
+            name = name.removeprefix("transformer.")
+            if name == "wte.weight":
+                yield "model.embed_tokens.weight", arr
+            elif name == "ln_f.weight":
+                yield "model.norm.weight", arr
+            elif name == "lm_head.weight":
+                yield "lm_head.weight", arr
+            elif name.startswith("h."):
+                _, idx, rest = name.split(".", 2)
+                base = f"model.layers.{idx}"
+                if rest == "ln_1.weight":
+                    yield f"{base}.input_layernorm.weight", arr
+                elif rest == "ln_2.weight":
+                    yield f"{base}.post_attention_layernorm.weight", arr
+                elif rest.startswith("attn.c_attn."):
+                    kind = rest.rsplit(".", 1)[1]
+                    for proj, part in zip(("q_proj", "k_proj", "v_proj"),
+                                          np.split(arr, 3, axis=0)):
+                        yield f"{base}.self_attn.{proj}.{kind}", part
+                elif rest.startswith(("attn.c_proj.", "mlp.w1.", "mlp.w2.",
+                                      "mlp.c_proj.")):
+                    src, kind = rest.rsplit(".", 1)
+                    if kind != "weight":
+                        # config guard rejects no_bias=false; any stray
+                        # bias here must not masquerade as a kernel
+                        logger.warning(f"HF qwen ingest: skipping {name}")
+                        continue
+                    target = {"attn.c_proj": "self_attn.o_proj",
+                              "mlp.w2": "mlp.gate_proj",  # silu side
+                              "mlp.w1": "mlp.up_proj",
+                              "mlp.c_proj": "mlp.down_proj"}[src]
+                    yield f"{base}.{target}.weight", arr
+                else:
+                    logger.warning(f"HF qwen ingest: skipping {name}")
+            else:
+                logger.warning(f"HF qwen ingest: skipping {name}")
+
+    return _ingest_llama(cfg, gen())
+
+
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
     _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
@@ -564,6 +646,10 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _phi_config_from_hf(hf_cfg, dtype)
         params = _ingest_phi(cfg, checkpoint_engine.parameters())
         model = PhiModel(cfg)
+    elif model_type == "qwen":
+        cfg = _qwen_config_from_hf(hf_cfg, dtype)
+        params = _ingest_qwen(cfg, checkpoint_engine.parameters())
+        model = LlamaModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -64,6 +64,13 @@ def _phi_factory(hf_cfg, dtype="bfloat16"):
     return PhiModel(_phi_config_from_hf(hf_cfg, dtype))
 
 
+def _qwen_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _qwen_config_from_hf)
+    from ..models.llama import LlamaModel
+    return LlamaModel(_qwen_config_from_hf(hf_cfg, dtype))
+
+
 def _qwen2_moe_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _qwen2_moe_config_from_hf)
@@ -78,6 +85,7 @@ POLICIES = {
     "llama": InjectionPolicy("llama", _llama_factory),
     "llama2": InjectionPolicy("llama", _llama_factory),
     "mistral": InjectionPolicy("mistral", _llama_factory),
+    "qwen": InjectionPolicy("qwen", _qwen_factory),
     "qwen2": InjectionPolicy("qwen2", _llama_factory),
     "phi3": InjectionPolicy("phi3", _llama_factory),
     "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
@@ -97,8 +105,10 @@ def policy_for(arch_or_model) -> Optional[InjectionPolicy]:
         key = arch_or_model.get("model_type", "").lower()
     else:
         key = type(arch_or_model).__name__.lower()
-        for name in POLICIES:
-            if name in key:
+        # longest-match first and underscore-insensitive: a Qwen2Moe class
+        # name must hit "qwen2_moe", not "qwen2" (nor "qwen")
+        for name in sorted(POLICIES, key=len, reverse=True):
+            if name.replace("_", "") in key.replace("_", ""):
                 key = name
                 break
     return POLICIES.get(key)

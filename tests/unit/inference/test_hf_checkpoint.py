@@ -254,3 +254,80 @@ def test_hf_phi_tied_embeddings(tmp_path):
     eng.put([0], [ids[0].tolist()])
     out = eng.schedule_step()
     assert out[0] == int(np.argmax(theirs[0, -1]))
+
+
+def test_hf_qwen_v1_roundtrip(tmp_path):
+    """Qwen v1 (fused biased c_attn, split w1/w2 MLP — no transformers
+    class exists, so the checkpoint is handcrafted): ingest must reproduce
+    the exact llama param tree it was exported from, and serve greedily."""
+    import jax
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+    import json as _json
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype="float32", remat=False,
+                           num_key_value_heads=4, attention_bias=True)
+    model = llama.LlamaModel(cfg)
+    params = jax.tree_util.tree_map(
+        np.asarray,
+        model.init(jax.random.PRNGKey(5),
+                   jnp.zeros((1, 8), jnp.int32))["params"])
+    D, H, Dh, I = (cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim,
+                   cfg.intermediate_size)
+
+    flat = {}
+    flat["transformer.wte.weight"] = params["embed_tokens"]["embedding"]
+    flat["transformer.ln_f.weight"] = params["norm"]["weight"]
+    flat["lm_head.weight"] = np.ascontiguousarray(
+        params["lm_head"]["kernel"].T)
+    for i in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{i}"]
+        base = f"transformer.h.{i}"
+        sa = lp["self_attn"]
+        w = np.concatenate([
+            np.ascontiguousarray(sa[p]["kernel"].reshape(D, H * Dh).T)
+            for p in ("q_proj", "k_proj", "v_proj")], axis=0)
+        b = np.concatenate([sa[p]["bias"].reshape(H * Dh)
+                            for p in ("q_proj", "k_proj", "v_proj")])
+        flat[f"{base}.attn.c_attn.weight"] = w
+        flat[f"{base}.attn.c_attn.bias"] = b
+        flat[f"{base}.attn.c_proj.weight"] = np.ascontiguousarray(
+            sa["o_proj"]["kernel"].T)
+        flat[f"{base}.ln_1.weight"] = lp["input_layernorm"]["weight"]
+        flat[f"{base}.ln_2.weight"] = lp["post_attention_layernorm"]["weight"]
+        flat[f"{base}.mlp.w2.weight"] = np.ascontiguousarray(
+            lp["mlp"]["gate_proj"]["kernel"].T)
+        flat[f"{base}.mlp.w1.weight"] = np.ascontiguousarray(
+            lp["mlp"]["up_proj"]["kernel"].T)
+        flat[f"{base}.mlp.c_proj.weight"] = np.ascontiguousarray(
+            lp["mlp"]["down_proj"]["kernel"].T)
+
+    d = tmp_path / "qwen"
+    d.mkdir()
+    save_file({k: np.ascontiguousarray(v.astype(np.float32))
+               for k, v in flat.items()}, str(d / "model.safetensors"))
+    (d / "config.json").write_text(_json.dumps({
+        "model_type": "qwen", "vocab_size": cfg.vocab_size,
+        "hidden_size": D, "intermediate_size": 2 * I,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": H, "seq_length": 128,
+        "layer_norm_epsilon": cfg.rms_norm_eps,
+        "rotary_emb_base": cfg.rope_theta, "no_bias": True}))
+
+    engine = HuggingFaceCheckpointEngine(str(d))
+    model2, params2 = build_model_and_params(engine, dtype="float32")
+    assert model2.config.intermediate_size == I
+    assert model2.config.attention_bias
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                            size=(1, 20)).astype(np.int32)
+    ours = np.asarray(model2.apply({"params": params2}, ids))
+    ref = np.asarray(model.apply({"params": params}, ids))
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+    # and the ragged engine serves it
+    eng = build_hf_engine(str(d), engine_config=dict(ENGINE_CFG))
+    out = eng.generate([ids[0, :9].tolist()], max_new_tokens=4)
+    full = np.asarray(model.apply({"params": params}, ids[:, :9]))
+    assert out[0][0] == int(np.argmax(full[0, -1]))
