@@ -1,6 +1,7 @@
 """PipelineModule — analog of reference ``runtime/pipe/module.py``
 (LayerSpec ``:30``, TiedLayerSpec ``:77``, PipelineModule ``:86``,
-``_partition_layers`` ``:391`` with methods uniform|parameters|type:regex).
+``_partition_layers`` ``:391`` with methods
+uniform|parameters|profile|type:regex).
 
 TPU-native layer contract: each layer is either
   * a flax ``nn.Module`` (init/apply), or
@@ -101,15 +102,60 @@ class PipelineModule:
             counts.append(max(1, n))
         return counts
 
-    def partition_layers(self, num_stages, method=None):
+    def _profile_layer_latencies(self, example_input, iters=3):
+        """Per-layer forward latency (for method="profile", reference
+        ``module.py:391`` 'profile'): build, init, and time each layer on
+        the example input, chaining each layer's output into the next so
+        shapes evolve as they would in the real stack.  A layer that can't
+        be timed poisons every downstream shape, so the whole profile falls
+        back to parameter-count weights rather than returning skewed data.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ...profiling.flops_profiler.profiler import FlopsProfiler
+        prof = FlopsProfiler()
+        x = jnp.asarray(example_input)
+        lats = []
+        for spec in self.specs:
+            layer = spec.build()
+            try:
+                if hasattr(layer, "init"):
+                    variables = layer.init(jax.random.PRNGKey(0), x)
+                    fn, args = (lambda v, t, l=layer: l.apply(v, t)), \
+                        (variables, x)
+                else:
+                    fn, args = (lambda t, l=layer: l(t)), (x, )
+                lats.append(max(prof.measure_latency(fn, *args, iters=iters),
+                                1e-7))
+                x = fn(*args)
+            except Exception as e:
+                logger.warning(
+                    f"profile partition: layer {spec.name} not timeable "
+                    f"({type(e).__name__}: {e}); downstream shapes unknown "
+                    "— falling back to parameter-count weights")
+                return self._count_layer_params()
+        # partition_balanced binary-searches integer limits — scale
+        # latencies to integers (~3 significant digits)
+        lo = min(lats)
+        return [max(1, round(v / lo * 100)) for v in lats]
+
+    def partition_layers(self, num_stages, method=None, example_input=None):
         """Reference ``_partition_layers`` ``:391``: returns stage boundary
-        list ``parts`` of len num_stages+1."""
+        list ``parts`` of len num_stages+1.  ``method="profile"`` requires
+        ``example_input`` (a sample layer-0 input) to time the layers."""
         method = (method or self.partition_method).lower()
         num_layers = len(self.specs)
         if method == "uniform":
             self.parts = partition_uniform(num_layers, num_stages)
         elif method == "parameters":
             weights = self._count_layer_params()
+            self.parts = partition_balanced(weights, num_stages)
+        elif method == "profile":
+            if example_input is None:
+                raise ValueError(
+                    "partition_method='profile' needs example_input= "
+                    "(a sample input for the first layer)")
+            weights = self._profile_layer_latencies(example_input)
             self.parts = partition_balanced(weights, num_stages)
         elif method.startswith("type:"):
             pattern = method.split(":", 1)[1]

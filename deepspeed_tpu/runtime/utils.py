@@ -41,7 +41,8 @@ def partition_uniform(num_items, num_parts):
 
 def partition_balanced(weights, num_parts):
     """Reference ``partition_balanced``: split so max part weight is minimized
-    (prefix-sum + binary search)."""
+    (prefix-sum + binary search).  Weights should be positive integers (the
+    limit search is integral) — scale float weights up first."""
     n = len(weights)
     prefix = np.concatenate([[0], np.cumsum(weights)])
 

@@ -152,3 +152,32 @@ def test_partition_methods():
     parts = m.partition_layers(4, method="uniform")
     assert parts == [0, 2, 4, 6, 8]
     assert len(m.stage_layers(0)) == 2
+
+
+def test_profile_partitioning():
+    """method='profile' balances stages by measured layer latency."""
+    import jax.numpy as jnp
+    import flax.linen as nn
+    import pytest
+    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    class Small(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(x)
+
+    class Big(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(6):
+                x = nn.Dense(256, name=f"d{i}")(x)
+            return nn.Dense(8, name="out")(x)
+
+    specs = [LayerSpec(Small) for _ in range(3)] + [LayerSpec(Big)]
+    mod = PipelineModule(layers=specs, partition_method="profile")
+    with pytest.raises(ValueError, match="example_input"):
+        mod.partition_layers(2)
+    parts = mod.partition_layers(2, example_input=jnp.ones((2, 8)))
+    assert parts[0] == 0 and parts[-1] == 4
+    # the heavy last layer should sit alone in the second stage
+    assert parts[1] == 3, parts
