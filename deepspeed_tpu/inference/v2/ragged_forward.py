@@ -54,9 +54,8 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
     for the rest.  Fallback: XLA gather of each token's block run with
     position masking."""
     import os
-    if (window == 0
-            and (jax.default_backend() == "tpu"
-                 or os.environ.get("DS_TPU_TEST_PAGED_INTERPRET"))
+    if ((jax.default_backend() == "tpu"
+         or os.environ.get("DS_TPU_TEST_PAGED_INTERPRET"))
             and not os.environ.get("DS_TPU_DISABLE_PALLAS_PAGED")):
         from ...ops.pallas.paged_attention import (paged_attention,
                                                    paged_attention_atoms)
@@ -64,13 +63,14 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
         if atom and q.shape[0] > decode_cap:
             out_d = paged_attention(q[:decode_cap], k_cache, v_cache,
                                     tables_t[:decode_cap],
-                                    positions[:decode_cap]) \
+                                    positions[:decode_cap], window=window) \
                 if decode_cap else q[:0]
             out_p = paged_attention_atoms(
                 q[decode_cap:], k_cache, v_cache, tables_t[decode_cap:],
-                positions[decode_cap:], atom)
+                positions[decode_cap:], atom, window=window)
             return jnp.concatenate([out_d, out_p], axis=0)
-        return paged_attention(q, k_cache, v_cache, tables_t, positions)
+        return paged_attention(q, k_cache, v_cache, tables_t, positions,
+                               window=window)
     T, H, Dh = q.shape
     Hkv = k_cache.shape[2]
     maxb = tables_t.shape[1]

@@ -27,18 +27,20 @@ from ._common import interpret_mode as _interpret
 
 
 def paged_attention(q, k_cache, v_cache, tables_t, positions,
-                    block_size=None):
+                    block_size=None, window=0):
     """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh];
     tables_t: [T, maxb] int32; positions: [T] int32 → [T, H, Dh].
 
     One token per grid row — exactly the atom-tiled kernel with atom=1
     (one shared online-softmax implementation; see _atom_kernel)."""
-    return paged_attention_atoms(q, k_cache, v_cache, tables_t, positions, 1)
+    return paged_attention_atoms(q, k_cache, v_cache, tables_t,
+                                 positions, 1, window=window)
 
 
 # ------------------------------------------------------- atom (prefill) path
 def _atom_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
-                 m_ref, l_ref, *, block_size, scale, groups, atom):
+                 m_ref, l_ref, *, block_size, scale, groups, atom,
+                 window):
     """Like :func:`_kernel` but one grid row covers ``atom`` consecutive
     buffer tokens OF THE SAME SEQUENCE (the batch builder guarantees the
     alignment; intra-atom pad rows produce discarded outputs).  The q tile
@@ -61,8 +63,14 @@ def _atom_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
     pos_tile = jnp.asarray([pos_ref[i * atom + r] for r in range(atom)],
                            dtype=jnp.int32)            # [atom]
     max_pos = jnp.max(pos_tile)
+    live = k_start <= max_pos
+    if window:
+        # blocks entirely older than the oldest row's window are dead;
+        # pad rows carry pos 0, which only loosens the bound (correct)
+        live = jnp.logical_and(
+            live, k_start + block_size - 1 > jnp.min(pos_tile) - window)
 
-    @pl.when(k_start <= max_pos)
+    @pl.when(live)
     def _compute():
         q = q_ref[0].astype(jnp.float32)               # [atom, H, Dh]
         k = k_ref[0].astype(jnp.float32)               # [bs, Hkv, Dh]
@@ -77,7 +85,10 @@ def _atom_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
         col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         pos_rows = jnp.broadcast_to(pos_tile[:, None],
                                     (A, groups)).reshape(1, A * groups, 1)
-        s = jnp.where(col <= pos_rows, s, _NEG_INF)
+        mask = col <= pos_rows
+        if window:  # sliding window: only the last `window` positions
+            mask = jnp.logical_and(mask, col > pos_rows - window)
+        s = jnp.where(mask, s, _NEG_INF)
 
         M = Hkv * A * groups
         s_f = s.reshape(M, bs)
@@ -107,7 +118,7 @@ def _atom_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
 
 
 def paged_attention_atoms(q, k_cache, v_cache, tables_t, positions,
-                          atom, block_size=None):
+                          atom, block_size=None, window=0):
     """Atom-tiled variant for prefill regions: q rows [T, H, Dh] where every
     aligned run of ``atom`` rows shares one sequence (pads allowed).  Page
     streaming uses the FIRST row's block table; per-row position masking
@@ -141,7 +152,7 @@ def paged_attention_atoms(q, k_cache, v_cache, tables_t, positions,
     )
     return pl.pallas_call(
         functools.partial(_atom_kernel, block_size=bs, scale=scale,
-                          groups=groups, atom=atom),
+                          groups=groups, atom=atom, window=int(window)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_atoms, atom, H, Dh), q.dtype),
         compiler_params=pltpu.CompilerParams(

@@ -127,7 +127,7 @@ class PipelineModule:
                     fn, args = (lambda t, l=layer: l(t)), (x, )
                 lats.append(max(prof.measure_latency(fn, *args, iters=iters),
                                 1e-7))
-                x = fn(*args)
+                x = jax.jit(fn)(*args)  # jit-cache hit, not an eager re-run
             except Exception as e:
                 logger.warning(
                     f"profile partition: layer {spec.name} not timeable "

@@ -130,3 +130,27 @@ def test_atom_kernel_matches_per_token():
     real[6:8] = False  # intra-atom pads
     np.testing.assert_allclose(np.asarray(out_atom)[real],
                                np.asarray(out_tok)[real], atol=1e-5)
+
+
+@pytest.mark.parametrize("atom", [1, 4])
+def test_paged_kernel_sliding_window(atom):
+    """Windowed paged attention (Mistral serving) matches the XLA gather
+    fallback, per-token and atom-tiled."""
+    from deepspeed_tpu.inference.v2.ragged_forward import _paged_attention
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_attention_atoms)
+    bs, Hkv, H, Dh, nb = 8, 2, 4, 16, 12
+    rng = np.random.default_rng(7)
+    kc = jnp.asarray(rng.standard_normal((nb, bs, Hkv, Dh)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, bs, Hkv, Dh)), jnp.float32)
+    T, W = 8, 11
+    q = jnp.asarray(rng.standard_normal((T, H, Dh)), jnp.float32)
+    tables = np.zeros((T, 6), np.int32)
+    tables[:] = [1, 2, 3, 4, 5, 0]          # one sequence, positions 28..35
+    pos = np.arange(28, 36).astype(np.int32)
+    out_k = paged_attention_atoms(q, kc, vc, jnp.asarray(tables),
+                                  jnp.asarray(pos), atom, window=W)
+    ref = _paged_attention(q, kc, vc, jnp.asarray(tables),
+                           jnp.asarray(pos), block_size=bs, window=W)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
