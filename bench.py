@@ -56,6 +56,21 @@ def _enable_compile_cache():
         pass
 
 
+def _tpu_peak_flops() -> float:
+    """Per-chip bf16 peak by device kind (MFU denominator)."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    # "lite" variants BEFORE the bare generation match: a real v5e reports
+    # device_kind "TPU v5 lite", which must not hit the v5p entry
+    for key, peak in (("v5 lite", 197e12), ("v5litepod", 197e12),
+                      ("v5e", 197e12), ("v6 lite", 918e12),
+                      ("v6e", 918e12), ("v5p", 459e12), ("v5", 459e12),
+                      ("v4", 275e12)):
+        if key in kind:
+            return peak
+    return 197e12  # default: v5e
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import deepspeed_tpu
@@ -71,7 +86,7 @@ def run_bench(on_tpu: bool) -> dict:
     if on_tpu:
         attempts = [(4, False, "none"), (8, True, "nothing_saveable")]
         S, steps, warmup = 2048, 10, 2
-        peak_flops = 197e12  # v5e bf16 peak per chip
+        peak_flops = _tpu_peak_flops()
     else:  # CPU smoke mode (sanity only)
         attempts = [(4, False, "none")]
         S, steps, warmup = 64, 3, 1

@@ -49,6 +49,37 @@ class InferenceEngineV2:
             raise ValueError("InferenceEngineV2 needs params")
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
+        # ---- tensor parallelism (reference inference_transformer_base
+        # sharding + config tensor_parallel.tp_size): params shard via the
+        # AutoTP rules, the KV cache shards over kv heads, and GSPMD
+        # partitions the jitted step.  The Pallas kernels are single-device
+        # programs, so tp>1 routes attention through the partitionable XLA
+        # path (per-kv-head parallel).
+        tp = int(getattr(config.tensor_parallel, "tp_size", 1) or 1)
+        self._tp = tp
+        self._tp_mesh = None
+        if tp > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            devs = jax.devices()
+            if len(devs) % tp or cfg.num_key_value_heads % tp:
+                raise ValueError(
+                    f"tp_size={tp} must divide both the device count "
+                    f"({len(devs)}) and num_key_value_heads "
+                    f"({cfg.num_key_value_heads})")
+            self._tp_mesh = Mesh(np.array(devs[:tp]), ("tp", ))
+            from ...module_inject import shard_params_for_tp
+            rules = None
+            import sys as _sys
+            mod = _sys.modules.get(type(model).__module__)
+            if hasattr(mod, "tp_rules"):
+                rules = mod.tp_rules(cfg)
+            self.params = shard_params_for_tp(self.params, self._tp_mesh,
+                                              rules=rules)
+            self._kv_sharding = NamedSharding(
+                self._tp_mesh, P(None, None, None, None, "tp", None))
+        else:
+            self._kv_sharding = None
+
         sm = config.state_manager
         block_size = sm.block_size
         max_blocks_per_seq = -(-sm.max_context // block_size)
@@ -66,6 +97,11 @@ class InferenceEngineV2:
         self.state_manager = DSStateManager(sm, self.kv_cache)
         self._budget = int(sm.max_ragged_batch_size)
         self._kv = self.kv_cache.data
+        if self._kv_sharding is not None:
+            self._kv = jax.device_put(self._kv, self._kv_sharding)
+            # drop the replicated original — a full unsharded cache pinned
+            # to device 0 would defeat the point of sharding it
+            self.kv_cache.data = self._kv
         logger.info(
             f"InferenceEngineV2: budget={self._budget} blocks={num_blocks}"
             f"×{block_size} max_seqs={self.state_manager.max_seqs}")
@@ -226,7 +262,8 @@ class InferenceEngineV2:
             jnp.asarray(slots),
             jnp.asarray(self.state_manager.block_table),
             jnp.asarray(last_idx), cfg=self.model_config,
-            block_size=self.kv_cache.block_size, layout=layout)
+            block_size=self.kv_cache.block_size, layout=layout,
+            use_kernel=self._tp == 1)
         out = {}
         if finishing:
             lg = np.asarray(logits)

@@ -41,7 +41,7 @@ def _rmsnorm(x, w, eps):
 
 
 def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
-                     window=0, layout=(0, 0)):
+                     window=0, layout=(0, 0), use_kernel=True):
     """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh]; tables_t: [T, maxb];
     positions: [T]; window: sliding-window size (0 → full causal).
     Returns [T, H, Dh].
@@ -54,8 +54,9 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
     for the rest.  Fallback: XLA gather of each token's block run with
     position masking."""
     import os
-    if ((jax.default_backend() == "tpu"
-         or os.environ.get("DS_TPU_TEST_PAGED_INTERPRET"))
+    if (use_kernel
+            and (jax.default_backend() == "tpu"
+                 or os.environ.get("DS_TPU_TEST_PAGED_INTERPRET"))
             and not os.environ.get("DS_TPU_DISABLE_PALLAS_PAGED")):
         from ...ops.pallas.paged_attention import (paged_attention,
                                                    paged_attention_atoms)
@@ -133,7 +134,7 @@ def _head_logits(params, x, last_token_idx, embed_key="embed_tokens"):
 def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
                             positions, cos, sin, *, cfg, block_size,
                             rotary=True, rotary_dim=None,
-                            layout=(0, 0)):
+                            layout=(0, 0), use_kernel=True):
     """Shared attention sub-block: qkv → rotary → cache scatter → paged
     attention → output projection.  Returns (attn_out [T, D], new kv_layer).
     kv_layer: [2, num_blocks, bs, Hkv, Dh].  ``rotary_dim`` < head_dim →
@@ -157,7 +158,7 @@ def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
     out = _paged_attention(q, kv_layer[0], kv_layer[1], tables_t,
                            positions, block_size,
                            window=getattr(cfg, "sliding_window", 0),
-                           layout=layout)
+                           layout=layout, use_kernel=use_kernel)
     o = out.reshape(out.shape[0], H * Dh)
     o = jnp.einsum("tf,fd->td", o, lp_attn["o_proj"]["kernel"].astype(dtype))
     if "bias" in lp_attn["o_proj"]:
@@ -165,10 +166,10 @@ def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
     return o, kv_layer
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
                    donate_argnums=(1, ))
 def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                      block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0)):
+                      block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
     """One ragged engine iteration for the Llama family.
 
     Args:
@@ -205,7 +206,8 @@ def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         # rotary analog), then attend against the updated pages
         attn_out, kv_layer = _ragged_attention_block(
             lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
-            cos, sin, cfg=cfg, block_size=block_size, layout=layout)
+            cos, sin, cfg=cfg, block_size=block_size, layout=layout,
+            use_kernel=use_kernel)
         kv_data = kv_data.at[l].set(kv_layer)
         x = x + attn_out
         h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
@@ -227,10 +229,10 @@ def _lm_head(params, x, last_token_idx, cfg):
     return xl @ params["lm_head"]["kernel"].astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
                    donate_argnums=(1, ))
 def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                        block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0)):
+                        block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
     """One ragged engine iteration for Mixtral (reference
     ``inference/v2/model_implementations/mixtral/``): Llama attention skeleton
     with the MLP replaced by the exact top-k sparse MoE (``moe_apply`` —
@@ -255,7 +257,8 @@ def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         h = _rmsnorm(x, lp["input_layernorm"]["weight"], eps)
         attn_out, kv_layer = _ragged_attention_block(
             lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
-            cos, sin, cfg=cfg, block_size=block_size, layout=layout)
+            cos, sin, cfg=cfg, block_size=block_size, layout=layout,
+            use_kernel=use_kernel)
         kv_data = kv_data.at[l].set(kv_layer)
         x = x + attn_out
         h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
@@ -289,10 +292,10 @@ def _layernorm(x, p, eps):
             + p["bias"]).astype(x.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
                    donate_argnums=(1, ))
 def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                       block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0)):
+                       block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
     """One ragged engine iteration for Falcon (reference
     ``inference/v2/model_implementations/falcon/``): parallel-block layout —
     attention and the GELU MLP read the same layernormed input and add into
@@ -321,7 +324,8 @@ def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
                        "v_proj": lp["v_proj"], "o_proj": lp["dense"]}
         attn_out, kv_layer = _ragged_attention_block(
             attn_params, h_attn, kv_data[l], blk, off, tables_t, positions,
-            cos, sin, cfg=acfg, block_size=block_size, layout=layout)
+            cos, sin, cfg=acfg, block_size=block_size, layout=layout,
+            use_kernel=use_kernel)
         kv_data = kv_data.at[l].set(kv_layer)
         if not cfg.parallel_attn:
             x = x + attn_out
@@ -335,10 +339,10 @@ def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
                         embed_key="word_embeddings"), kv_data
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
                    donate_argnums=(1, ))
 def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0)):
+                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
     """One ragged engine iteration for OPT (reference
     ``inference/v2/model_implementations/opt/``): learned positions (+2
     offset), pre-LN blocks, ReLU MLP, no rotary."""
@@ -364,7 +368,7 @@ def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         attn_out, kv_layer = _ragged_attention_block(
             attn_params, h, kv_data[l], blk, off, tables_t, positions,
             None, None, cfg=acfg, block_size=block_size, rotary=False,
-            layout=layout)
+            layout=layout, use_kernel=use_kernel)
         kv_data = kv_data.at[l].set(kv_layer)
         x = x + attn_out
         if not cfg.do_layer_norm_before:
@@ -381,10 +385,10 @@ def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
     return _head_logits(params, x, last_token_idx), kv_data
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
                    donate_argnums=(1, ))
 def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0)):
+                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
     """One ragged engine iteration for Phi-2 (reference
     ``inference/v2/model_implementations/phi/``): parallel block, partial
     rotary, LayerNorm, biased linears (incl. lm_head)."""
@@ -409,7 +413,7 @@ def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         attn_out, kv_layer = _ragged_attention_block(
             attn_params, h, kv_data[l], blk, off, tables_t, positions,
             cos, sin, cfg=acfg, block_size=block_size, rotary_dim=rd,
-            layout=layout)
+            layout=layout, use_kernel=use_kernel)
         kv_data = kv_data.at[l].set(kv_layer)
         mlp = _lin(jax.nn.gelu(_lin(h, lp["fc1"], dtype)), lp["fc2"], dtype)
         x = x + attn_out + mlp
