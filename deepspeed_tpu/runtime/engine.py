@@ -809,6 +809,14 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called without a prior forward() "
                                "in training mode")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        offloaded = getattr(self, "_host_offloaded", None)
+        if offloaded and "grad_acc" in offloaded:
+            # grads offloaded mid-accumulation: restore BEFORE the None
+            # check or the prior micro-batches' gradients are silently lost
+            host, shardings = offloaded["grad_acc"]
+            self.grad_acc = jax.tree_util.tree_map(jax.device_put, host,
+                                                   shardings)
+            del offloaded["grad_acc"]
         if self.grad_acc is None:
             self.grad_acc = self._stashed_grads
         else:
@@ -830,10 +838,12 @@ class DeepSpeedEngine:
         self._check_params()
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
+            # restore offloaded state FIRST — grads may live on host via
+            # offload_states(include=["lp_grads"])
+            self._ensure_state_resident()
             if self.grad_acc is None:
                 raise RuntimeError("step() at a grad-accum boundary without "
                                    "any backward() since the last boundary")
-            self._ensure_state_resident()
             apply = self._get_compiled_apply()
             (self.params, self.master, self.opt_state,
              self.scale_state, overflow, gnorm) = apply(
@@ -918,15 +928,17 @@ class DeepSpeedEngine:
     # ------------------------------------------------- state offload on demand
     _OFFLOAD_STATE_ATTRS = {"optim_states": "opt_state",
                             "hp_params": "master",
-                            "lp_params": "params"}
+                            "lp_params": "params",
+                            "lp_grads": "grad_acc"}
 
     def offload_states(self, include=None, device="cpu", pin_memory=True,
                        non_blocking=False):
         """Move engine states to host memory on demand (reference
         ``engine.py:3720``; used by RLHF-style flows to free HBM between
         phases).  ``include``: subset of {"optim_states", "hp_params",
-        "lp_params"}; default all.  States return via :meth:`reload_states`
-        (or automatically on the next forward/step)."""
+        "lp_params", "lp_grads"}; default all.  States return via
+        :meth:`reload_states` (or automatically on the next
+        forward/backward/step)."""
         if str(device) not in ("cpu", "OffloadDeviceEnum.cpu"):
             raise ValueError(f"only host offload is supported, got {device}")
         if getattr(self, "_state_on_nvme", False):

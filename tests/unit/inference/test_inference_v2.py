@@ -191,3 +191,35 @@ def test_v2_tp_rejects_indivisible():
         InferenceEngineV2(model, params=params,
                           config=dict(dtype="float32",
                                       tensor_parallel=dict(tp_size=2)))
+
+
+def test_v2_tp_mixtral_ep_rules_restricted():
+    """Mixtral's training tp_rules reference the 'ep' axis; the tp-only
+    inference mesh must not crash — sharding parity vs tp=1 still holds."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import mixtral
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+    cfg = mixtral.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, dtype="float32", remat=False)
+    model = mixtral.MixtralModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+              max_ragged_sequence_count=8, max_context=128,
+              block_size=16, num_blocks=40)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 96, size=11).tolist()]
+    outs = {}
+    for tp in (1, 2):
+        eng = InferenceEngineV2(
+            model, params=params,
+            config=dict(dtype="float32", state_manager=dict(sm),
+                        tensor_parallel=dict(tp_size=tp)))
+        outs[tp] = eng.generate(prompts, max_new_tokens=4)
+        eng.flush(range(1))
+    assert outs[1] == outs[2]
