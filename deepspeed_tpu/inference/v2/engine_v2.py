@@ -72,8 +72,9 @@ class InferenceEngineV2:
             import sys as _sys
             mod = _sys.modules.get(type(model).__module__)
             if hasattr(mod, "tp_rules"):
-                rules = self._restrict_rules_to_mesh(mod.tp_rules(cfg),
-                                                     self._tp_mesh)
+                # shard_params_for_tp restricts specs to the mesh's axes
+                # (drops 'zero'/'ep' etc. training pseudo-axes)
+                rules = mod.tp_rules(cfg)
             self.params = shard_params_for_tp(self.params, self._tp_mesh,
                                               rules=rules)
             self._kv_sharding = NamedSharding(
@@ -135,26 +136,6 @@ class InferenceEngineV2:
         """Release sequences (reference ``flush`` :188)."""
         for uid in uids:
             self.state_manager.flush_sequence(uid)
-
-    @staticmethod
-    def _restrict_rules_to_mesh(rules, mesh):
-        """Training tp_rules may reference axes the inference mesh lacks
-        (mixtral's 'ep', the 'zero' pseudo-axis): keep only axes the mesh
-        has so a rule like P('ep', None, ('tp','zero')) becomes
-        P(None, None, 'tp') instead of a KeyError."""
-        from jax.sharding import PartitionSpec as P
-        names = set(mesh.axis_names)
-
-        def fix_axis(a):
-            if a is None:
-                return None
-            if isinstance(a, (tuple, list)):
-                kept = tuple(x for x in a if x in names)
-                return kept if len(kept) > 1 else (kept[0] if kept else None)
-            return a if a in names else None
-
-        return {k: P(*(fix_axis(a) for a in spec))
-                for k, spec in rules.items()}
 
     # -------------------------------------------------------------- schedule
     def _atom_layout(self):

@@ -89,13 +89,16 @@ def _divisible(shape, spec, mesh):
     return True
 
 
-def _strip_zero_placeholder(spec):
-    """Drop the 'zero' pseudo-axis (a ZeRO-placement pin interpreted only by
-    ZeroPartitionPlan) — inference has no ZeRO axes to place."""
+def _restrict_spec_to_mesh(spec, mesh):
+    """Drop axes the target mesh doesn't have: the 'zero' pseudo-axis (a
+    ZeRO-placement pin interpreted only by ZeroPartitionPlan) and any
+    training-mesh axis absent at inference (e.g. mixtral's 'ep' on a
+    tp-only mesh) — P('ep', None, ('tp','zero')) → P(None, None, 'tp')."""
+    have = set(mesh.axis_names)
     out = []
     for ax in spec:
         names = tuple(a for a in (ax if isinstance(ax, tuple) else (ax, ))
-                      if a is not None and a != "zero")
+                      if a is not None and a in have)
         out.append(names if len(names) > 1 else (names[0] if names else None))
     return P(*out)
 
@@ -110,7 +113,7 @@ def shard_params_for_tp(params, mesh, rules=None, tp_axis="tp"):
     def place(kp, leaf):
         spec = match_tp_rule(rules, path_str(kp))
         if spec is not None:
-            spec = _strip_zero_placeholder(spec)
+            spec = _restrict_spec_to_mesh(spec, mesh)
         if spec is None or not _divisible(leaf.shape, spec, mesh):
             if spec is not None:
                 logger.warning(

@@ -30,6 +30,42 @@ def _pytree_save(path, tree):
     ckptr.save(path, tree, force=True)
 
 
+def _pytree_save_async(path, tree):
+    """Async orbax save (the reference's Nebula engine role: staging returns
+    immediately, the write commits in the background).  Returns the
+    checkpointer — callers must keep it alive and ``wait_until_finished``."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    ckptr.save(path, tree, force=True)
+    return ckptr
+
+
+class _AsyncSaveHandle:
+    """Pending async checkpoint: ``wait()`` commits the `latest` tag only
+    after every tree is durably written (Nebula's commit semantics)."""
+
+    def __init__(self, checkpointers, latest_path=None, tag=None):
+        self._ckptrs = checkpointers
+        self._latest_path = latest_path
+        self._tag = tag
+        self._done = False
+
+    def wait(self):
+        if self._done:
+            return
+        for c in self._ckptrs:
+            c.wait_until_finished()
+            c.close()  # join orbax's commit threads — no leak across saves
+        if self._latest_path is not None:
+            with open(self._latest_path, "w") as f:
+                f.write(str(self._tag))
+        self._done = True
+
+    @property
+    def done(self):
+        return self._done
+
+
 def _pytree_restore(path, template=None, shardings=None):
     import orbax.checkpoint as ocp
     ckptr = ocp.PyTreeCheckpointer()
@@ -43,7 +79,7 @@ def _pytree_restore(path, template=None, shardings=None):
 
 
 def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
-                           save_latest=True):
+                           save_latest=True, async_save=False):
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     root = os.path.abspath(os.path.join(save_dir, str(tag)))
@@ -66,15 +102,26 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     with open(os.path.join(root, "engine_state.json"), "w") as f:
         json.dump(state, f, indent=2)
 
-    _pytree_save(os.path.join(root, "model"), engine.params)
+    trees = [("model", engine.params)]
     if engine.master is not None:
-        _pytree_save(os.path.join(root, "master"), engine.master)
+        trees.append(("master", engine.master))
     if engine.opt_state is not None:
-        _pytree_save(os.path.join(root, "optim"), engine.opt_state)
+        trees.append(("optim", engine.opt_state))
+    latest_path = (os.path.join(os.path.abspath(save_dir), "latest")
+                   if save_latest else None)
 
-    if save_latest:
-        with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-            f.write(str(tag))
+    handle = None
+    if async_save:
+        handle = _AsyncSaveHandle(
+            [_pytree_save_async(os.path.join(root, sub), tree)
+             for sub, tree in trees],
+            latest_path=latest_path, tag=tag)
+    else:
+        for sub, tree in trees:
+            _pytree_save(os.path.join(root, sub), tree)
+        if latest_path is not None:
+            with open(latest_path, "w") as f:
+                f.write(str(tag))
 
     # ship the recovery script into the checkpoint (reference engine.py:3540
     # _copy_recovery_script copies zero_to_fp32.py next to the shards)
@@ -85,6 +132,9 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
                      os.path.join(os.path.abspath(save_dir), "zero_to_fp32.py"))
     except Exception:  # non-fatal: checkpoint itself is complete
         pass
+    if handle is not None:
+        log_dist(f"async checkpoint staged {root}", ranks=[0])
+        return handle
     log_dist(f"saved checkpoint {root}", ranks=[0])
     return True
 

@@ -972,16 +972,36 @@ class DeepSpeedEngine:
 
     # ----------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, exclude_frozen_parameters=False):
+                        save_latest=True, exclude_frozen_parameters=False,
+                        async_save=False):
+        """``async_save=True`` stages the write and returns immediately
+        (the reference's Nebula async engine role); the `latest` tag
+        commits at :meth:`wait_for_checkpoint` (also called automatically
+        before the next save)."""
         from .checkpoint_engine import save_engine_checkpoint
         self._ensure_state_resident()
-        return save_engine_checkpoint(self, save_dir, tag=tag,
-                                      client_state=client_state,
-                                      save_latest=save_latest)
+        self.wait_for_checkpoint()   # one pending async save at a time
+        out = save_engine_checkpoint(self, save_dir, tag=tag,
+                                     client_state=client_state,
+                                     save_latest=save_latest,
+                                     async_save=async_save)
+        if async_save:
+            self._pending_ckpt = out
+        return out
+
+    def wait_for_checkpoint(self):
+        """Block until a pending ``async_save`` checkpoint is durable."""
+        pending = getattr(self, "_pending_ckpt", None)
+        if pending is not None:
+            pending.wait()
+            self._pending_ckpt = None
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
+        # a pending async save must commit first: `latest` isn't written
+        # until then, and the target dir may still be mid-write
+        self.wait_for_checkpoint()
         if self._config.checkpoint_config.load_universal:
             from ..checkpoint.universal_checkpoint import load_universal_checkpoint
             return load_universal_checkpoint(

@@ -346,3 +346,30 @@ def test_offload_lp_grads_mid_accumulation():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
         finals[0], finals[1])
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_save stages the write and keeps training; wait commits the
+    latest tag; resume matches (Nebula-engine role)."""
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(stage=2))
+    data = batches(random_dataset(32, HIDDEN), 4 * engine.dp_world_size)
+    _train(engine, data, steps=3)
+    saved = engine.get_fp32_param()
+    step_saved = engine.global_steps
+
+    handle = engine.save_checkpoint(str(tmp_path), tag="a", async_save=True)
+    assert handle is not None and not handle.done
+    _train(engine, data, steps=2)        # training continues while staging
+    engine.wait_for_checkpoint()
+    assert handle.done
+    assert (tmp_path / "latest").read_text() == "a"
+
+    engine.load_checkpoint(str(tmp_path))   # latest → "a"
+    assert engine.global_steps == step_saved
+    restored = engine.get_fp32_param()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        saved, restored)
