@@ -53,13 +53,26 @@ class _AsyncSaveHandle:
     def wait(self):
         if self._done:
             return
-        for c in self._ckptrs:
-            c.wait_until_finished()
-            c.close()  # join orbax's commit threads — no leak across saves
-        if self._latest_path is not None:
-            with open(self._latest_path, "w") as f:
-                f.write(str(self._tag))
-        self._done = True
+        errors = []
+        try:
+            for c in self._ckptrs:
+                try:
+                    c.wait_until_finished()
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+                finally:
+                    try:  # join orbax's commit threads even on failure
+                        c.close()
+                    except Exception:
+                        pass
+            if errors:
+                # `latest` is NOT written: the checkpoint is not durable
+                raise errors[0]
+            if self._latest_path is not None:
+                with open(self._latest_path, "w") as f:
+                    f.write(str(self._tag))
+        finally:
+            self._done = True  # a failed commit must not wedge retries
 
     @property
     def done(self):

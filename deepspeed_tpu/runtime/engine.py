@@ -987,14 +987,23 @@ class DeepSpeedEngine:
                                      async_save=async_save)
         if async_save:
             self._pending_ckpt = out
+            if not getattr(self, "_ckpt_atexit", False):
+                # a script whose LAST act is an async save would otherwise
+                # exit without ever committing the `latest` tag
+                import atexit
+                import weakref
+                ref = weakref.ref(self)
+                atexit.register(
+                    lambda: ref() is not None and ref().wait_for_checkpoint())
+                self._ckpt_atexit = True
         return out
 
     def wait_for_checkpoint(self):
         """Block until a pending ``async_save`` checkpoint is durable."""
         pending = getattr(self, "_pending_ckpt", None)
         if pending is not None:
+            self._pending_ckpt = None  # even a failed commit must not wedge
             pending.wait()
-            self._pending_ckpt = None
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,

@@ -70,16 +70,19 @@ def _launch_workers(zero_stage, ckpt_dir="", timeout=420):
     return losses
 
 
-def _single_process_reference(zero_stage, with_ckpt=False, tmp_path=None):
-    """Same training run on the in-process 8-device mesh."""
+class Net(nn.Module):
+    """Must stay in lockstep with tests/unit/multiproc/worker_zero_parity.py
+    (a separate process — it re-defines the same toy model)."""
 
-    class Net(nn.Module):
-        @nn.compact
-        def __call__(self, x, y):
-            h = jnp.tanh(nn.Dense(32, name="fc1")(x))
-            out = nn.Dense(D, name="fc2")(h)
-            return jnp.mean((out - y) ** 2)
+    @nn.compact
+    def __call__(self, x, y):
+        h = jnp.tanh(nn.Dense(32, name="fc1")(x))
+        out = nn.Dense(D, name="fc2")(h)
+        return jnp.mean((out - y) ** 2)
 
+
+def _make_engine_and_stream(zero_stage):
+    """In-process dp=8 engine + the exact data stream the workers use."""
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=Net(),
         config={"train_micro_batch_size_per_gpu": 1,
@@ -91,6 +94,12 @@ def _single_process_reference(zero_stage, with_ckpt=False, tmp_path=None):
     W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
     sample = rng.standard_normal((8, D)).astype(np.float32)
     engine.initialize_parameters(0, sample, sample @ W)
+    return engine, rng, W
+
+
+def _single_process_reference(zero_stage, with_ckpt=False, tmp_path=None):
+    """Same training run on the in-process 8-device mesh."""
+    engine, rng, W = _make_engine_and_stream(zero_stage)
 
     losses = []
     for step in range(4):
@@ -122,3 +131,32 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
     ref = _single_process_reference(2, with_ckpt=True, tmp_path=tmp_path)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
     assert os.path.isdir(ckpt)
+
+
+def test_cross_world_size_resume(tmp_path):
+    """A checkpoint written by a 2-process (dp=8 over 2×4 devices) run must
+    resume in a SINGLE process at the same global topology — the reference's
+    DistributedFixture elastic-resize pattern (``tests/unit/common.py:355``:
+    save at one world size, consume at another). Orbax global arrays make
+    this topology-free by construction; this proves it end-to-end."""
+    ckpt = str(tmp_path / "resize_ckpt")
+    got = _launch_workers(2, ckpt_dir=ckpt)   # workers save+reload at step 2
+
+    engine, rng, W = _make_engine_and_stream(zero_stage=2)
+    # consume the first two batches (trained by the 2-proc run pre-save)
+    for _ in range(2):
+        rng.standard_normal((8, D))
+    engine.load_checkpoint(ckpt, tag="mp")
+
+    resumed = []
+    for _ in range(2):
+        x = rng.standard_normal((8, D)).astype(np.float32)
+        y = x @ W
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        resumed.append(float(loss))
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    np.testing.assert_allclose(resumed, got[2:], rtol=1e-5, atol=1e-7)
