@@ -1,10 +1,17 @@
-from .comm import (all_gather, all_gather_into_tensor, all_reduce, all_to_all,
-                   all_to_all_single, barrier, broadcast, configure,
-                   destroy_process_group, ensure_runtime_initialized,
-                   get_local_rank, get_rank,
-                   get_world_group, get_world_size, init_distributed,
-                   initialize_mesh_device, is_initialized, log_summary,
-                   new_group, reduce_scatter, reduce_scatter_tensor)
+from .comm import (all_gather, all_gather_coalesced, all_gather_into_tensor,
+                   all_reduce, all_reduce_coalesced, all_to_all,
+                   all_to_all_single, allgather_fn, barrier, broadcast,
+                   configure, destroy_process_group,
+                   ensure_runtime_initialized, gather,
+                   get_all_ranks_from_group, get_global_rank,
+                   get_local_rank, get_rank, get_world_group,
+                   get_world_size, has_all_gather_into_tensor,
+                   has_all_reduce_coalesced, has_coalescing_manager,
+                   has_reduce_scatter_tensor, inference_all_reduce,
+                   init_distributed, initialize_mesh_device, irecv, is_available,
+                   is_initialized, isend, log_summary, monitored_barrier,
+                   new_group, recv, reduce, reduce_scatter,
+                   reduce_scatter_fn, reduce_scatter_tensor, scatter, send)
 from .backend import MeshBackend, ProcessGroup
 from .reduce_op import ReduceOp
 from . import functional

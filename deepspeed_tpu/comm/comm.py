@@ -15,6 +15,8 @@ import functools
 import os
 import time
 
+import numpy as np
+
 from .backend import MeshBackend, ProcessGroup
 from .reduce_op import ReduceOp
 from ..utils.comms_logging import CommsLogger, get_msg_size_from_args
@@ -234,6 +236,139 @@ def broadcast(tensor, src=0, group=None, async_op=False):
 def barrier(group=None):
     _assert_initialized()
     return cdb.barrier(group=group)
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Reference ``monitored_barrier``: a barrier that reports how long the
+    sync took (straggler visibility; there is no per-rank blame to assign
+    under a single SPMD controller)."""
+    t0 = time.perf_counter()
+    out = barrier(group=group)
+    dt = time.perf_counter() - t0
+    if timeout is not None and dt > float(timeout):
+        logger.warning(f"monitored_barrier took {dt:.3f}s "
+                       f"(timeout {timeout}s)")
+    return out
+
+
+@timed_op
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, async_op=False):
+    """Reference ``reduce``: under SPMD the reduced value is computed
+    everywhere (an all_reduce); ``dst`` has no special placement."""
+    _assert_initialized()
+    return cdb.all_reduce(tensor, op=op, group=group)
+
+
+@timed_op
+def gather(tensor, gather_list=None, dst=0, group=None, axis=0,
+           async_op=False):
+    """Reference ``gather``: SPMD computes the gathered result everywhere
+    (an all_gather); ``dst``/``gather_list`` have no special placement."""
+    _assert_initialized()
+    return cdb.all_gather(tensor, group=group, axis=axis)
+
+
+# reference inference_all_reduce: same collective, inference-tagged
+inference_all_reduce = all_reduce
+
+
+def all_gather_coalesced(tensors, group=None, async_op=False):
+    """Reference coalesced all-gather: one call per tensor (XLA already
+    fuses adjacent collectives under jit; eager coalescing buys nothing)."""
+    return [all_gather(t, group=group) for t in tensors]
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group=None,
+                         async_op=False):
+    return [all_reduce(t, op=op, group=group) for t in tensors]
+
+
+def allgather_fn(output_tensor, input_tensor, group=None, async_op=False,
+                 debug=None):
+    """Reference helper (picks the best all-gather impl): ours is always
+    ``all_gather``; the output-buffer arg has no meaning without torch's
+    in-place semantics."""
+    return all_gather(input_tensor, group=group)
+
+
+def reduce_scatter_fn(output_tensor, input_tensor, op=ReduceOp.SUM,
+                      group=None, async_op=False, debug=None):
+    return reduce_scatter(input_tensor, op=op, group=group)
+
+
+def send(tensor, dst, group=None, tag=0):
+    raise NotImplementedError(
+        "eager decoupled send/recv does not exist under a single SPMD "
+        "controller — express p2p as lax.ppermute inside the compiled "
+        "program (see runtime/pipe/engine.py)")
+
+
+def recv(tensor, src, group=None, tag=0):
+    raise NotImplementedError(
+        "eager decoupled send/recv does not exist under a single SPMD "
+        "controller — express p2p as lax.ppermute inside the compiled "
+        "program (see runtime/pipe/engine.py)")
+
+
+isend = send
+irecv = recv
+
+
+def scatter(tensor, scatter_list=None, src=0, group=None, async_op=False):
+    raise NotImplementedError(
+        "eager scatter has no SPMD analog — feed per-shard data with "
+        "engine.shard_batch / jax.device_put with a sharding instead")
+
+
+# ------------------------------------------------------- capability probes
+def is_available():
+    return True
+
+
+def has_all_gather_into_tensor():
+    return True
+
+
+def has_reduce_scatter_tensor():
+    return True
+
+
+def has_all_reduce_coalesced():
+    return True
+
+
+def has_coalescing_manager():
+    return False  # XLA fuses under jit; no eager coalescing manager
+
+
+def _group_member_devices(group):
+    """Devices of ONE instance of a mesh-axis group (the slice at index 0
+    of every non-group axis — under a single SPMD controller there is no
+    'caller rank' to select a specific instance; all instances are
+    isomorphic)."""
+    g = group if group is not None else cdb.world_group
+    mesh = getattr(g, "mesh", cdb.mesh)
+    axes = set(getattr(g, "axis_names", mesh.axis_names))
+    idx = tuple(slice(None) if name in axes else 0
+                for name in mesh.axis_names)
+    return list(np.asarray(mesh.devices)[idx].flat)
+
+
+def get_global_rank(group=None, group_rank=0):
+    """Reference ``get_global_rank``: global device id of the group's
+    ``group_rank``-th member."""
+    _assert_initialized()
+    devices = _group_member_devices(group)
+    if not 0 <= group_rank < len(devices):
+        raise IndexError(
+            f"group_rank {group_rank} out of range for group of "
+            f"size {len(devices)}")
+    return devices[group_rank].id
+
+
+def get_all_ranks_from_group(group=None):
+    _assert_initialized()
+    return [d.id for d in _group_member_devices(group)]
 
 
 def log_summary(show_straggler=False):

@@ -1,5 +1,6 @@
 """ds_bench collective sweep (reference bin/ds_bench surface)."""
 
+import numpy as np
 import pytest
 
 from deepspeed_tpu.benchmarks.comm_bench import run
@@ -28,3 +29,48 @@ def test_degenerate_axis_rejected():
     with pytest.raises(SystemExit, match="nothing to benchmark"):
         run(axis="pp", minsize=12, maxsize=12, print_fn=lambda *a: None)
     groups.reset_mesh()
+
+
+def test_facade_parity_ops():
+    """The reference comm surface beyond the core collectives: reduce/
+    gather/coalesced variants compute, SPMD-impossible ops raise with
+    guidance, probes answer."""
+    import jax.numpy as jnp
+    import deepspeed_tpu.comm as dist
+    dist.init_distributed()
+    x = jnp.arange(8.0)
+    # facade convention (test_dist): input = concatenation of per-rank
+    # locals; reduce sums the 8 one-element shards -> 28 everywhere
+    r = dist.reduce(x, dst=0)
+    np.testing.assert_allclose(np.asarray(r), 28.0)
+    g = dist.gather(x)
+    assert g.shape[0] >= x.shape[0]
+    outs = dist.all_reduce_coalesced([x, 2 * x])
+    assert len(outs) == 2
+    assert dist.allgather_fn(None, x) is not None
+    assert dist.has_all_gather_into_tensor() and dist.is_available()
+    assert isinstance(dist.get_all_ranks_from_group(), list)
+    dist.monitored_barrier(timeout=60)
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        dist.send(x, dst=1)
+    with pytest.raises(NotImplementedError, match="shard_batch"):
+        dist.scatter(x)
+
+
+def test_group_rank_introspection():
+    """Subgroup member lists respect the axis factorization."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    groups.initialize_mesh(dp=4, tp=2)
+    dist.init_distributed()
+    g_tp = dist.new_group(("tp", ))
+    ranks = dist.get_all_ranks_from_group(g_tp)
+    assert len(ranks) == 2 == g_tp.size()
+    assert dist.get_global_rank(g_tp, 1) == ranks[1]
+    with pytest.raises(IndexError):
+        dist.get_global_rank(g_tp, 5)
+    assert len(dist.get_all_ranks_from_group()) == 8
+    groups.reset_mesh()
+    dist.destroy_process_group()
