@@ -90,7 +90,61 @@ def timed_op(func):
 _jax_distributed_up = False
 
 
-def ensure_runtime_initialized():
+def mpi_discovery(distributed_port=29500):
+    """Derive (coordinator, num_processes, process_id) from MPI or SLURM
+    env (reference ``comm/comm.py:688 mpi_discovery`` + the SLURM path of
+    the launcher).  Returns None when neither launcher's env is present.
+
+    * ``mpirun``: OMPI_COMM_WORLD_RANK/SIZE; the coordinator address is
+      broadcast via mpi4py when available, else COORDINATOR_ADDRESS must be
+      exported (``mpirun -x COORDINATOR_ADDRESS=host0:port``).
+    * SLURM: SLURM_PROCID/SLURM_NPROCS + the first node of
+      SLURM_STEP_NODELIST (simple "prefix[a-b]" expansion).
+    """
+    env = os.environ
+    if "OMPI_COMM_WORLD_RANK" in env:
+        pid = int(env["OMPI_COMM_WORLD_RANK"])
+        nproc = int(env["OMPI_COMM_WORLD_SIZE"])
+        coord = env.get("COORDINATOR_ADDRESS")
+        if coord is None:
+            try:
+                from mpi4py import MPI
+                comm = MPI.COMM_WORLD
+                import socket
+                # broadcast the bare hostname — gethostbyname often
+                # resolves to 127.0.1.1 on stock images, which remote
+                # ranks cannot reach; let each rank resolve it via DNS
+                coord = comm.bcast(
+                    f"{socket.gethostname()}:{distributed_port}", root=0)
+            except ImportError as e:
+                raise RuntimeError(
+                    "launched under mpirun but mpi4py is unavailable to "
+                    "broadcast the coordinator — export "
+                    "COORDINATOR_ADDRESS=<rank0-host>:<port> "
+                    "(e.g. mpirun -x COORDINATOR_ADDRESS=...)") from e
+        return coord, nproc, pid
+    if "SLURM_PROCID" in env and "SLURM_NPROCS" in env:
+        pid = int(env["SLURM_PROCID"])
+        nproc = int(env["SLURM_NPROCS"])
+        coord = env.get("COORDINATOR_ADDRESS")
+        if coord is None:
+            nodelist = env.get("SLURM_STEP_NODELIST",
+                               env.get("SLURM_NODELIST", ""))
+            first = nodelist.split(",")[0]
+            if "[" in first:  # "prefix[3-8]" or "prefix[3,9]" → prefix3
+                prefix, rng = first.split("[", 1)
+                first = prefix + rng.split("-")[0].split(",")[0].rstrip("]")
+            if not first:
+                raise RuntimeError(
+                    "SLURM env present but no node list — export "
+                    "COORDINATOR_ADDRESS=<rank0-host>:<port>")
+            coord = f"{first}:{distributed_port}"
+        return coord, nproc, pid
+    return None
+
+
+def ensure_runtime_initialized(auto_mpi_discovery=True,
+                               distributed_port=29500):
     """The multi-process half of ``init_distributed``: bring up
     ``jax.distributed`` (COORDINATOR_ADDRESS rendezvous — the MASTER_ADDR
     analog) WITHOUT touching the mesh.  MUST run before anything asks jax
@@ -103,6 +157,13 @@ def ensure_runtime_initialized():
     nproc = int(os.environ.get("JAX_PROCESS_COUNT",
                                os.environ.get("WORLD_SIZE", "1")))
     pid = int(os.environ.get("JAX_PROCESS_ID", os.environ.get("RANK", "0")))
+    if nproc <= 1 and auto_mpi_discovery:
+        # launched by mpirun/srun directly (reference auto_mpi_discovery);
+        # an exported COORDINATOR_ADDRESS is respected, MPI env supplies
+        # the rank/size our launcher vars would have
+        discovered = mpi_discovery(distributed_port=distributed_port)
+        if discovered is not None:
+            coord, nproc, pid = discovered
     if coord is not None and nproc > 1:
         import jax
         jax.distributed.initialize(coordinator_address=coord,
@@ -127,7 +188,8 @@ def init_distributed(dist_backend=None, auto_mpi_discovery=True,
     if is_initialized():
         return cdb
 
-    ensure_runtime_initialized()
+    ensure_runtime_initialized(auto_mpi_discovery=auto_mpi_discovery,
+                               distributed_port=distributed_port)
 
     from ..accelerator import get_accelerator
     backend_name = dist_backend or get_accelerator().communication_backend_name()

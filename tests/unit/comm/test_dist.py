@@ -100,3 +100,35 @@ def test_comms_logger():
     summary = dist.log_summary()
     assert "all_reduce" in summary
     dist.configure(enabled=False)
+
+
+def test_mpi_discovery_env(monkeypatch):
+    """mpi_discovery derives rendezvous info from mpirun/SLURM env
+    (reference comm.py:688)."""
+    from deepspeed_tpu.comm.comm import mpi_discovery
+    for var in ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+                "SLURM_PROCID", "SLURM_NPROCS", "COORDINATOR_ADDRESS",
+                "SLURM_STEP_NODELIST"):
+        monkeypatch.delenv(var, raising=False)
+    assert mpi_discovery() is None
+
+    # mpirun with an explicit coordinator
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:29500")
+    assert mpi_discovery() == ("10.0.0.1:29500", 4, 3)
+    # without a coordinator and without mpi4py → actionable error
+    monkeypatch.delenv("COORDINATOR_ADDRESS")
+    import importlib
+    if importlib.util.find_spec("mpi4py") is None:
+        with pytest.raises(RuntimeError, match="COORDINATOR_ADDRESS"):
+            mpi_discovery()
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+    monkeypatch.delenv("OMPI_COMM_WORLD_SIZE")
+
+    # SLURM with a bracketed nodelist
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    monkeypatch.setenv("SLURM_NPROCS", "8")
+    monkeypatch.setenv("SLURM_STEP_NODELIST", "tpu-host[3-6],tpu-host9")
+    coord, nproc, pid = mpi_discovery(distributed_port=1234)
+    assert coord == "tpu-host3:1234" and nproc == 8 and pid == 1
