@@ -107,7 +107,7 @@ class DeepSpeedTransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None,
-                 deterministic=None):
+                 deterministic=None, pld_theta=None):
         cfg = self.config
         if deterministic is None:
             deterministic = not cfg.training
@@ -160,10 +160,20 @@ class DeepSpeedTransformerLayer(nn.Module):
         # top level ("inter"/"output"), not nested under a submodule name
         nn.share_scope(self, ffn)
 
+        x_in = x
         if cfg.pre_layer_norm:
             x = x + attn_block(ln("attn_ln")(x))
             x = x + ffn(ln("ffn_ln")(x), deterministic)
         else:
             x = ln("attn_ln")(x + attn_block(x))
             x = ln("ffn_ln")(x + ffn(x, deterministic))
+
+        if pld_theta is not None and not deterministic:
+            # progressive layer drop (engine pld_theta, reference PLD):
+            # keep this layer's computation with probability theta, else
+            # pass the input through unchanged (stochastic depth)
+            keep = jax.random.bernoulli(
+                self.make_rng("pld"),
+                jnp.asarray(pld_theta, jnp.float32))
+            x = jnp.where(keep, x, x_in.astype(x.dtype))
         return (x, ) if cfg.return_tuple else x

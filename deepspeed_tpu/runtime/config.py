@@ -301,6 +301,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}) or {})
         self.pipeline_config = PipelineConfig(**pd.get("pipeline", {}) or {})
+        self.pld_config = dict(pd.get("progressive_layer_drop", {}) or {})
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}) or {})
         self.data_types_config = DataTypesConfig(**pd.get("data_types", {}) or {})
         self.aio_config = AioConfig(**pd.get("aio", {}) or {})

@@ -269,6 +269,16 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
 
+        # ------------------------------------------- progressive layer drop
+        pld_cfg = getattr(config, "pld_config", {}) or {}
+        if pld_cfg.get("enabled"):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5),
+                gamma=pld_cfg.get("gamma", 0.001))
+        else:
+            self.progressive_layer_drop = None
+
         if model_parameters is not None:
             log_dist(
                 f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
@@ -623,11 +633,26 @@ class DeepSpeedEngine:
 
     def _effective_apply_fn(self):
         """apply_fn with registered param transforms composed in — the single
-        model-fn entry for every micro-step variant (GSPMD / qgZ / 1-bit)."""
+        model-fn entry for every micro-step variant (GSPMD / qgZ / 1-bit)
+        and the flops profiler.  In training mode with PLD enabled, the two
+        trailing inputs forward() appends (theta, rng key) are stripped and
+        delivered as kwargs here — so every consumer stays consistent with
+        the augmented input convention."""
         fn = self._apply_fn
         for t in self._param_transforms:
             fn = (lambda inner, t: lambda params, *i, **k: inner(
                 t(params), *i, **k))(fn, t)
+        if self.progressive_layer_drop is not None and self.training:
+            inner = fn
+            if self._flax:
+                fn = lambda params, *i, **k: inner(
+                    params, *i[:-2], pld_theta=i[-2],
+                    rngs={"pld": i[-1]}, **k)
+            else:
+                # non-flax models receive the key explicitly — they have no
+                # rng collection to draw the drop decision from
+                fn = lambda params, *i, **k: inner(
+                    params, *i[:-2], pld_theta=i[-2], pld_rng=i[-1], **k)
         return fn
 
     # ---------------------------------------------------------- compiled fns
@@ -753,6 +778,10 @@ class DeepSpeedEngine:
             out = self._effective_apply_fn()(self.params, *inputs, **kwargs)
             return out
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self.progressive_layer_drop is not None:
+            inputs = (*inputs,
+                      np.float32(self.progressive_layer_drop.get_theta()),
+                      jax.random.PRNGKey(self.micro_steps))
         micro = self._get_compiled_micro(inputs)
         loss, grads = micro(self.params, self.scale_state.scale, inputs)
         self._stashed_grads = grads
@@ -855,6 +884,8 @@ class DeepSpeedEngine:
                 self._nvme_swap_out()
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
             if bool(overflow):
                 self.skipped_steps += 1
                 log_dist(f"overflow at step {self.global_steps}, "
@@ -1011,6 +1042,17 @@ class DeepSpeedEngine:
         # a pending async save must commit first: `latest` isn't written
         # until then, and the target dir may still be mid-write
         self.wait_for_checkpoint()
+        try:
+            return self._load_checkpoint_impl(
+                load_dir, tag, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+        finally:
+            if self.progressive_layer_drop is not None:
+                # resume at the annealed theta, not a fresh 1.0
+                self.progressive_layer_drop.update_state(self.global_steps)
+
+    def _load_checkpoint_impl(self, load_dir, tag, load_optimizer_states,
+                              load_lr_scheduler_states, load_module_only):
         if self._config.checkpoint_config.load_universal:
             from ..checkpoint.universal_checkpoint import load_universal_checkpoint
             return load_universal_checkpoint(

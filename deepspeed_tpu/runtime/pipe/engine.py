@@ -65,6 +65,11 @@ class PipelineEngine(DeepSpeedEngine):
                          training_data=training_data, lr_scheduler=lr_scheduler,
                          collate_fn=collate_fn, config=config, mpu=mpu,
                          tp_rules=rules, **kw)
+        if self.progressive_layer_drop is not None:
+            raise NotImplementedError(
+                "progressive_layer_drop is not supported by the pipeline "
+                "engine (its fused program builds its own apply path); "
+                "disable it or use the base engine")
         # Stage geometry: contiguous uniform split of the block run, padded to
         # equal per-stage counts so the stacked leaves split evenly over "pp".
         # Pad blocks carry a False entry in the valid mask and are skipped

@@ -1,0 +1,42 @@
+"""Progressive Layer Drop — reference ``runtime/progressive_layer_drop.py``.
+
+PLD (Zhang & He, "Accelerating Training of Transformer-Based Language
+Models with Progressive Layer Dropping") anneals a keep probability
+``theta(t)`` from 1 toward a floor ``theta_bar``; each transformer layer is
+skipped (identity) with probability ``1 - theta(t)`` during training, which
+cuts per-step compute while the schedule keeps early training stable.
+
+The engine exposes the schedule exactly like the reference: when
+``progressive_layer_drop.enabled`` is set, every training forward receives
+``pld_theta`` (a traced scalar, so the jitted step does NOT recompile as
+theta anneals), and ``update_state`` advances the schedule each global
+step.  ``DeepSpeedTransformerLayer`` consumes ``pld_theta`` natively
+(stochastic depth via the ``pld`` rng collection); custom flax models opt
+in by accepting a ``pld_theta`` keyword.
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    """Keep-probability schedule: theta(t) = (1 - theta_bar)·e^(−gamma·t)
+    inverted around the floor — starts at 1, decays to ``theta``."""
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = float(theta)    # the floor (theta_bar)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = ((1.0 - self.theta)
+                              * float(np.exp(-self.gamma * global_step))
+                              + self.theta)
+        return self.current_theta
+
+    def get_state(self):
+        """Reference ``get_state``: the kwargs injected into the model."""
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
