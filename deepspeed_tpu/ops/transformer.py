@@ -160,20 +160,29 @@ class DeepSpeedTransformerLayer(nn.Module):
         # top level ("inter"/"output"), not nested under a submodule name
         nn.share_scope(self, ffn)
 
-        x_in = x
-        if cfg.pre_layer_norm:
-            x = x + attn_block(ln("attn_ln")(x))
-            x = x + ffn(ln("ffn_ln")(x), deterministic)
-        else:
-            x = ln("attn_ln")(x + attn_block(x))
-            x = ln("ffn_ln")(x + ffn(x, deterministic))
+        def layer_body(x):
+            if cfg.pre_layer_norm:
+                x = x + attn_block(ln("attn_ln")(x))
+                x = x + ffn(ln("ffn_ln")(x), deterministic)
+            else:
+                x = ln("attn_ln")(x + attn_block(x))
+                x = ln("ffn_ln")(x + ffn(x, deterministic))
+            return x
 
         if pld_theta is not None and not deterministic:
             # progressive layer drop (engine pld_theta, reference PLD):
-            # keep this layer's computation with probability theta, else
-            # pass the input through unchanged (stochastic depth)
+            # keep this layer with probability theta, else identity.  The
+            # scalar-predicate lax.cond actually SKIPS the layer's FLOPs at
+            # runtime (a jnp.where would compute both branches).
             keep = jax.random.bernoulli(
-                self.make_rng("pld"),
-                jnp.asarray(pld_theta, jnp.float32))
-            x = jnp.where(keep, x, x_in.astype(x.dtype))
+                self.make_rng("pld"), jnp.asarray(pld_theta, jnp.float32))
+            # flax: initialize params unconditionally, run conditionally
+            # (nn.cond lifts module state through the branch)
+            if self.is_initializing():
+                x = layer_body(x)
+            else:
+                x = nn.cond(keep, lambda mdl, t: layer_body(t),
+                            lambda mdl, t: t, self, x)
+        else:
+            x = layer_body(x)
         return (x, ) if cfg.return_tuple else x

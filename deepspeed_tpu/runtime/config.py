@@ -120,6 +120,27 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class PldConfig(DeepSpeedConfigModel):
+    """``progressive_layer_drop`` section (reference
+    ``runtime/progressive_layer_drop.py`` + PLD paper schedule)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    """``eigenvalue`` section (reference ``runtime/eigenvalue.py`` — layer
+    Hessian eigenvalues for compression's quantization-offset schedule)."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = ""
+    layer_num: int = 0
+
+
 class HybridEngineConfig(DeepSpeedConfigModel):
     """Reference ``deepspeed/runtime/config.py`` hybrid_engine section
     (RLHF train↔generate flip-flop, ``runtime/hybrid_engine.py:30``)."""
@@ -301,7 +322,10 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}) or {})
         self.pipeline_config = PipelineConfig(**pd.get("pipeline", {}) or {})
-        self.pld_config = dict(pd.get("progressive_layer_drop", {}) or {})
+        self.pld_config = PldConfig(
+            **pd.get("progressive_layer_drop", {}) or {})
+        self.eigenvalue_config = EigenvalueConfig(
+            **pd.get("eigenvalue", {}) or {})
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}) or {})
         self.data_types_config = DataTypesConfig(**pd.get("data_types", {}) or {})
         self.aio_config = AioConfig(**pd.get("aio", {}) or {})

@@ -270,14 +270,32 @@ class DeepSpeedEngine:
         self.monitor = MonitorMaster(config.monitor_config)
 
         # ------------------------------------------- progressive layer drop
-        pld_cfg = getattr(config, "pld_config", {}) or {}
-        if pld_cfg.get("enabled"):
+        pld_cfg = getattr(config, "pld_config", None)
+        if pld_cfg is not None and pld_cfg.enabled:
+            if zc.zero_quantized_gradients or self._onebit_opt is not None:
+                # their manual-SPMD micros shard every input over dp; the
+                # rank-0 theta / (2,) rng key can't ride that convention
+                raise NotImplementedError(
+                    "progressive_layer_drop cannot combine with "
+                    "zero_quantized_gradients or 1-bit optimizers")
             from .progressive_layer_drop import ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
-                theta=pld_cfg.get("theta", 0.5),
-                gamma=pld_cfg.get("gamma", 0.001))
+                theta=pld_cfg.theta, gamma=pld_cfg.gamma)
         else:
             self.progressive_layer_drop = None
+
+        # ----------------------------------------------- eigenvalue (compression)
+        eig_cfg = getattr(config, "eigenvalue_config", None)
+        if eig_cfg is not None and eig_cfg.enabled:
+            from .eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=eig_cfg.verbose, max_iter=eig_cfg.max_iter,
+                tol=eig_cfg.tol, stability=eig_cfg.stability,
+                gas_boundary_resolution=eig_cfg.gas_boundary_resolution,
+                layer_name=eig_cfg.layer_name, layer_num=eig_cfg.layer_num)
+        else:
+            self.eigenvalue = None
+        self.block_eigenvalue = None
 
         if model_parameters is not None:
             log_dist(
@@ -631,7 +649,7 @@ class DeepSpeedEngine:
         self._compiled_apply = None
         self._compiled_train_batch = {}
 
-    def _effective_apply_fn(self):
+    def _effective_apply_fn(self, with_pld=True):
         """apply_fn with registered param transforms composed in — the single
         model-fn entry for every micro-step variant (GSPMD / qgZ / 1-bit)
         and the flops profiler.  In training mode with PLD enabled, the two
@@ -642,7 +660,8 @@ class DeepSpeedEngine:
         for t in self._param_transforms:
             fn = (lambda inner, t: lambda params, *i, **k: inner(
                 t(params), *i, **k))(fn, t)
-        if self.progressive_layer_drop is not None and self.training:
+        if with_pld and self.progressive_layer_drop is not None \
+                and self.training:
             inner = fn
             if self._flax:
                 fn = lambda params, *i, **k: inner(
@@ -945,6 +964,20 @@ class DeepSpeedEngine:
                 "engine has no parameters — pass model_parameters to "
                 "initialize() or call engine.initialize_parameters(seed, "
                 "*sample_inputs) first")
+
+    def compute_block_eigenvalues(self, *sample_inputs):
+        """Per-block Hessian max-eigenvalues of the loss (reference engine
+        eigenvalue hook, consumed by compression's quantization-offset
+        scheduling).  Caches the result on ``self.block_eigenvalue``."""
+        if self.eigenvalue is None:
+            raise RuntimeError("eigenvalue is not enabled in the config "
+                               '("eigenvalue": {"enabled": true})')
+        self._check_params()
+        inputs = self.shard_batch(*sample_inputs)
+        apply_fn = self._effective_apply_fn(with_pld=False)
+        self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+            lambda p, *i: apply_fn(p, *i), self.params, *inputs)
+        return self.block_eigenvalue
 
     def compile(self, backend=None, compile_kwargs=None) -> None:
         """Reference ``engine.py:3696`` (torch.compile wrapper).  Every
