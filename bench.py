@@ -283,19 +283,31 @@ def main():
             errors[mode] = (f"rc={proc.returncode} "
                             f"stderr tail: {(err or '')[-500:]}")
 
-    # Most recent REAL-CHIP measurement (for honest context when the axon
-    # tunnel's compile RPC is too slow for the fallback path to avoid —
-    # measured via this same script, see README perf table):
-    #   2026-07-30: 31611 tok/s, MFU 0.581, B=4 S=2048 536M, flash 512/512
-    last_measured = ("last real-TPU measurement 2026-07-30: 31611 tok/s "
-                     "MFU=0.581 vs_baseline=1.451")
+    # self-maintaining record of the last successful REAL-CHIP run, cited
+    # for honest context when the tunnel is too slow today
+    last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_last_device.json")
     if "device" in results:
+        try:
+            with open(last_path, "w") as f:
+                json.dump({"when": time.strftime("%Y-%m-%d"),
+                           **results["device"]}, f)
+        except OSError:
+            pass
         print(json.dumps(results["device"]), flush=True)
     elif "cpu" in results:
         rec = results["cpu"]
+        note = ""
+        try:
+            with open(last_path) as f:
+                prev = json.load(f)
+            note = (f"; last real-TPU run {prev.get('when', '?')}: "
+                    f"value={prev.get('value')} "
+                    f"vs_baseline={prev.get('vs_baseline')}")
+        except (OSError, ValueError):
+            pass
         rec["unit"] += (" [cpu-fallback: device attempt failed: "
-                        f"{errors.get('device', 'unknown')[:200]}; "
-                        f"{last_measured}]")
+                        f"{errors.get('device', 'unknown')[:200]}{note}]")
         print(json.dumps(rec), flush=True)
     else:
         print(json.dumps({

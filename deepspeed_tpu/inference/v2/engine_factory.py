@@ -30,6 +30,17 @@ def build_hf_engine(path: str,
         engine_config = RaggedInferenceEngineConfig(**{**engine_config,
                                                        **kwargs})
     checkpoint = HuggingFaceCheckpointEngine(path)
+    from .ragged_forward import RAGGED_FORWARDS
+    model_type = checkpoint.model_config.get("model_type", "llama")
+    if model_type in ("bloom", ):
+        # ingestable for v1 injection but no ragged forward exists — fail
+        # BEFORE ingesting gigabytes of weights
+        raise ValueError(
+            f"{model_type!r} is served by the v1 engine "
+            "(deepspeed_tpu.init_inference via "
+            "module_inject.replace_transformer_layer), not FastGen v2 — "
+            f"no ragged forward is registered (have: "
+            f"{sorted(RAGGED_FORWARDS)})")
     model, params = build_model_and_params(checkpoint,
                                            dtype=engine_config.dtype)
     return InferenceEngineV2(model, params=params, config=engine_config)

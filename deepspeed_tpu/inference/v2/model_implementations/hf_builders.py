@@ -21,7 +21,8 @@ from ....models.phi import PhiConfig, PhiModel
 from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
-                         "falcon", "opt", "phi", "qwen2_moe", "qwen")
+                         "falcon", "opt", "phi", "qwen2_moe", "qwen",
+                         "bloom")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -498,6 +499,63 @@ def _ingest_qwen(cfg: LlamaConfig,
     return _ingest_llama(cfg, gen())
 
 
+def _bloom_config_from_hf(cfg: dict, dtype: str):
+    from ....models.bloom import BloomConfig
+    return BloomConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg.get("hidden_size", cfg.get("n_embed")),
+        num_hidden_layers=cfg.get("n_layer", cfg.get("num_hidden_layers")),
+        num_attention_heads=cfg.get("n_head",
+                                    cfg.get("num_attention_heads")),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+        apply_residual_connection_post_layernorm=cfg.get(
+            "apply_residual_connection_post_layernorm", False),
+        dtype=dtype, remat=False)
+
+
+def _ingest_bloom(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF bloom layout → flax tree.  The fused head-interleaved
+    ``query_key_value`` is kept AS-IS (the flax block reshapes the same
+    way), so every weight is a plain transpose."""
+    tree: Dict = {}
+    ln_names = ("input_layernorm", "post_attention_layernorm")
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES):
+            continue
+        name = name.removeprefix("transformer.")
+        if name.startswith("word_embeddings_layernorm."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("word_embeddings_layernorm",
+                        "scale" if kind == "weight" else "bias"), arr)
+        elif name == "word_embeddings.weight":
+            _set(tree, ("word_embeddings", "embedding"), arr)
+        elif name.startswith("ln_f."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("ln_f", "scale" if kind == "weight" else "bias"),
+                 arr)
+        elif name == "lm_head.weight":
+            continue  # always tied to word_embeddings
+        elif name.startswith("h."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"h_{idx}"
+            rest = rest.removeprefix("self_attention.")                        .removeprefix("mlp.")
+            proj, kind = rest.rsplit(".", 1)
+            if proj in ln_names:
+                _set(tree, (layer, proj,
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif proj in ("query_key_value", "dense", "dense_h_to_4h",
+                          "dense_4h_to_h"):
+                val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                       else arr)
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"), val)
+            else:
+                logger.warning(f"HF bloom ingest: skipping {name}")
+        else:
+            logger.warning(f"HF bloom ingest: skipping {name}")
+    return tree
+
+
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
     _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
@@ -650,6 +708,11 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _qwen_config_from_hf(hf_cfg, dtype)
         params = _ingest_qwen(cfg, checkpoint_engine.parameters())
         model = LlamaModel(cfg)
+    elif model_type == "bloom":
+        from ....models.bloom import BloomModel
+        cfg = _bloom_config_from_hf(hf_cfg, dtype)
+        params = _ingest_bloom(cfg, checkpoint_engine.parameters())
+        model = BloomModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -58,7 +58,7 @@ def kv_cache_update(module, k, v, rotate_fn=None):
 
 
 def decode_attention(q, k_full, v_full, start_index, softmax_scale=None,
-                     window=0):
+                     window=0, alibi_slopes=None):
     """Attention of S query tokens (global positions ``start_index + s``)
     over a full-length KV buffer, masked so query s sees keys
     ``j <= start_index + s``.  Degenerates to plain causal attention for the
@@ -82,6 +82,13 @@ def decode_attention(q, k_full, v_full, start_index, softmax_scale=None,
     mask = key_pos <= query_pos                      # [S, L]
     if window:  # sliding window: only the last `window` keys are visible
         mask &= key_pos > query_pos - window
+    if alibi_slopes is not None:
+        # ALiBi in its softmax-invariant form: + slope_h * key_pos (differs
+        # from -slope*(q-k) by a per-row constant the softmax cancels)
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(Hkv, rep)
+        kp = jnp.arange(L, dtype=jnp.float32)
+        scores = scores + sl[None, :, :, None, None] \
+            * kp[None, None, None, None, :]
     scores = jnp.where(mask[None, None, None], scores,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -57,6 +57,13 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _bloom_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _bloom_config_from_hf)
+    from ..models.bloom import BloomModel
+    return BloomModel(_bloom_config_from_hf(hf_cfg, dtype))
+
+
 def _phi_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _phi_config_from_hf)
@@ -90,6 +97,7 @@ POLICIES = {
     "phi3": InjectionPolicy("phi3", _llama_factory),
     "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
     "qwen2_moe": InjectionPolicy("qwen2_moe", _qwen2_moe_factory),
+    "bloom": InjectionPolicy("bloom", _bloom_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
     "phi": InjectionPolicy("phi", _phi_factory),

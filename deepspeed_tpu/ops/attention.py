@@ -14,11 +14,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0):
+def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0,
+                   alibi_slopes=None):
     """Reference XLA path [B, S, H, D] (fp32 softmax accumulation)."""
     B, S, H, D = q.shape
     scale = softmax_scale if softmax_scale is not None else D**-0.5
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if alibi_slopes is not None:
+        # ALiBi (softmax-invariant form: + slope_h * key_pos)
+        sl = jnp.asarray(alibi_slopes, logits.dtype)
+        logits = logits + sl[None, :, None, None] \
+            * jnp.arange(k.shape[1], dtype=logits.dtype)[None, None, None, :]
     if causal:
         Sk = k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)
@@ -57,10 +63,12 @@ def _warn_fallback(e):
             "at long sequence lengths", type(e).__name__, e)
 
 
-def attention_core(q, k, v, causal=True, softmax_scale=None, window=0):
+def attention_core(q, k, v, causal=True, softmax_scale=None, window=0,
+                   alibi_slopes=None):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
     ``window`` > 0 = sliding-window causal attention (Mistral)."""
-    if _use_pallas():
+    if _use_pallas() and alibi_slopes is None:
+        # the flash kernel has no bias hook (yet) — ALiBi takes the XLA path
         try:
             from .pallas.flash_attention import (DEFAULT_BLOCK_K,
                                                  DEFAULT_BLOCK_Q,
@@ -83,4 +91,4 @@ def attention_core(q, k, v, causal=True, softmax_scale=None, window=0):
             except Exception as e:
                 _warn_fallback(e)
     return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
-                          window=window)
+                          window=window, alibi_slopes=alibi_slopes)
