@@ -32,7 +32,7 @@ def build_hf_engine(path: str,
     checkpoint = HuggingFaceCheckpointEngine(path)
     from .ragged_forward import RAGGED_FORWARDS
     model_type = checkpoint.model_config.get("model_type", "llama")
-    if model_type in ("bloom", ):
+    if model_type in ("bloom", "gpt_neox"):
         # ingestable for v1 injection but no ragged forward exists — fail
         # BEFORE ingesting gigabytes of weights
         raise ValueError(

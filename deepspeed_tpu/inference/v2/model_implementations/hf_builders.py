@@ -22,7 +22,7 @@ from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
                          "falcon", "opt", "phi", "qwen2_moe", "qwen",
-                         "bloom")
+                         "bloom", "gpt_neox")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -499,6 +499,22 @@ def _ingest_qwen(cfg: LlamaConfig,
     return _ingest_llama(cfg, gen())
 
 
+def _fused_block_layer_entry(tree, layer, rest, arr, proj_names, ln_names,
+                             arch):
+    """Shared per-layer dispatch for the bloom/gpt-neox style layouts:
+    LayerNorms → scale/bias, listed projections → transposed kernel/bias."""
+    proj, kind = rest.rsplit(".", 1)
+    if proj in ln_names:
+        _set(tree, (layer, proj, "scale" if kind == "weight" else "bias"),
+             arr)
+    elif proj in proj_names:
+        val = np.ascontiguousarray(arr.T) if kind == "weight" else arr
+        _set(tree, (layer, proj, "kernel" if kind == "weight" else "bias"),
+             val)
+    else:
+        logger.warning(f"HF {arch} ingest: skipping {layer}.{rest}")
+
+
 def _bloom_config_from_hf(cfg: dict, dtype: str):
     from ....models.bloom import BloomConfig
     return BloomConfig(
@@ -553,6 +569,58 @@ def _ingest_bloom(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
                 logger.warning(f"HF bloom ingest: skipping {name}")
         else:
             logger.warning(f"HF bloom ingest: skipping {name}")
+    return tree
+
+
+def _gpt_neox_config_from_hf(cfg: dict, dtype: str):
+    from ....models.gpt_neox import GPTNeoXConfig
+    _reject_rope_scaling(cfg, "gpt_neox")
+    return GPTNeoXConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg.get("intermediate_size",
+                                  4 * cfg["hidden_size"]),
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        rotary_pct=cfg.get("rotary_pct", 0.25),
+        rotary_emb_base=cfg.get("rotary_emb_base",
+                                cfg.get("rope_theta", 10000.0)),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+        use_parallel_residual=cfg.get("use_parallel_residual", True),
+        hidden_act=cfg.get("hidden_act", "gelu"),
+        dtype=dtype, remat=False)
+
+
+def _ingest_gpt_neox(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF gpt-neox → flax: the fused head-interleaved ``query_key_value``
+    is kept as-is (the flax block reshapes identically); every weight is a
+    plain transpose."""
+    tree: Dict = {}
+    proj_names = ("query_key_value", "dense", "dense_h_to_4h",
+                  "dense_4h_to_h")
+    ln_names = ("input_layernorm", "post_attention_layernorm")
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES) or ".attention.bias" in name \
+                or ".rotary_emb." in name or ".masked_bias" in name:
+            continue
+        name = name.removeprefix("gpt_neox.")
+        if name == "embed_in.weight":
+            _set(tree, ("embed_in", "embedding"), arr)
+        elif name == "embed_out.weight":
+            _set(tree, ("embed_out", "kernel"), np.ascontiguousarray(arr.T))
+        elif name.startswith("final_layer_norm."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("final_layer_norm",
+                        "scale" if kind == "weight" else "bias"), arr)
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            rest = rest.removeprefix("attention.").removeprefix("mlp.")
+            _fused_block_layer_entry(tree, f"layers_{idx}", rest, arr,
+                                     proj_names=proj_names,
+                                     ln_names=ln_names, arch="gpt_neox")
+        else:
+            logger.warning(f"HF gpt_neox ingest: skipping {name}")
     return tree
 
 
@@ -713,6 +781,11 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _bloom_config_from_hf(hf_cfg, dtype)
         params = _ingest_bloom(cfg, checkpoint_engine.parameters())
         model = BloomModel(cfg)
+    elif model_type == "gpt_neox":
+        from ....models.gpt_neox import GPTNeoXModel
+        cfg = _gpt_neox_config_from_hf(hf_cfg, dtype)
+        params = _ingest_gpt_neox(cfg, checkpoint_engine.parameters())
+        model = GPTNeoXModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -57,6 +57,13 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _gpt_neox_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _gpt_neox_config_from_hf)
+    from ..models.gpt_neox import GPTNeoXModel
+    return GPTNeoXModel(_gpt_neox_config_from_hf(hf_cfg, dtype))
+
+
 def _bloom_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _bloom_config_from_hf)
@@ -98,6 +105,7 @@ POLICIES = {
     "mixtral": InjectionPolicy("mixtral", _mixtral_factory),
     "qwen2_moe": InjectionPolicy("qwen2_moe", _qwen2_moe_factory),
     "bloom": InjectionPolicy("bloom", _bloom_factory),
+    "gpt_neox": InjectionPolicy("gpt_neox", _gpt_neox_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
     "phi": InjectionPolicy("phi", _phi_factory),

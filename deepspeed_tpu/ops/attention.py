@@ -21,10 +21,13 @@ def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0,
     scale = softmax_scale if softmax_scale is not None else D**-0.5
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
     if alibi_slopes is not None:
-        # ALiBi (softmax-invariant form: + slope_h * key_pos)
-        sl = jnp.asarray(alibi_slopes, logits.dtype)
+        # ALiBi (softmax-invariant form: + slope_h * key_pos) in fp32 —
+        # bf16 quantizes slope*position to useless resolution past ~256
+        # (and the decode path computes it in fp32; they must agree)
+        logits = logits.astype(jnp.float32)
+        sl = jnp.asarray(alibi_slopes, jnp.float32)
         logits = logits + sl[None, :, None, None] \
-            * jnp.arange(k.shape[1], dtype=logits.dtype)[None, None, None, :]
+            * jnp.arange(k.shape[1], dtype=jnp.float32)[None, None, None, :]
     if causal:
         Sk = k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), dtype=bool), k=Sk - S)

@@ -366,3 +366,57 @@ def test_hf_bloom_parity_and_v1_serving(tmp_path):
         pad_token_id=0,
         attention_mask=torch.ones(1, 7, dtype=torch.long))[0, 7:].tolist()
     assert np.asarray(out)[0, 7:].tolist() == ref
+
+
+def test_hf_gpt_neox_parity_and_v1_serving(tmp_path):
+    """GPT-NeoX/Pythia (partial rotary, parallel residual, fused
+    interleaved qkv, untied head): logits parity + v1 greedy decode."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.5,
+        max_position_embeddings=128, use_parallel_residual=True)
+    torch.manual_seed(13)
+    hf_model = transformers.GPTNeoXForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "neox")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 14),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+    eng = deepspeed_tpu.init_inference((model, params), dtype="float32")
+    prompt = jnp.asarray(ids[:1, :6], jnp.int32)
+    out = eng.generate(prompt, max_new_tokens=5)
+    hf_model.generation_config.eos_token_id = None
+    ref = hf_model.generate(
+        torch.tensor(ids[:1, :6]), max_new_tokens=5, do_sample=False,
+        pad_token_id=0,
+        attention_mask=torch.ones(1, 6, dtype=torch.long))[0, 6:].tolist()
+    assert np.asarray(out)[0, 6:].tolist() == ref
+
+
+def test_hf_gpt_neox_sequential_residual(tmp_path):
+    """use_parallel_residual=False variant."""
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        max_position_embeddings=128, use_parallel_residual=False)
+    torch.manual_seed(14)
+    hf_model = transformers.GPTNeoXForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "neox-seq")
+    hf_model.save_pretrained(path, safe_serialization=True)
+    model, params = build_model_and_params(
+        HuggingFaceCheckpointEngine(path), dtype="float32")
+    ids = np.random.default_rng(1).integers(0, 96, size=(1, 11),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    np.testing.assert_allclose(ours, _hf_logits(hf_model, ids),
+                               atol=2e-3, rtol=2e-3)
