@@ -13,6 +13,7 @@ from .checkpoint import (CheckpointEngineBase, HuggingFaceCheckpointEngine,
 from .config_v2 import RaggedInferenceEngineConfig
 from .engine_v2 import InferenceEngineV2
 from .model_implementations import build_model_and_params
+from .model_implementations.hf_builders import V1_ONLY_MODEL_TYPES
 
 
 def build_hf_engine(path: str,
@@ -32,7 +33,7 @@ def build_hf_engine(path: str,
     checkpoint = HuggingFaceCheckpointEngine(path)
     from .ragged_forward import RAGGED_FORWARDS
     model_type = checkpoint.model_config.get("model_type", "llama")
-    if model_type in ("bloom", "gpt_neox"):
+    if model_type in V1_ONLY_MODEL_TYPES:
         # ingestable for v1 injection but no ragged forward exists — fail
         # BEFORE ingesting gigabytes of weights
         raise ValueError(

@@ -22,7 +22,10 @@ from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
                          "falcon", "opt", "phi", "qwen2_moe", "qwen",
-                         "bloom", "gpt_neox")
+                         "bloom", "gpt_neox", "gptj")
+
+# ingestable for v1 kernel-injection serving only — no ragged (v2) forward
+V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -624,6 +627,71 @@ def _ingest_gpt_neox(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
     return tree
 
 
+def _gptj_config_from_hf(cfg: dict, dtype: str):
+    from ....models.gptj import GPTJConfig
+    _reject_rope_scaling(cfg, "gptj")
+    return GPTJConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg.get("n_embd", cfg.get("hidden_size")),
+        num_hidden_layers=cfg.get("n_layer", cfg.get("num_hidden_layers")),
+        num_attention_heads=cfg.get("n_head",
+                                    cfg.get("num_attention_heads")),
+        rotary_dim=cfg.get("rotary_dim", 64),
+        intermediate_size=cfg.get("n_inner")
+        or 4 * cfg.get("n_embd", cfg.get("hidden_size")),
+        max_position_embeddings=cfg.get("n_positions", 2048),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+        dtype=dtype, remat=False)
+
+
+def _ingest_gptj(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF gptj → flax (separate unbiased q/k/v/out; one shared ln_1)."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES):
+            continue
+        if name.startswith("lm_head."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("lm_head", "kernel" if kind == "weight" else "bias"),
+                 np.ascontiguousarray(arr.T) if kind == "weight" else arr)
+            continue
+        name = name.removeprefix("transformer.")
+        if name == "wte.weight":
+            _set(tree, ("wte", "embedding"), arr)
+        elif name.startswith("ln_f."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("ln_f", "scale" if kind == "weight" else "bias"),
+                 arr)
+        elif name.startswith("h."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"h_{idx}"
+            if rest.startswith("ln_1."):
+                kind = rest.rsplit(".", 1)[1]
+                _set(tree, (layer, "ln_1",
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif rest.startswith("attn."):
+                sub = rest.removeprefix("attn.")
+                proj = sub.split(".", 1)[0]
+                if proj not in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                    logger.warning(f"HF gptj ingest: skipping {name}")
+                    continue
+                path, value = _attn_param(arr, sub, H, Dh,
+                                          out_name="out_proj")
+                _set(tree, (layer, ) + path, value)
+            elif rest.startswith("mlp."):
+                proj, kind = rest.removeprefix("mlp.").rsplit(".", 1)
+                val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                       else arr)
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"), val)
+            else:
+                logger.warning(f"HF gptj ingest: skipping {name}")
+        else:
+            logger.warning(f"HF gptj ingest: skipping {name}")
+    return tree
+
+
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
     _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
@@ -786,6 +854,11 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _gpt_neox_config_from_hf(hf_cfg, dtype)
         params = _ingest_gpt_neox(cfg, checkpoint_engine.parameters())
         model = GPTNeoXModel(cfg)
+    elif model_type == "gptj":
+        from ....models.gptj import GPTJModel
+        cfg = _gptj_config_from_hf(hf_cfg, dtype)
+        params = _ingest_gptj(cfg, checkpoint_engine.parameters())
+        model = GPTJModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -57,6 +57,13 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _gptj_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _gptj_config_from_hf)
+    from ..models.gptj import GPTJModel
+    return GPTJModel(_gptj_config_from_hf(hf_cfg, dtype))
+
+
 def _gpt_neox_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _gpt_neox_config_from_hf)
@@ -106,6 +113,7 @@ POLICIES = {
     "qwen2_moe": InjectionPolicy("qwen2_moe", _qwen2_moe_factory),
     "bloom": InjectionPolicy("bloom", _bloom_factory),
     "gpt_neox": InjectionPolicy("gpt_neox", _gpt_neox_factory),
+    "gptj": InjectionPolicy("gptj", _gptj_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
     "phi": InjectionPolicy("phi", _phi_factory),

@@ -420,3 +420,36 @@ def test_hf_gpt_neox_sequential_residual(tmp_path):
     ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
     np.testing.assert_allclose(ours, _hf_logits(hf_model, ids),
                                atol=2e-3, rtol=2e-3)
+
+
+def test_hf_gptj_parity_and_v1_serving(tmp_path):
+    """GPT-J (interleaved rotary, one shared ln, unbiased attn projections,
+    biased untied head): logits parity + v1 greedy decode."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    cfg = transformers.GPTJConfig(
+        vocab_size=96, n_embd=32, n_layer=2, n_head=4, rotary_dim=4,
+        n_positions=128, n_inner=None)
+    torch.manual_seed(17)
+    hf_model = transformers.GPTJForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "gptj")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 13),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+    eng = deepspeed_tpu.init_inference((model, params), dtype="float32")
+    prompt = jnp.asarray(ids[:1, :6], jnp.int32)
+    out = eng.generate(prompt, max_new_tokens=5)
+    hf_model.generation_config.eos_token_id = None
+    ref = hf_model.generate(
+        torch.tensor(ids[:1, :6]), max_new_tokens=5, do_sample=False,
+        pad_token_id=0,
+        attention_mask=torch.ones(1, 6, dtype=torch.long))[0, 6:].tolist()
+    assert np.asarray(out)[0, 6:].tolist() == ref
