@@ -627,6 +627,18 @@ def _ingest_gpt_neox(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
     return tree
 
 
+def _gptj_rotary_dim(cfg: dict) -> int:
+    rd = cfg.get("rotary_dim", 64)
+    if rd is None:
+        # HF's null-rotary path builds the sincos table at embed_dim, a
+        # different frequency progression than head_dim — every released
+        # GPT-J checkpoint sets rotary_dim, so refuse rather than serve a
+        # subtly different rotation
+        raise ValueError("gptj with rotary_dim=null is not supported "
+                         "(set an explicit rotary_dim)")
+    return int(rd)
+
+
 def _gptj_config_from_hf(cfg: dict, dtype: str):
     from ....models.gptj import GPTJConfig
     _reject_rope_scaling(cfg, "gptj")
@@ -636,7 +648,7 @@ def _gptj_config_from_hf(cfg: dict, dtype: str):
         num_hidden_layers=cfg.get("n_layer", cfg.get("num_hidden_layers")),
         num_attention_heads=cfg.get("n_head",
                                     cfg.get("num_attention_heads")),
-        rotary_dim=cfg.get("rotary_dim", 64),
+        rotary_dim=_gptj_rotary_dim(cfg),
         intermediate_size=cfg.get("n_inner")
         or 4 * cfg.get("n_embd", cfg.get("hidden_size")),
         max_position_embeddings=cfg.get("n_positions", 2048),

@@ -55,25 +55,54 @@ def test_decode_heavy_keeps_flat_layout():
     eng.flush(range(4))
 
 
+_GEN_SNIPPET = """
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+# share the suite's persistent compile cache — a cold subprocess would
+# otherwise recompile for minutes
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DS_TPU_TEST_CACHE",
+                                 os.path.join("tests", ".jax_cache")))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+import numpy as np
+from tests.unit.inference.test_atom_prefill import _engine
+rng = np.random.default_rng(2)
+prompts = [rng.integers(0, 96, size=n).tolist() for n in (23, 9, 2, 17)]
+outs = []
+for atom in (0, 8):
+    cfg, eng = _engine(atom=atom)
+    outs.append(eng.generate(prompts, max_new_tokens=6))
+    eng.flush(range(len(prompts)))
+assert outs[0] == outs[1], (outs[0], outs[1])
+print("ATOM_PARITY_OK", outs[0])
+"""
+
+
 @pytest.mark.parametrize("interpret_kernels", [False, True])
-def test_atom_generate_matches_flat(interpret_kernels, monkeypatch):
+def test_atom_generate_matches_flat(interpret_kernels):
     """Greedy generation must be identical with atoms on/off — in the XLA
-    fallback AND through the real Pallas kernels (interpret mode)."""
+    fallback AND through the real Pallas kernels (interpret mode).
+
+    The interpret-mode env gate is read at trace time, so each variant runs
+    in a fresh subprocess — clearing the jit caches in-process would force
+    the whole remaining suite to recompile."""
+    import subprocess
+    import sys
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        "..", "..", ".."))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     if interpret_kernels:
-        monkeypatch.setenv("DS_TPU_TEST_PAGED_INTERPRET", "1")
-    # the env gate is read at TRACE time: drop cached traces so this
-    # parametrization actually takes its branch (and clear after, so stale
-    # interpret-mode traces don't leak into later tests)
-    jax.clear_caches()
-    rng = np.random.default_rng(2)
-    prompts = [rng.integers(0, 96, size=n).tolist() for n in (23, 9, 2, 17)]
-    outs = []
-    for atom in (0, 8):
-        cfg, eng = _engine(atom=atom)
-        outs.append(eng.generate(prompts, max_new_tokens=6))
-        eng.flush(range(len(prompts)))
-    assert outs[0] == outs[1]
-    jax.clear_caches()
+        env["DS_TPU_TEST_PAGED_INTERPRET"] = "1"
+    else:
+        env.pop("DS_TPU_TEST_PAGED_INTERPRET", None)
+    proc = subprocess.run([sys.executable, "-c", _GEN_SNIPPET], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ATOM_PARITY_OK" in proc.stdout
 
 
 def test_decode_overflow_does_not_collide():

@@ -453,3 +453,24 @@ def test_hf_gptj_parity_and_v1_serving(tmp_path):
         pad_token_id=0,
         attention_mask=torch.ones(1, 6, dtype=torch.long))[0, 6:].tolist()
     assert np.asarray(out)[0, 6:].tolist() == ref
+
+
+def test_hf_gptj_null_rotary_dim(tmp_path):
+    """rotary_dim: null (HF's embed_dim-table rotary quirk) is rejected
+    loudly instead of served with a subtly different rotation."""
+    import json as _json
+    cfg = transformers.GPTJConfig(
+        vocab_size=96, n_embd=32, n_layer=1, n_head=4, rotary_dim=None,
+        n_positions=64)
+    torch.manual_seed(19)
+    hf_model = transformers.GPTJForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "gptj-null-rd")
+    hf_model.save_pretrained(path, safe_serialization=True)
+    # ensure the saved config really carries null
+    saved = _json.loads((tmp_path / "gptj-null-rd" / "config.json")
+                        .read_text())
+    assert saved.get("rotary_dim", "missing") in (None, "missing")
+    with pytest.raises(ValueError, match="rotary_dim"):
+        build_model_and_params(HuggingFaceCheckpointEngine(path),
+                               dtype="float32")
