@@ -22,10 +22,10 @@ from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
                          "falcon", "opt", "phi", "qwen2_moe", "qwen",
-                         "bloom", "gpt_neox", "gptj")
+                         "bloom", "gpt_neox", "gptj", "bert")
 
 # ingestable for v1 kernel-injection serving only — no ragged (v2) forward
-V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj")
+V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj", "bert")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -704,6 +704,112 @@ def _ingest_gptj(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
     return tree
 
 
+def _bert_config_from_hf(cfg: dict, dtype: str):
+    from ....models.bert import BertConfig
+    if cfg.get("hidden_act", "gelu") != "gelu":
+        raise ValueError(f"bert hidden_act {cfg.get('hidden_act')!r} is "
+                         "not supported (erf gelu only)")
+    archs = cfg.get("architectures") or []
+    if archs and not any("ForMaskedLM" in a for a in archs):
+        raise ValueError(
+            f"bert checkpoint architectures {archs} carry no MLM head — "
+            "only BertForMaskedLM checkpoints are servable (the encoder "
+            "head weights cls.predictions.* are required)")
+    return BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=cfg.get("type_vocab_size", 2),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+        mlm_transform=True, dtype=dtype, remat=False)
+
+
+def _ingest_bert(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF BertForMaskedLM → flax (MLM transform head mapped onto
+    mlm_dense/mlm_ln/mlm_bias; decoder weight is tied to the word
+    embeddings and skipped)."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES) or name.startswith("bert.pooler."):
+            continue
+        if name.startswith("cls.predictions."):
+            rest = name.removeprefix("cls.predictions.")
+            if rest == "bias" or rest == "decoder.bias":
+                _set(tree, ("mlm_bias", ), arr)
+            elif rest == "decoder.weight":
+                continue  # tied to word_embeddings
+            elif rest.startswith("transform.dense."):
+                kind = rest.rsplit(".", 1)[1]
+                _set(tree, ("mlm_dense",
+                            "kernel" if kind == "weight" else "bias"),
+                     np.ascontiguousarray(arr.T) if kind == "weight"
+                     else arr)
+            elif rest.startswith("transform.LayerNorm."):
+                kind = rest.rsplit(".", 1)[1]
+                _set(tree, ("mlm_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF bert ingest: skipping {name}")
+            continue
+        name = name.removeprefix("bert.")
+        if name.startswith("embeddings."):
+            rest = name.removeprefix("embeddings.")
+            base = rest.rsplit(".", 1)[0]
+            if base in ("word_embeddings", "position_embeddings",
+                        "token_type_embeddings"):
+                _set(tree, (base, "embedding"), arr)
+            elif base == "LayerNorm":
+                kind = rest.rsplit(".", 1)[1]
+                _set(tree, ("embeddings_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF bert ingest: skipping {name}")
+        elif name.startswith("encoder.layer."):
+            _, _, idx, rest = name.split(".", 3)
+            layer = f"layer_{idx}"
+            kind = rest.rsplit(".", 1)[1]
+            if rest.startswith("attention.self."):
+                proj = rest.split(".")[2]     # query|key|value
+                if kind == "weight":
+                    D = arr.shape[1]
+                    _set(tree, (layer, proj, "kernel"),
+                         np.ascontiguousarray(arr.T).reshape(D, H, Dh))
+                else:
+                    _set(tree, (layer, proj, "bias"), arr.reshape(H, Dh))
+            elif rest.startswith("attention.output.dense."):
+                if kind == "weight":           # [D, D] → [H, Dh, D]
+                    D = arr.shape[0]
+                    _set(tree, (layer, "attention_output", "kernel"),
+                         np.ascontiguousarray(arr.T).reshape(H, Dh, D))
+                else:
+                    _set(tree, (layer, "attention_output", "bias"), arr)
+            elif rest.startswith("attention.output.LayerNorm."):
+                _set(tree, (layer, "attention_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif rest.startswith("intermediate.dense."):
+                _set(tree, (layer, "intermediate",
+                            "kernel" if kind == "weight" else "bias"),
+                     np.ascontiguousarray(arr.T) if kind == "weight"
+                     else arr)
+            elif rest.startswith("output.dense."):
+                _set(tree, (layer, "output",
+                            "kernel" if kind == "weight" else "bias"),
+                     np.ascontiguousarray(arr.T) if kind == "weight"
+                     else arr)
+            elif rest.startswith("output.LayerNorm."):
+                _set(tree, (layer, "output_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF bert ingest: skipping {name}")
+        else:
+            logger.warning(f"HF bert ingest: skipping {name}")
+    return tree
+
+
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
     _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
@@ -871,6 +977,11 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _gptj_config_from_hf(hf_cfg, dtype)
         params = _ingest_gptj(cfg, checkpoint_engine.parameters())
         model = GPTJModel(cfg)
+    elif model_type == "bert":
+        from ....models.bert import BertModel
+        cfg = _bert_config_from_hf(hf_cfg, dtype)
+        params = _ingest_bert(cfg, checkpoint_engine.parameters())
+        model = BertModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -21,6 +21,9 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # HF BertForMaskedLM head: transform dense+gelu+LN before the tied
+    # decoder, plus a decoder bias — enabled when serving HF checkpoints
+    mlm_transform: bool = False
     dtype: str = "float32"
     remat: bool = False
 
@@ -73,7 +76,7 @@ class BertLayer(nn.Module):
         x = ln(name="attention_ln")(x + attn)
 
         h = dense(features=cfg.intermediate_size, name="intermediate")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # BERT's gelu is the exact erf
         h = dense(features=D, name="output")(h)
         return ln(name="output_ln")(x + h)
 
@@ -108,7 +111,17 @@ class BertModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = layer(cfg, name=f"layer_{i}")(x, attention_mask)
 
-        logits = we.attend(x.astype(jnp.float32))
+        if cfg.mlm_transform:
+            x = nn.Dense(cfg.hidden_size, dtype=dtype,
+                         param_dtype=jnp.float32, name="mlm_dense")(x)
+            x = nn.gelu(x, approximate=False)
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                             param_dtype=jnp.float32, name="mlm_ln")(x)
+            bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+            logits = we.attend(x.astype(jnp.float32)) + bias
+        else:
+            logits = we.attend(x.astype(jnp.float32))
         if labels is None:
             return logits
         from ..sequence.cross_entropy import softmax_cross_entropy_with_logits

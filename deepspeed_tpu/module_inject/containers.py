@@ -57,6 +57,13 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _bert_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _bert_config_from_hf)
+    from ..models.bert import BertModel
+    return BertModel(_bert_config_from_hf(hf_cfg, dtype))
+
+
 def _gptj_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _gptj_config_from_hf)
@@ -114,6 +121,7 @@ POLICIES = {
     "bloom": InjectionPolicy("bloom", _bloom_factory),
     "gpt_neox": InjectionPolicy("gpt_neox", _gpt_neox_factory),
     "gptj": InjectionPolicy("gptj", _gptj_factory),
+    "bert": InjectionPolicy("bert", _bert_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
     "phi": InjectionPolicy("phi", _phi_factory),

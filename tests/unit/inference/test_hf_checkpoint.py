@@ -474,3 +474,53 @@ def test_hf_gptj_null_rotary_dim(tmp_path):
     with pytest.raises(ValueError, match="rotary_dim"):
         build_model_and_params(HuggingFaceCheckpointEngine(path),
                                dtype="float32")
+
+
+def test_hf_bert_mlm_parity(tmp_path):
+    """BertForMaskedLM (the reference's ORIGINAL container family): MLM
+    logits parity incl. the transform head and tied decoder + bias."""
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64)
+    torch.manual_seed(23)
+    hf_model = transformers.BertForMaskedLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "bert")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    assert "mlm_dense" in params and "mlm_bias" in params
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 12),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+    # masked positions respected through the attention_mask path
+    am = np.ones((2, 12), np.int64)
+    am[:, 9:] = 0
+    ours_m = np.asarray(model.apply({"params": params},
+                                    ids.astype(np.int32),
+                                    attention_mask=am.astype(np.int32)))
+    with torch.no_grad():
+        theirs_m = hf_model(torch.tensor(ids),
+                            attention_mask=torch.tensor(am)
+                            ).logits.float().numpy()
+    np.testing.assert_allclose(ours_m[:, :9], theirs_m[:, :9],
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_hf_bert_without_mlm_head_rejected(tmp_path):
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64)
+    model = transformers.BertModel(cfg)
+    path = str(tmp_path / "bert-encoder")
+    model.save_pretrained(path, safe_serialization=True)
+    with pytest.raises(ValueError, match="MaskedLM"):
+        build_model_and_params(HuggingFaceCheckpointEngine(path),
+                               dtype="float32")
