@@ -23,9 +23,11 @@ def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0,
     if alibi_slopes is not None:
         # ALiBi (softmax-invariant form: + slope_h * key_pos) in fp32 —
         # bf16 quantizes slope*position to useless resolution past ~256
-        # (and the decode path computes it in fp32; they must agree)
+        # (and the decode path computes it in fp32; they must agree).
+        # Slopes are positional constants, never trained (matches the
+        # flash kernel's stop_gradient).
         logits = logits.astype(jnp.float32)
-        sl = jnp.asarray(alibi_slopes, jnp.float32)
+        sl = jax.lax.stop_gradient(jnp.asarray(alibi_slopes, jnp.float32))
         logits = logits + sl[None, :, None, None] \
             * jnp.arange(k.shape[1], dtype=jnp.float32)[None, None, None, :]
     if causal:
@@ -70,8 +72,7 @@ def attention_core(q, k, v, causal=True, softmax_scale=None, window=0,
                    alibi_slopes=None):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
     ``window`` > 0 = sliding-window causal attention (Mistral)."""
-    if _use_pallas() and alibi_slopes is None:
-        # the flash kernel has no bias hook (yet) — ALiBi takes the XLA path
+    if _use_pallas():
         try:
             from .pallas.flash_attention import (DEFAULT_BLOCK_K,
                                                  DEFAULT_BLOCK_Q,
@@ -90,7 +91,8 @@ def attention_core(q, k, v, causal=True, softmax_scale=None, window=0,
                 return flash_attention(q, k, v, causal=causal,
                                        softmax_scale=softmax_scale,
                                        window=window, block_q=bq,
-                                       block_k=bk)
+                                       block_k=bk,
+                                       alibi_slopes=alibi_slopes)
             except Exception as e:
                 _warn_fallback(e)
     return _xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,

@@ -85,6 +85,16 @@ def _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
     return mask
 
 
+def _alibi_bias(s, slopes_ref, h, k_start, alibi):
+    """Softmax-invariant ALiBi: + slope_h * absolute key position.  ONE
+    definition shared by the forward and both backward kernels so the
+    recomputed probabilities can never diverge from the forward pass."""
+    if not alibi:
+        return s
+    col = k_start + jax.lax.broadcasted_iota(jnp.float32, s.shape, 1)
+    return s + slopes_ref[h, 0] * col
+
+
 def _block_live(q_start, k_start, causal, sq, sk, block_q, block_k=None,
                 window=0):
     """Whether this K block contributes at all (static-shape early-out).
@@ -101,8 +111,10 @@ def _block_live(q_start, k_start, causal, sq, sk, block_q, block_k=None,
 
 
 # --------------------------------------------------------------------- fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, sq, sk, block_q, block_k, window):
+def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale, causal, sq, sk, block_q, block_k,
+                window, alibi):
+    ih = pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -121,6 +133,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _alibi_bias(s, slopes_ref, ih, k_start, alibi)
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
                            window)
         s = jnp.where(mask, s, _NEG_INF)
@@ -157,7 +170,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, 0] = _col_to_row(lse)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
+def _fwd(q, k, v, slopes, causal, scale, block_q, block_k, sq, sk,
+         window, alibi):
     """Core on padded [B,H,S,D] inputs; sq/sk are the unpadded lengths."""
     B, Hq, sq_p, D = q.shape
     _, Hkv, sk_p, _ = k.shape
@@ -166,7 +180,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                sq=sq, sk=sk, block_q=block_q,
-                               block_k=block_k, window=window)
+                               block_k=block_k, window=window,
+                               alibi=alibi)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, Hq, nq, nk),
@@ -176,6 +191,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
                          lambda b, h, i, j: (b, kv_head(h), j, 0)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, kv_head(h), j, 0)),
+            pl.BlockSpec((Hq, 1), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -194,14 +211,15 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, slopes)
     return o, lse
 
 
 # --------------------------------------------------------------------- bwd
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, sq, sk, block_q, block_k,
-                   window):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   slopes_ref, dq_ref, acc_ref, *, scale, causal, sq, sk,
+                   block_q, block_k, window, alibi):
+    ih = pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -222,6 +240,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _alibi_bias(s, slopes_ref, ih, k_start, alibi)
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
                            window)
         # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
@@ -237,9 +256,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                    dv_ref, dk_acc, dv_acc, *, scale, causal, sq, sk, block_q,
-                    block_k, window):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    slopes_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    causal, sq, sk, block_q, block_k, window, alibi):
+    ih = pl.program_id(1)
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -261,6 +281,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         delta = _row_to_col(delta_ref[0, 0])
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = _alibi_bias(s, slopes_ref, ih, k_start, alibi)
         mask = _score_mask(q_start, k_start, causal, sq, sk, block_q, block_k,
                            window)
         # dead rows carry the finite _DEAD_ROW_LSE sentinel; their positions
@@ -281,8 +302,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
-         sk, window):
+def _bwd(q, k, v, o, lse, do, slopes, causal, scale, block_q, block_k,
+         sq, sk, window, alibi):
     B, Hq, sq_p, D = q.shape
     _, Hkv, sk_p, _ = k.shape
     nq, nk = sq_p // block_q, sk_p // block_k
@@ -300,7 +321,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, sq=sq,
                           sk=sk, block_q=block_q, block_k=block_k,
-                          window=window),
+                          window=window, alibi=alibi),
         grid=(B, Hq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -311,6 +332,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((Hq, 1), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -318,14 +341,14 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=semantics,
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
 
     # dk/dv are produced per *query* head ([B,Hq,Sk,D]) and group-summed to
     # KV heads afterwards — the GQA head fan-in.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, sq=sq,
                           sk=sk, block_q=block_q, block_k=block_k,
-                          window=window),
+                          window=window, alibi=alibi),
         grid=(B, Hq, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -336,6 +359,8 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, j)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, j)),
+            pl.BlockSpec((Hq, 1), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -351,7 +376,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
         ],
         compiler_params=semantics,
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
     if Hq != Hkv:
         g = Hq // Hkv
         dk = dk.reshape(B, Hkv, g, sk_p, D).sum(axis=2).astype(k.dtype)
@@ -361,21 +386,27 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq,
 
 # ------------------------------------------------------------------ public
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
-    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window)
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, slopes, causal, scale, block_q, block_k, sq, sk, window,
+           alibi):
+    o, _ = _fwd(q, k, v, slopes, causal, scale, block_q, block_k, sq, sk,
+                window, alibi)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window):
-    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, sq, sk, window)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, slopes, causal, scale, block_q, block_k, sq, sk,
+               window, alibi):
+    o, lse = _fwd(q, k, v, slopes, causal, scale, block_q, block_k, sq, sk,
+                  window, alibi)
+    return o, (q, k, v, slopes, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, sq, sk, window, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, sq, sk,
-                window)
+def _flash_bwd(causal, scale, block_q, block_k, sq, sk, window, alibi, res,
+               do):
+    q, k, v, slopes, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, slopes, causal, scale, block_q,
+                      block_k, sq, sk, window, alibi)
+    return dq, dk, dv, jnp.zeros_like(slopes)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -383,7 +414,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal=True, softmax_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    window=0):
+                    window=0, alibi_slopes=None):
     """[B, S, H, D] flash attention with GQA (Hkv | Hq) support.
 
     Differentiable (custom VJP with flash recomputation).  S and D need not be
@@ -404,6 +435,12 @@ def flash_attention(q, k, v, causal=True, softmax_scale=None,
     qt = _pad_to(_pad_to(q.transpose(0, 2, 1, 3), 2, block_q), 3, 128)
     kt = _pad_to(_pad_to(k.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
     vt = _pad_to(_pad_to(v.transpose(0, 2, 1, 3), 2, block_k), 3, 128)
-    o = _flash(qt, kt, vt, bool(causal), scale, block_q, block_k, sq, sk,
-               int(window))
+    alibi = alibi_slopes is not None
+    # slopes are positional constants (ALiBi), not trainable parameters —
+    # stop_gradient makes that explicit and keeps TPU/XLA paths consistent
+    slopes = (jax.lax.stop_gradient(
+        jnp.asarray(alibi_slopes, jnp.float32).reshape(Hq, 1))
+        if alibi else jnp.zeros((Hq, 1), jnp.float32))
+    o = _flash(qt, kt, vt, slopes, bool(causal), scale, block_q, block_k,
+               sq, sk, int(window), alibi)
     return o[:, :, :sq, :D].transpose(0, 2, 1, 3)
