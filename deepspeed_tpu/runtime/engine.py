@@ -278,6 +278,25 @@ class DeepSpeedEngine:
                 raise NotImplementedError(
                     "progressive_layer_drop cannot combine with "
                     "zero_quantized_gradients or 1-bit optimizers")
+            import inspect
+            target = model.__call__ if self._flax else model
+            # non-flax models additionally receive the rng key explicitly
+            # (flax models get it via the "pld" rng collection)
+            needed = (("pld_theta", ) if self._flax
+                      else ("pld_theta", "pld_rng"))
+            try:
+                sig_params = inspect.signature(target).parameters
+                has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                                 for p in sig_params.values())
+                accepts = has_var_kw or all(n in sig_params for n in needed)
+            except (TypeError, ValueError):
+                accepts = True  # unintrospectable callables get benefit of doubt
+            if not accepts:
+                raise ValueError(
+                    "progressive_layer_drop is enabled but the model does "
+                    f"not accept {' and '.join(needed)} keyword(s) — use "
+                    "PLD-aware layers (e.g. DeepSpeedTransformerLayer) or "
+                    "disable it")
             from .progressive_layer_drop import ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=pld_cfg.theta, gamma=pld_cfg.gamma)
