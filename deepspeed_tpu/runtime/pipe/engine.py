@@ -60,16 +60,24 @@ class PipelineEngine(DeepSpeedEngine):
         # stacked blocks: leading layer dim sharded over pp
         rules.setdefault("blocks/*", P("pp"))
 
+        # PLD guard must fire BEFORE the base engine's pld signature check
+        # sees our internal apply fn and gives misleading advice
+        if isinstance(config, dict):
+            pld_enabled = ((config.get("progressive_layer_drop") or {})
+                           .get("enabled"))
+        else:  # DeepSpeedConfig object (initialize() pre-parses)
+            pld_cfg = getattr(config, "pld_config", None)
+            pld_enabled = pld_cfg is not None and pld_cfg.enabled
+        if pld_enabled:
+            raise NotImplementedError(
+                "progressive_layer_drop is not supported by the pipeline "
+                "engine (its fused program builds its own apply path); "
+                "disable it or use the base engine")
         super().__init__(args=args, model=self._build_apply(), optimizer=optimizer,
                          model_parameters=model_parameters,
                          training_data=training_data, lr_scheduler=lr_scheduler,
                          collate_fn=collate_fn, config=config, mpu=mpu,
                          tp_rules=rules, **kw)
-        if self.progressive_layer_drop is not None:
-            raise NotImplementedError(
-                "progressive_layer_drop is not supported by the pipeline "
-                "engine (its fused program builds its own apply path); "
-                "disable it or use the base engine")
         # Stage geometry: contiguous uniform split of the block run, padded to
         # equal per-stage counts so the stacked leaves split evenly over "pp".
         # Pad blocks carry a False entry in the valid mask and are skipped

@@ -6,8 +6,6 @@ unmodified' requirement."""
 import numpy as np
 import pytest
 
-import jax
-
 import deepspeed_tpu
 from tests.unit.simple_model import make_simple_mlp_params, simple_mlp_apply
 
