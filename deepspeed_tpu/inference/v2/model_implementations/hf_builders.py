@@ -22,10 +22,12 @@ from ....utils.logging import logger
 
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
                          "falcon", "opt", "phi", "qwen2_moe", "qwen",
-                         "bloom", "gpt_neox", "gptj", "bert")
+                         "bloom", "gpt_neox", "gptj", "bert",
+                         "gpt_neo")
 
 # ingestable for v1 kernel-injection serving only — no ragged (v2) forward
-V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj", "bert")
+V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj", "bert",
+                       "gpt_neo")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -810,6 +812,73 @@ def _ingest_bert(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
     return tree
 
 
+def _gpt_neo_config_from_hf(cfg: dict, dtype: str):
+    from ....models.gpt_neo import GPTNeoConfig
+    act = cfg.get("activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(f"gpt_neo activation_function {act!r} is not "
+                         "supported (gelu_new only)")
+    return GPTNeoConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_hidden_layers=cfg.get("num_layers",
+                                  cfg.get("num_hidden_layers")),
+        num_attention_heads=cfg.get("num_heads",
+                                    cfg.get("num_attention_heads")),
+        intermediate_size=cfg.get("intermediate_size")
+        or 4 * cfg["hidden_size"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        window_size=cfg.get("window_size", 256),
+        attention_layers=tuple(cfg.get("attention_layers",
+                                       ["global", "local"])),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+        dtype=dtype, remat=False)
+
+
+def _ingest_gpt_neo(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF gpt-neo → flax (separate unbiased q/k/v under attn.attention,
+    biased out_proj/mlp, gpt2-style names, tied head)."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    tree: Dict = {}
+    for name, arr in params_iter:
+        if name.endswith(_SKIP_SUFFIXES) or \
+                name.endswith(".attn.attention.bias"):
+            # legacy .bin checkpoints persist the causal-mask buffer
+            continue
+        if name == "lm_head.weight":
+            continue  # tied to wte
+        name = name.removeprefix("transformer.")
+        if name in ("wte.weight", "wpe.weight"):
+            _set(tree, (name.split(".")[0], "embedding"), arr)
+        elif name.startswith("ln_f."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("ln_f", "scale" if kind == "weight" else "bias"),
+                 arr)
+        elif name.startswith("h."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"h_{idx}"
+            rest = rest.removeprefix("attn.attention.") \
+                       .removeprefix("mlp.")
+            proj, kind = rest.rsplit(".", 1)
+            if proj in ("ln_1", "ln_2"):
+                _set(tree, (layer, proj,
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                path, value = _attn_param(arr, rest, H, Dh,
+                                          out_name="out_proj")
+                _set(tree, (layer, ) + path, value)
+            elif proj in ("c_fc", "c_proj"):
+                val = (np.ascontiguousarray(arr.T) if kind == "weight"
+                       else arr)
+                _set(tree, (layer, proj,
+                            "kernel" if kind == "weight" else "bias"), val)
+            else:
+                logger.warning(f"HF gpt_neo ingest: skipping {name}")
+        else:
+            logger.warning(f"HF gpt_neo ingest: skipping {name}")
+    return tree
+
+
 def _falcon_config_from_hf(cfg: dict, dtype: str) -> FalconConfig:
     _reject_rope_scaling(cfg, "falcon")
     if (cfg.get("new_decoder_architecture")
@@ -982,6 +1051,11 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _bert_config_from_hf(hf_cfg, dtype)
         params = _ingest_bert(cfg, checkpoint_engine.parameters())
         model = BertModel(cfg)
+    elif model_type == "gpt_neo":
+        from ....models.gpt_neo import GPTNeoModel
+        cfg = _gpt_neo_config_from_hf(hf_cfg, dtype)
+        params = _ingest_gpt_neo(cfg, checkpoint_engine.parameters())
+        model = GPTNeoModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -57,6 +57,13 @@ def _opt_factory(hf_cfg, dtype="bfloat16"):
     return OPTModel(_opt_config_from_hf(hf_cfg, dtype))
 
 
+def _gpt_neo_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _gpt_neo_config_from_hf)
+    from ..models.gpt_neo import GPTNeoModel
+    return GPTNeoModel(_gpt_neo_config_from_hf(hf_cfg, dtype))
+
+
 def _bert_factory(hf_cfg, dtype="bfloat16"):
     from ..inference.v2.model_implementations.hf_builders import (
         _bert_config_from_hf)
@@ -120,6 +127,7 @@ POLICIES = {
     "qwen2_moe": InjectionPolicy("qwen2_moe", _qwen2_moe_factory),
     "bloom": InjectionPolicy("bloom", _bloom_factory),
     "gpt_neox": InjectionPolicy("gpt_neox", _gpt_neox_factory),
+    "gpt_neo": InjectionPolicy("gpt_neo", _gpt_neo_factory),
     "gptj": InjectionPolicy("gptj", _gptj_factory),
     "bert": InjectionPolicy("bert", _bert_factory),
     "falcon": InjectionPolicy("falcon", _falcon_factory),

@@ -524,3 +524,62 @@ def test_hf_bert_without_mlm_head_rejected(tmp_path):
     with pytest.raises(ValueError, match="MaskedLM"):
         build_model_and_params(HuggingFaceCheckpointEngine(path),
                                dtype="float32")
+
+
+def test_hf_gpt_neo_parity(tmp_path):
+    """GPT-Neo (alternating global/local attention, UNSCALED scores,
+    learned positions, tied head): logits parity vs transformers — the
+    local layers' window must actually bite (window < sequence)."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64, window_size=5,
+        attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(29)
+    hf_model = transformers.GPTNeoForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "gptneo")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    assert model.config.attention_layers == ("global", "local")
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 20),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_gpt_neo_legacy_bin_buffers(tmp_path):
+    """Legacy .bin checkpoints persist attn.attention.bias mask buffers —
+    ingest must skip them; non-gelu_new activations are rejected."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64, window_size=5,
+        attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(31)
+    hf_model = transformers.GPTNeoForCausalLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "gptneo-bin")
+    hf_model.save_pretrained(path, safe_serialization=False)
+    # emulate the legacy persisted causal-mask buffer
+    sd = torch.load(str(tmp_path / "gptneo-bin" / "pytorch_model.bin"),
+                    weights_only=False)
+    sd["transformer.h.0.attn.attention.bias"] = torch.ones(1, 1, 64, 64)
+    torch.save(sd, str(tmp_path / "gptneo-bin" / "pytorch_model.bin"))
+    model, params = build_model_and_params(
+        HuggingFaceCheckpointEngine(path), dtype="float32")
+    ids = np.random.default_rng(3).integers(0, 96, size=(1, 15),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    np.testing.assert_allclose(ours, _hf_logits(hf_model, ids),
+                               atol=2e-3, rtol=2e-3)
+
+    import json as _json
+    cfg_path = tmp_path / "gptneo-bin" / "config.json"
+    c = _json.loads(cfg_path.read_text())
+    c["activation_function"] = "relu"
+    cfg_path.write_text(_json.dumps(c))
+    with pytest.raises(ValueError, match="activation_function"):
+        build_model_and_params(HuggingFaceCheckpointEngine(str(path)),
+                               dtype="float32")
