@@ -250,29 +250,53 @@ class PipelineEngine(DeepSpeedEngine):
 
             def tick_body(carry, t):
                 state, total_loss, logit_acc = carry
-                # stage 0 injects microbatch t (clamped; extra feeds during
-                # drain are overwritten downstream)
-                b = jax.lax.dynamic_index_in_dim(
-                    batch_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                feed = pre_apply(params["pre"], b)
-                x = jnp.where(stage == 0, feed, state)
+                # stage 0 embeds microbatch t; every other stage — and stage
+                # 0's drain ticks (t >= M) — takes the lax.cond false branch
+                # and never executes the embedding.  shard_map is manual
+                # SPMD, so the conditional is a genuine per-rank branch: the
+                # embed/head FLOPs run only on their owning stage, matching
+                # the reference's 1F1B ownership (first stage loads micros,
+                # ``pipe/engine.py:882``; last stage computes loss, ``:583``).
+                def feed_branch(state):
+                    b = jax.lax.dynamic_index_in_dim(
+                        batch_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                    return pre_apply(params["pre"], b)
+
+                x = jax.lax.cond(
+                    jnp.logical_and(stage == 0, t < M),
+                    feed_branch, lambda state: state, state)
                 y = engine_self._stage_scan(params["blocks"], valid_local, x)
                 # last stage finishes microbatch t - (pp - 1)
                 m_idx = t - (pp - 1)
                 m_ok = jnp.logical_and(m_idx >= 0, m_idx < M)
-                lbl = jax.lax.dynamic_index_in_dim(
-                    labels_mb, jnp.clip(m_idx, 0, M - 1), 0, keepdims=False)
-                out = post_apply(params["post"], y)
                 on_last = jnp.logical_and(stage == pp - 1, m_ok)
-                if loss_fn is not None:
-                    l = loss_fn(out, lbl).astype(jnp.float32)
-                    total_loss = total_loss + jnp.where(on_last, l, 0.0)
+
+                def head_branch(y):
+                    lbl = jax.lax.dynamic_index_in_dim(
+                        labels_mb, jnp.clip(m_idx, 0, M - 1), 0,
+                        keepdims=False)
+                    out = post_apply(params["post"], y)
+                    l = (loss_fn(out, lbl).astype(jnp.float32)
+                         if loss_fn is not None else jnp.zeros((), jnp.float32))
+                    if logit_acc is not None:
+                        return l, out.astype(logit_acc.dtype)
+                    return l
+
+                def skip_branch(y):
+                    z = jnp.zeros((), jnp.float32)
+                    if logit_acc is not None:
+                        out_sd = jax.eval_shape(post_apply, params["post"], y)
+                        return z, jnp.zeros(out_sd.shape, logit_acc.dtype)
+                    return z
+
+                head_out = jax.lax.cond(on_last, head_branch, skip_branch, y)
                 if logit_acc is not None:
+                    l, out = head_out
                     logit_acc = jax.lax.dynamic_update_index_in_dim(
-                        logit_acc,
-                        jnp.where(on_last, out,
-                                  jnp.zeros_like(out)).astype(logit_acc.dtype),
-                        jnp.clip(m_idx, 0, M - 1), 0)
+                        logit_acc, out, jnp.clip(m_idx, 0, M - 1), 0)
+                else:
+                    l = head_out
+                total_loss = total_loss + l
                 # neighbor hand-off (ring: last stage's output wraps to stage
                 # 0 where the feed overwrites it)
                 state = jax.lax.ppermute(y, "pp", perm)
