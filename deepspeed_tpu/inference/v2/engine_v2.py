@@ -206,6 +206,14 @@ class InferenceEngineV2:
                 else:
                     start = p_cur
                     room = T - p_cur
+                    if room <= 0 and d_cur < decode_cap:
+                        # prefill region exhausted but decode rows are free:
+                        # advance this sequence by ONE token through a spare
+                        # decode row.  Exact: the decode path masks keys by
+                        # position, and every earlier token of the sequence
+                        # is already in cache (round-2 advisor finding —
+                        # schedulable work was left on the table)
+                        start, room = d_cur, 1
                 if room <= 0:
                     continue
             else:

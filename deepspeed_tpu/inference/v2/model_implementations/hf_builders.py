@@ -809,6 +809,15 @@ def _ingest_bert(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
                 logger.warning(f"HF bert ingest: skipping {name}")
         else:
             logger.warning(f"HF bert ingest: skipping {name}")
+    # a config.json without an "architectures" list slips past the
+    # _bert_config_from_hf guard — re-check on the ingested tree so a
+    # headless checkpoint fails HERE with the real reason, not later
+    # inside flax apply with an opaque missing-param error
+    if "mlm_dense" not in tree or "mlm_bias" not in tree:
+        raise ValueError(
+            "bert checkpoint carries no MLM head weights "
+            "(cls.predictions.*) — only BertForMaskedLM checkpoints are "
+            "servable")
     return tree
 
 

@@ -24,6 +24,11 @@ class ScaleByAdamState(NamedTuple):
     count: jnp.ndarray  # int32 scalar
     mu: any
     nu: any
+    # f32 scalar; NaN = follow the configured lr/schedule.  A runtime state
+    # leaf (not a baked constant) so torch-API writes to
+    # ``optimizer.param_groups[0]["lr"]`` take effect in the already-compiled
+    # step without recompilation (reference FusedAdam honors such writes).
+    lr_override: any = None
 
 
 class GradientTransformation(NamedTuple):
@@ -34,6 +39,20 @@ class GradientTransformation(NamedTuple):
 
 def _bias_correction(decay, count):
     return 1.0 - decay**count
+
+
+def no_lr_override():
+    """Initial ``lr_override`` leaf: NaN = follow the configured schedule."""
+    return jnp.full((), jnp.nan, jnp.float32)
+
+
+def resolve_lr(cur_lr, state):
+    """Effective lr: the runtime ``lr_override`` state leaf when set (via
+    ``optimizer.param_groups[0]['lr'] = x``), else the schedule's value."""
+    ov = getattr(state, "lr_override", None)
+    if ov is None:
+        return cur_lr
+    return jnp.where(jnp.isnan(ov), cur_lr, ov)
 
 
 def fused_adam(lr=1e-3,
@@ -53,11 +72,12 @@ def fused_adam(lr=1e-3,
     def init(params):
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
         nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
-        return ScaleByAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu)
+        return ScaleByAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu,
+                                lr_override=no_lr_override())
 
     def update(grads, state, params):
         count = state.count + 1
-        cur_lr = lr_fn(count) if lr_fn is not None else lr
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
 
         def upd(g, m, v, p):
             g = g.astype(jnp.float32)
@@ -84,7 +104,8 @@ def fused_adam(lr=1e-3,
         updates = treedef.unflatten([o[0] for o in outs])
         mu = treedef.unflatten([o[1] for o in outs])
         nu = treedef.unflatten([o[2] for o in outs])
-        return updates, ScaleByAdamState(count=count, mu=mu, nu=nu)
+        return updates, ScaleByAdamState(count=count, mu=mu, nu=nu,
+                                         lr_override=state.lr_override)
 
     return GradientTransformation(init=init, update=update)
 

@@ -72,6 +72,12 @@ def attention_core(q, k, v, causal=True, softmax_scale=None, window=0,
                    alibi_slopes=None):
     """[B, S, H, D] attention; flash kernel on TPU, XLA elsewhere.
     ``window`` > 0 = sliding-window causal attention (Mistral)."""
+    if window and not causal:
+        # validate BEFORE dispatch: the flash path rejects this combination
+        # and the XLA path used to silently ignore the window — both
+        # backends must fail identically (round-2 advisor finding)
+        raise ValueError("window > 0 requires causal=True (sliding-window "
+                         "attention is defined over causal positions)")
     if _use_pallas():
         try:
             from .pallas.flash_attention import (DEFAULT_BLOCK_K,

@@ -11,7 +11,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .adam import GradientTransformation, ScaleByAdamState
+from .adam import (GradientTransformation, ScaleByAdamState,
+                   no_lr_override, resolve_lr)
 from .op_builder import PallasOpBuilder, register_op_builder
 
 
@@ -30,11 +31,12 @@ def fused_lamb(lr=1e-3,
     def init(params):
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
         nu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
-        return ScaleByAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu)
+        return ScaleByAdamState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu,
+                                lr_override=no_lr_override())
 
     def update(grads, state, params):
         count = state.count + 1
-        cur_lr = lr_fn(count) if lr_fn is not None else lr
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
 
         def upd(g, m, v, p):
             g = g.astype(jnp.float32)
@@ -64,7 +66,8 @@ def fused_lamb(lr=1e-3,
         return (treedef.unflatten([o[0] for o in outs]),
                 ScaleByAdamState(count=count,
                                  mu=treedef.unflatten([o[1] for o in outs]),
-                                 nu=treedef.unflatten([o[2] for o in outs])))
+                                 nu=treedef.unflatten([o[2] for o in outs]),
+                                 lr_override=state.lr_override))
 
     return GradientTransformation(init=init, update=update)
 

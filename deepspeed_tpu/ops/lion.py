@@ -9,13 +9,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .adam import GradientTransformation
+from .adam import GradientTransformation, no_lr_override, resolve_lr
 from .op_builder import PallasOpBuilder, register_op_builder
 
 
 class ScaleByLionState(NamedTuple):
     count: jnp.ndarray
     mu: any
+    lr_override: any = None  # see ScaleByAdamState.lr_override
 
 
 def fused_lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, lr_fn=None):
@@ -23,11 +24,12 @@ def fused_lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, lr_fn=None):
 
     def init(params):
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
-        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu)
+        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu,
+                                lr_override=no_lr_override())
 
     def update(grads, state, params):
         count = state.count + 1
-        cur_lr = lr_fn(count) if lr_fn is not None else lr
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
 
         def upd(g, m, p):
             g = g.astype(jnp.float32)
@@ -45,7 +47,8 @@ def fused_lion(lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, lr_fn=None):
         outs = [upd(g, m, p) for g, m, p in zip(flat_g, flat_m, flat_p)]
         return (treedef.unflatten([o[0] for o in outs]),
                 ScaleByLionState(count=count,
-                                 mu=treedef.unflatten([o[1] for o in outs])))
+                                 mu=treedef.unflatten([o[1] for o in outs]),
+                                 lr_override=state.lr_override))
 
     return GradientTransformation(init=init, update=update)
 
@@ -55,13 +58,15 @@ def sgd(lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False, lr_fn=None):
 
     def init(params):
         if momentum == 0.0:
-            return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=())
+            return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=(),
+                                    lr_override=no_lr_override())
         mu = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
-        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu)
+        return ScaleByLionState(count=jnp.zeros((), jnp.int32), mu=mu,
+                                lr_override=no_lr_override())
 
     def update(grads, state, params):
         count = state.count + 1
-        cur_lr = lr_fn(count) if lr_fn is not None else lr
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
 
         def upd(g, m, p):
             g = g.astype(jnp.float32)
@@ -76,14 +81,16 @@ def sgd(lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False, lr_fn=None):
         if momentum == 0.0:
             updates = jax.tree_util.tree_map(
                 lambda g, p: upd(g, None, p)[0], grads, params)
-            return updates, ScaleByLionState(count=count, mu=state.mu)
+            return updates, ScaleByLionState(count=count, mu=state.mu,
+                                             lr_override=state.lr_override)
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_m = treedef.flatten_up_to(state.mu)
         flat_p = treedef.flatten_up_to(params)
         outs = [upd(g, m, p) for g, m, p in zip(flat_g, flat_m, flat_p)]
         return (treedef.unflatten([o[0] for o in outs]),
                 ScaleByLionState(count=count,
-                                 mu=treedef.unflatten([o[1] for o in outs])))
+                                 mu=treedef.unflatten([o[1] for o in outs]),
+                                 lr_override=state.lr_override))
 
     return GradientTransformation(init=init, update=update)
 

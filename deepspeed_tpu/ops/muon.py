@@ -1,0 +1,135 @@
+"""Muon — momentum + Newton-Schulz orthogonalized updates for hidden 2-D
+params, AdamW for everything else.
+
+Config name ``"muon"`` (``runtime/config.py MUON_OPTIMIZER``; later reference
+DeepSpeed versions ship a Muon optimizer — the pinned v0.16.2 names it only).
+TPU fit: the whole update is five matmuls per 2-D param (the Newton-Schulz
+iteration), which lands on the MXU; no data-dependent control flow.
+
+Semantics follow the public Muon recipe (Keller Jordan et al.):
+* hidden-layer 2-D matrices: SGD-momentum accumulate (nesterov optional),
+  then replace the momentum buffer with its approximate orthogonalization
+  NS5(m) scaled by sqrt(max(1, rows/cols));
+* embeddings, LM head, and non-2-D params (biases, norms): AdamW with its
+  own lr — the recipe explicitly EXCLUDES embed/head params from
+  orthogonalization.  Exclusion is by parameter path (``embed``/``wte``/
+  ``wpe``/``head``/``vocab`` substrings) plus an ndim != 2 catch-all;
+  override with the ``exclude`` predicate.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .adam import GradientTransformation, no_lr_override, resolve_lr
+
+# Quintic Newton-Schulz coefficients from the public Muon implementation —
+# tuned for fast convergence of the polar factor at bf16-tolerant precision.
+_NS_COEFFS = (3.4445, -4.7750, 2.0315)
+
+_EXCLUDE_SUBSTRINGS = ("embed", "wte", "wpe", "head", "vocab")
+
+
+class MuonState(NamedTuple):
+    count: jnp.ndarray
+    mu: any   # momentum (muon leaves) / exp_avg (adamw leaves)
+    nu: any   # exp_avg_sq for adamw leaves; scalar placeholder for muon ones
+    lr_override: any = None
+
+
+def default_muon_exclude(path, leaf):
+    """True → AdamW; the public recipe excludes embeddings/head and every
+    non-2-D parameter from orthogonalization."""
+    if leaf.ndim != 2:
+        return True
+    lowered = path.lower()
+    return any(s in lowered for s in _EXCLUDE_SUBSTRINGS)
+
+
+def newton_schulz_orthogonalize(g, steps=5, eps=1e-7):
+    """Approximate UV^T (polar factor) of a 2-D matrix via the quintic
+    Newton-Schulz iteration; runs in float32 on the MXU."""
+    a, b, c = _NS_COEFFS
+    x = g.astype(jnp.float32)
+    transposed = x.shape[0] > x.shape[1]
+    if transposed:
+        x = x.T
+    x = x / (jnp.linalg.norm(x) + eps)
+
+    def body(x, _):
+        xxt = x @ x.T
+        return a * x + (b * xxt + c * (xxt @ xxt)) @ x, None
+
+    x, _ = jax.lax.scan(body, x, None, length=steps)
+    if transposed:
+        x = x.T
+    return x
+
+
+def muon(lr=2e-2, momentum=0.95, nesterov=True, ns_steps=5,
+         weight_decay=0.0, adamw_lr=3e-4, adamw_betas=(0.9, 0.95),
+         adamw_eps=1e-8, exclude=default_muon_exclude, lr_fn=None):
+    """Muon GradientTransformation (engine-facing, ZeRO/TP compatible: pure
+    per-leaf math plus matmuls — GSPMD shards them like any other op).
+
+    ``lr``/``lr_fn`` drive the muon leaves; ``adamw_lr`` scales
+    proportionally when a schedule is active (adamw_lr · lr_t / lr)."""
+    b1, b2 = adamw_betas
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        nu = treedef.unflatten([
+            jnp.zeros_like(leaf, dtype=jnp.float32)
+            if exclude(jax.tree_util.keystr(kp), leaf)
+            else jnp.zeros((), jnp.float32)  # placeholder: muon leaf
+            for kp, leaf in flat])
+        return MuonState(count=jnp.zeros((), jnp.int32), mu=mu, nu=nu,
+                         lr_override=no_lr_override())
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
+        aw_lr = adamw_lr * (cur_lr / lr)  # follow the schedule's shape
+        bc1 = 1.0 - b1**count.astype(jnp.float32)
+        bc2 = 1.0 - b2**count.astype(jnp.float32)
+
+        def upd_muon(g, m, p):
+            g = g.astype(jnp.float32)
+            m_ = momentum * m + g
+            d = (g + momentum * m_) if nesterov else m_
+            o = newton_schulz_orthogonalize(d, steps=ns_steps)
+            d = o * jnp.sqrt(jnp.maximum(1.0, p.shape[0] / p.shape[1]))
+            if weight_decay != 0.0:
+                d = d + weight_decay * p.astype(jnp.float32)
+            return (-cur_lr * d).astype(p.dtype), m_, jnp.zeros((),
+                                                               jnp.float32)
+
+        def upd_adamw(g, m, v, p):
+            g = g.astype(jnp.float32)
+            m_ = b1 * m + (1 - b1) * g
+            v_ = b2 * v + (1 - b2) * (g * g)
+            step = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + adamw_eps)
+            if weight_decay != 0.0:
+                step = step + weight_decay * p.astype(jnp.float32)
+            return (-aw_lr * step).astype(p.dtype), m_, v_
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        outs = []
+        for (kp, g), m, v, p in zip(flat, flat_m, flat_v, flat_p):
+            if exclude(jax.tree_util.keystr(kp), p):
+                outs.append(upd_adamw(g, m, v, p))
+            else:
+                outs.append(upd_muon(g, m, p))
+        return (treedef.unflatten([o[0] for o in outs]),
+                MuonState(count=count,
+                          mu=treedef.unflatten([o[1] for o in outs]),
+                          nu=treedef.unflatten([o[2] for o in outs]),
+                          lr_override=state.lr_override))
+
+    return GradientTransformation(init=init, update=update)

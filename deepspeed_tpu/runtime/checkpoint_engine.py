@@ -24,6 +24,22 @@ import numpy as np
 from ..utils.logging import log_dist, logger
 
 
+def _strip_lr_override(opt_state):
+    """The ``lr_override`` leaf is ephemeral runtime state (a torch-API
+    ``param_groups`` write), not training state — keep it OUT of the on-disk
+    layout so checkpoints stay loadable across revisions that added it."""
+    if hasattr(opt_state, "lr_override") and opt_state.lr_override is not None:
+        return opt_state._replace(lr_override=None)
+    return opt_state
+
+
+def _reattach_lr_override(restored, current):
+    if hasattr(restored, "lr_override") and \
+            getattr(current, "lr_override", None) is not None:
+        return restored._replace(lr_override=current.lr_override)
+    return restored
+
+
 def _pytree_save(path, tree):
     import orbax.checkpoint as ocp
     ckptr = ocp.PyTreeCheckpointer()
@@ -119,7 +135,7 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     if engine.master is not None:
         trees.append(("master", engine.master))
     if engine.opt_state is not None:
-        trees.append(("optim", engine.opt_state))
+        trees.append(("optim", _strip_lr_override(engine.opt_state)))
     latest_path = (os.path.join(os.path.abspath(save_dir), "latest")
                    if save_latest else None)
 
@@ -183,9 +199,13 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
         if load_optimizer_states and engine.opt_state is not None and \
                 os.path.isdir(os.path.join(root, "optim")):
             target = engine.master if engine.master is not None else engine.params
-            engine.opt_state = _pytree_restore(
-                os.path.join(root, "optim"), template=engine.opt_state,
-                shardings=engine._opt_state_shardings(target))
+            restored = _pytree_restore(
+                os.path.join(root, "optim"),
+                template=_strip_lr_override(engine.opt_state),
+                shardings=_strip_lr_override(
+                    engine._opt_state_shardings(target)))
+            engine.opt_state = _reattach_lr_override(restored,
+                                                     engine.opt_state)
         if load_lr_scheduler_states and engine.lr_scheduler is not None and \
                 "lr_scheduler" in state and hasattr(engine.lr_scheduler,
                                                     "load_state_dict"):

@@ -50,15 +50,31 @@ from .zero.partition import ZeroPartitionPlan
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
 
+class _ParamGroup(dict):
+    """torch-style param group whose ``["lr"] = x`` writes reach the compiled
+    step: the engine routes the value into the optimizer state's runtime
+    ``lr_override`` leaf (no recompile).  Reference torch schedulers mutate
+    ``param_groups[0]["lr"]`` directly and FusedAdam honors it."""
+
+    def __init__(self, engine, **kw):
+        super().__init__(**kw)
+        self._engine = engine
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        if key == "lr" and value is not None:
+            self._engine._set_client_lr(float(value))
+
+
 class _OptimizerFacade:
     """torch-optimizer-shaped view of the engine's optimizer state, for user
     code that expects ``initialize()``'s second return value (reference returns
     the wrapped torch optimizer).  ``param_groups`` exposes lr for schedulers
-    written against the torch API."""
+    written against the torch API; writes take effect (see ``_ParamGroup``)."""
 
     def __init__(self, engine):
         self._engine = engine
-        self.param_groups = [{"lr": None}]
+        self.param_groups = [_ParamGroup(engine, lr=None)]
 
     def state_dict(self):
         return {"opt_state": self._engine.opt_state}
@@ -238,6 +254,9 @@ class DeepSpeedEngine:
         self.opt_state = None
         self.grad_acc = None
         self.scale_state = None
+        self._pending_client_lr = None  # torch-API param_groups lr write
+        self._last_loss = None          # reported loss for monitor events
+        self._micro_losses = []         # gas-window losses (device scalars)
         self._configure_nvme_swapper(zc)
         if model_parameters is not None:
             self._install_parameters(model_parameters)
@@ -389,8 +408,9 @@ class DeepSpeedEngine:
         from ..ops.adam import fused_adam
         from ..ops.lamb import fused_lamb
         from ..ops.lion import fused_lion, sgd
-        from .config import (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
-                             ZERO_ONE_ADAM_OPTIMIZER)
+        from ..ops.muon import muon
+        from .config import (MUON_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+                             ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER)
 
         cfg = self._config
         lr_fn = None
@@ -447,9 +467,16 @@ class DeepSpeedEngine:
                 self._grad_transform = sgd(
                     lr=lr, momentum=p.pop("momentum", 0.0),
                     weight_decay=p.pop("weight_decay", 0.0), lr_fn=lr_fn)
+            elif name == MUON_OPTIMIZER:
+                self._grad_transform = muon(
+                    lr=lr, momentum=p.pop("momentum", 0.95),
+                    nesterov=p.pop("nesterov", True),
+                    ns_steps=p.pop("ns_steps", 5),
+                    weight_decay=p.pop("weight_decay", 0.0), lr_fn=lr_fn)
             else:
                 raise ValueError(f"unsupported optimizer {name!r} (have: adam, "
-                                 "adamw, fusedadam, lamb, fusedlamb, lion, sgd)")
+                                 "adamw, fusedadam, lamb, fusedlamb, lion, "
+                                 "sgd, muon)")
         else:
             self._grad_transform = fused_adam(lr=1e-3, lr_fn=lr_fn)
 
@@ -461,6 +488,8 @@ class DeepSpeedEngine:
             self.opt_state = jax.jit(
                 self._grad_transform.init,
                 out_shardings=self._opt_state_shardings(target))(target)
+            if self._pending_client_lr is not None:
+                self._set_client_lr(self._pending_client_lr)
             if self._nvme_swapper is not None:
                 # NVMe offload: state leaves HBM right away (reference
                 # stage3.py swaps states out at init, not lazily)
@@ -592,10 +621,40 @@ class DeepSpeedEngine:
         return self._config.gradient_clipping
 
     def get_lr(self):
+        if self._pending_client_lr is not None:
+            return [self._pending_client_lr]
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
             return [float(self.lr_scheduler.get_lr(
                 jnp.asarray(max(1, self.global_steps))))]
         return [None]
+
+    def _scheduler_reclaims_lr(self):
+        """Reference semantics: an engine-managed lr scheduler rewrites
+        ``param_groups`` every step, so a one-off client lr write lasts only
+        until the scheduler's next step.  Mirror that by clearing the
+        override whenever the managed scheduler steps."""
+        if self._pending_client_lr is None:
+            return
+        self._pending_client_lr = None
+        if self.opt_state is not None and hasattr(self.opt_state,
+                                                  "lr_override"):
+            self.opt_state = self.opt_state._replace(
+                lr_override=jnp.full((), jnp.nan, jnp.float32))
+
+    def _set_client_lr(self, value):
+        """Route a torch-API ``param_groups[0]["lr"]`` write into the
+        optimizer state's runtime ``lr_override`` leaf so the already-compiled
+        step picks it up without recompilation."""
+        self._pending_client_lr = value
+        if self.opt_state is None:
+            return  # applied when the state is created
+        if not hasattr(self.opt_state, "lr_override"):
+            raise NotImplementedError(
+                "this optimizer does not support torch-style lr writes via "
+                "param_groups (client/1-bit optimizers manage their own lr); "
+                "use an lr scheduler in the config instead")
+        self.opt_state = self.opt_state._replace(
+            lr_override=jnp.full((), value, jnp.float32))
 
     @property
     def cur_scale(self):
@@ -823,6 +882,7 @@ class DeepSpeedEngine:
         micro = self._get_compiled_micro(inputs)
         loss, grads = micro(self.params, self.scale_state.scale, inputs)
         self._stashed_grads = grads
+        self._micro_losses.append(loss)  # device scalar; synced only on report
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._maybe_profile_flops(inputs)
         return loss
@@ -930,10 +990,17 @@ class DeepSpeedEngine:
                          f"scale → {self.cur_scale}", ranks=[0])
             if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
                 self.lr_scheduler.step()
+                self._scheduler_reclaims_lr()
             if self.curriculum_scheduler is not None:
                 self.curriculum_scheduler.update_difficulty(self.global_steps)
             for hook in self._post_step_hooks:
                 hook(self)
+            if self._micro_losses:
+                # the step's loss = mean over the gas window (reference
+                # engine.py:2029 logs the accumulated mean, not the last
+                # microbatch)
+                self._last_loss = self._micro_losses
+                self._micro_losses = []
             self._report_step_metrics(gnorm)
         self.micro_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -943,6 +1010,14 @@ class DeepSpeedEngine:
                 self._config.steps_per_print == 0:
             events = [("Train/Samples/lr", self.get_lr()[0] or 0.0,
                        self.global_samples)]
+            if self._last_loss is not None:
+                # reference writes Train/Samples/train_loss every logged step
+                # (engine.py:2029) — the loss curve is the monitor's main job
+                ll = self._last_loss
+                val = (float(np.mean([float(l) for l in ll]))
+                       if isinstance(ll, list) else float(ll))
+                events.append(("Train/Samples/train_loss", val,
+                               self.global_samples))
             if self._config.fp16_enabled:
                 events.append(("Train/Samples/loss_scale", self.cur_scale,
                                self.global_samples))

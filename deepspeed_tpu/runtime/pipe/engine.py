@@ -130,6 +130,16 @@ class PipelineEngine(DeepSpeedEngine):
         self.loss_fn = model.loss_fn
 
     # ------------------------------------------------------------- model fns
+    def _forward_full(self, params, x):
+        """pre → blocks → post over the stacked params (the single source
+        of the non-pipelined forward composition)."""
+        for i, layer in enumerate(self.pre_layers):
+            x = layer.apply({"params": params["pre"][f"layer_{i}"]}, x)
+        x = self._stage_scan(params["blocks"], self._block_valid, x)
+        for i, layer in enumerate(self.post_layers):
+            x = layer.apply({"params": params["post"][f"layer_{i}"]}, x)
+        return x
+
     def _build_apply(self):
         """A plain (non-pipelined) apply over the same params — used for
         pp=1 and for numerical-parity tests."""
@@ -138,12 +148,7 @@ class PipelineEngine(DeepSpeedEngine):
         def apply_fn(params, *batch):
             *inputs, labels = batch
             x = inputs[0] if len(inputs) == 1 else tuple(inputs)
-            for i, layer in enumerate(engine_self.pre_layers):
-                x = layer.apply({"params": params["pre"][f"layer_{i}"]}, x)
-            x = engine_self._stage_scan(params["blocks"],
-                                        engine_self._block_valid, x)
-            for i, layer in enumerate(engine_self.post_layers):
-                x = layer.apply({"params": params["post"][f"layer_{i}"]}, x)
+            x = engine_self._forward_full(params, x)
             if engine_self.loss_fn is not None:
                 return engine_self.loss_fn(x, labels)
             return x
@@ -420,6 +425,25 @@ class PipelineEngine(DeepSpeedEngine):
 
         return loss
 
+    def _plain_logits_fn(self):
+        """pp=1 eval with logits (reference ``eval_batch`` returns outputs
+        regardless of pp degree — round-2 raised here)."""
+        engine_self = self
+
+        def one(params, b, l):
+            x = engine_self._forward_full(params, b)
+            loss = (engine_self.loss_fn(x, l).astype(jnp.float32)
+                    if engine_self.loss_fn is not None
+                    else jnp.zeros((), jnp.float32))
+            return loss, x
+
+        def fn(params, batch_mb, labels_mb):
+            losses, logits = jax.vmap(partial(one, params))(batch_mb,
+                                                            labels_mb)
+            return jnp.mean(losses), logits
+
+        return fn
+
     # -------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
         """One full training step over gas microbatches (reference
@@ -462,6 +486,9 @@ class PipelineEngine(DeepSpeedEngine):
             self.skipped_steps += 1
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
+            self._scheduler_reclaims_lr()
+        self._last_loss = loss
+        self._report_step_metrics(None)
         self.tput_timer.stop(global_step=True)
         return loss
 
@@ -478,13 +505,10 @@ class PipelineEngine(DeepSpeedEngine):
         if key not in self._compiled_eval:
             if self.pp_world_size > 1:
                 fn = self._pipe_loss_fn(1, with_logits=return_logits)
+            elif return_logits:
+                fn = self._plain_logits_fn()
             else:
-                plain = self._plain_gas_loss_fn()
-                if return_logits:
-                    raise NotImplementedError(
-                        "return_logits requires pp>1 pipelined eval or the "
-                        "base-engine forward()")
-                fn = plain
+                fn = self._plain_gas_loss_fn()
 
             def eval_fn(params, batch_mb, labels_mb):
                 cp = jax.tree_util.tree_map(

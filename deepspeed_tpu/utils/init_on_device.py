@@ -15,8 +15,16 @@ With a real ``device``, ``init`` simply runs under ``jax.default_device``.
 """
 
 import contextlib
+import contextvars
 
 import jax
+
+# The meta-device patch necessarily rebinds ``nn.Module.init`` process-wide,
+# but the *effect* is scoped per-context: the wrapper abstracts only inits
+# initiated from a thread/context that is inside an OnDevice("meta") block;
+# concurrent unrelated inits on other threads run the original (round-2
+# advisor finding).
+_meta_active = contextvars.ContextVar("ds_on_device_meta", default=False)
 
 
 class OnDevice:
@@ -38,6 +46,8 @@ class OnDevice:
             me = self
 
             def abstract_init(module, rngs, *args, **kwargs):
+                if not _meta_active.get():
+                    return orig_init(module, rngs, *args, **kwargs)
                 out = jax.eval_shape(
                     lambda r, *a: orig_init(module, r, *a, **kwargs),
                     rngs, *args)
@@ -51,6 +61,8 @@ class OnDevice:
 
             nn.Module.init = abstract_init
             self._stack.callback(setattr, nn.Module, "init", orig_init)
+            token = _meta_active.set(True)
+            self._stack.callback(_meta_active.reset, token)
         else:
             dev = (self.device if not isinstance(self.device, str)
                    else jax.devices(self.device)[0])

@@ -183,3 +183,27 @@ def test_paged_kernel_sliding_window(atom):
                            jnp.asarray(pos), block_size=bs, window=W)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_prefill_overflow_uses_free_decode_rows():
+    """When the prefill region fills, remaining work advances through spare
+    decode rows instead of being skipped (round-2 advisor finding)."""
+    cfg, eng = _engine(atom=8, budget=32)  # decode_cap=8, prefill=24
+    rng = np.random.default_rng(5)
+    # three long prompts: 24-token prefill region fits at most 24 tokens;
+    # no decoding sequences, so all 8 decode rows are spare
+    uids = [0, 1, 2]
+    eng.put(uids, [rng.integers(1, 96, size=20).tolist() for _ in uids])
+    before = {u: eng.state_manager.get_sequence(u).seen_tokens for u in uids}
+    batch = eng._build_batch()
+    toks, pos, slots, last_idx, finishing, layout = batch
+    decode_cap, atom = layout
+    assert atom > 0
+    placed = sum(eng.state_manager.get_sequence(u).seen_tokens - before[u]
+                 for u in uids)
+    # one 20-token prompt fills the 24-slot prefill region (3 atom tiles
+    # with pads); the other two sequences each advance 1 token through
+    # spare decode rows instead of being skipped
+    assert placed == 22, (placed, slots.tolist())
+    assert int((slots[:decode_cap] != 0).sum()) == 2
+    eng.flush(uids)

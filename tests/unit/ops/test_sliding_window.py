@@ -147,3 +147,13 @@ def test_flash_alibi_matches_naive():
     for a, b, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+def test_window_requires_causal_on_dispatch():
+    """attention_core validates BEFORE dispatch so the XLA fallback and the
+    flash path fail identically (round-2 advisor: the XLA path silently
+    computed full bidirectional attention)."""
+    from deepspeed_tpu.ops.attention import attention_core
+    q, k, v = _qkv(S=16)
+    with pytest.raises(ValueError, match="causal"):
+        attention_core(q, k, v, causal=False, window=4)

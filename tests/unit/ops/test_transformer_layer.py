@@ -118,3 +118,37 @@ def test_attn_dropout_applies_without_mask():
     outs = [layer.apply({"params": params}, x, deterministic=True)
             for _ in range(2)]
     np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(outs[1]))
+
+
+def test_on_device_meta_scoped_to_entering_thread():
+    """A concurrent init on another thread inside an OnDevice('meta') window
+    materializes REAL params (round-2 advisor: the global patch silently
+    abstracted unrelated inits)."""
+    import threading
+    from deepspeed_tpu.utils.init_on_device import OnDevice
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    x = jnp.ones((2, 4), jnp.float32)
+    other, errs = [], []
+
+    def other_thread():
+        try:
+            other.append(Tiny().init(jax.random.PRNGKey(1), x))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    with OnDevice(device="meta"):
+        abstract = Tiny().init(jax.random.PRNGKey(0), x)
+        t = threading.Thread(target=other_thread)
+        t.start()
+        t.join()
+    assert not errs
+    assert all(isinstance(l, jax.ShapeDtypeStruct)
+               for l in jax.tree_util.tree_leaves(abstract))
+    assert not any(isinstance(l, jax.ShapeDtypeStruct)
+                   for l in jax.tree_util.tree_leaves(other[0]))

@@ -296,3 +296,18 @@ def test_pipe_embed_head_only_on_owning_stage():
         f"vocab embed/projection runs unconditionally on every stage: "
         f"{sorted(uncond_vocab)}")
     _teardown()
+
+
+def test_pipe_eval_batch_logits_pp1():
+    """pp=1 eval_batch(return_logits=True) works (round-2 weak #7: raised)."""
+    engine = _make_engine(pp=1, gas=2)
+    rng = np.random.default_rng(3)
+    W = rng.standard_normal((D, D)).astype(np.float32) * 0.3
+    x0 = rng.standard_normal((4, D)).astype(np.float32)
+    engine.initialize_parameters(0, x0, x0 @ W)
+    x = rng.standard_normal((8, D)).astype(np.float32)
+    loss, logits = engine.eval_batch(iter([(x, x @ W)]), return_logits=True)
+    assert logits.shape == (8, D)
+    expect_mse = float(np.mean((np.asarray(logits) - (x @ W)) ** 2))
+    np.testing.assert_allclose(float(loss), expect_mse, rtol=1e-4)
+    _teardown()

@@ -441,3 +441,104 @@ def test_transformer_layer_pld_drop():
     full = layer.apply({"params": params}, x, deterministic=True)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(full),
                                atol=1e-6)
+
+
+def test_param_groups_lr_write_takes_effect():
+    """torch-API schedulers write ``param_groups[0]["lr"]`` directly; the
+    write must reach the already-compiled step (round-2 weakness: the facade
+    dict was inert).  lr=0 freezes params with no recompile; restoring a real
+    lr resumes training."""
+    params = make_simple_mlp_params(HIDDEN)
+    engine, opt, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(opt="fusedadam"))
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    _train(engine, data, steps=3)
+
+    before = jax.tree_util.tree_map(np.asarray, engine.params)
+    opt.param_groups[0]["lr"] = 0.0
+    assert opt.param_groups[0]["lr"] == 0.0
+    _train(engine, data, steps=2)
+    after = jax.tree_util.tree_map(np.asarray, engine.params)
+    deltas = [float(np.abs(a - b).max()) for a, b in zip(
+        jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after))]
+    assert max(deltas) == 0.0, "lr=0 write did not reach the compiled step"
+
+    opt.param_groups[0]["lr"] = 0.02
+    l = _train(engine, data, steps=4)
+    assert l[-1] < l[0], "training did not resume after lr restore"
+
+
+def test_monitor_records_train_loss(tmp_path):
+    """Reference writes Train/Samples/train_loss each logged step
+    (engine.py:2029) — round-2 gap: only lr/loss_scale were emitted."""
+    import csv
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(extra={
+            "steps_per_print": 1,
+            "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                            "job_name": "job"}}))
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    _train(engine, data, steps=3)
+    files = list(tmp_path.rglob("*train_loss*.csv"))
+    assert files, f"no train_loss csv under {tmp_path}"
+    vals = []
+    for r in csv.reader(open(files[0])):
+        try:
+            vals.append(float(r[-1]))
+        except (ValueError, IndexError):
+            continue  # header row
+    assert len(vals) >= 3
+    assert all(np.isfinite(v) for v in vals)
+
+
+def test_muon_optimizer_trains():
+    """config optimizer "muon" (MUON_OPTIMIZER was a dead constant in
+    round 2): Newton-Schulz orthogonalized momentum trains the MLP."""
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(opt="muon"))
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    losses = _train(engine, data, steps=15)
+    assert losses[-1] < losses[0] * 0.7, f"muon: {losses[0]} → {losses[-1]}"
+
+
+def test_muon_orthogonalizes_2d_updates():
+    from deepspeed_tpu.ops.muon import newton_schulz_orthogonalize
+    rng = np.random.default_rng(0)
+    # ill-conditioned gradient (condition number ~1e3)
+    g = rng.standard_normal((32, 16)).astype(np.float32) \
+        * np.logspace(0, -3, 16, dtype=np.float32)
+    o = newton_schulz_orthogonalize(jnp.asarray(g))
+    # the quintic NS iteration is deliberately loose (public Muon recipe):
+    # it squashes singular values into a band near 1, not exactly to 1
+    s = np.linalg.svd(np.asarray(o), compute_uv=False)
+    assert s.min() > 0.3 and s.max() < 1.3, s
+    s_raw = np.linalg.svd(g, compute_uv=False)
+    assert s_raw.max() / s_raw.min() > 100 * s.max() / s.min()
+
+
+def test_muon_excludes_embeddings_and_head():
+    """The public Muon recipe orthogonalizes only hidden 2-D matrices —
+    embeddings/head/non-2-D params take the AdamW branch (their nu moment is
+    a real buffer, muon leaves carry a scalar placeholder)."""
+    from deepspeed_tpu.ops.muon import muon
+    params = {"wte": {"embedding": jnp.ones((64, 8))},
+              "mlp": {"kernel": jnp.ones((8, 8)), "bias": jnp.ones((8,))},
+              "lm_head": {"kernel": jnp.ones((8, 64))}}
+    tx = muon(lr=0.01)
+    st = tx.init(params)
+    assert st.nu["wte"]["embedding"].shape == (64, 8)   # adamw (excluded)
+    assert st.nu["mlp"]["bias"].shape == (8,)           # adamw (non-2D)
+    assert st.nu["lm_head"]["kernel"].shape == (8, 64)  # adamw (head)
+    assert st.nu["mlp"]["kernel"].shape == ()           # muon placeholder
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, st2 = tx.update(grads, st, params)
+    # adamw leaves got real second moments; muon leaf stayed a placeholder
+    assert float(st2.nu["wte"]["embedding"].max()) > 0
+    assert st2.nu["mlp"]["kernel"].shape == ()
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(updates))

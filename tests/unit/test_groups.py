@@ -67,3 +67,24 @@ def test_hpz_mesh():
 def test_hpz_must_divide_dp():
     with pytest.raises(ValueError):
         groups.initialize_mesh(dp=8, zero_partition_size=3)
+
+
+def test_strict_locality_raises_when_hpz_requested(monkeypatch):
+    """When the config explicitly asks for hpZ's locality property, physical
+    mesh construction failure must raise, not silently degrade to linear
+    device order (round-2 review weak #9)."""
+    import jax
+    from jax.experimental import mesh_utils
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def boom(*a, **k):
+        raise RuntimeError("topology query failed")
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", boom)
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", boom)
+    with pytest.raises(RuntimeError, match="locality property"):
+        groups.initialize_mesh(dp=8, zero_partition_size=4)
+    # without the explicit request the same failure only warns
+    st = groups.initialize_mesh(dp=8)
+    assert st.mesh is not None
