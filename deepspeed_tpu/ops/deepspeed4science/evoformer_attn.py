@@ -27,6 +27,7 @@ here autodiff does, summing over broadcast axes automatically).
 """
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -110,12 +111,61 @@ def evoformer_attention(q, k, v, biases=(), softmax_scale=None, block_q=256):
     return jnp.moveaxis(out, -3, -2)
 
 
+def _flash_bias_route(Q, K, V, bs):
+    """Route full pair-bias attention through the Pallas bias-operand flash
+    kernel (``ops/pallas/flash_bias.py``) — the TPU answer to the
+    reference's CUTLASS fMHA-with-bias (``csrc/deepspeed4science/
+    evoformer_attn/``): dPair comes out of a dedicated in-kernel reduction
+    instead of a materialized [B, N, H, L, L] score-grad tensor.
+
+    Returns None when the route doesn't apply (no pair bias, unexpected
+    shapes, or non-TPU backend without the env override).  NOTE: on this
+    route the MASK bias gets a zero cotangent (it's a -inf-style constant);
+    the chunked-XLA path differentiates it if ever needed.
+    Env: DS_TPU_EVOFORMER_FLASH=1 forces on (tests, interpret mode), =0 off.
+    """
+    flag = os.environ.get("DS_TPU_EVOFORMER_FLASH")
+    if flag == "0" or os.environ.get("DS_TPU_DISABLE_PALLAS_ATTN"):
+        return None  # same fleet-wide kill switch as attention_core
+    if flag != "1":
+        from ..pallas._common import interpret_mode
+        if interpret_mode():
+            return None
+    B, N, L, H, D = Q.shape
+    mask_bias = pair_bias = None
+    for b in bs:
+        if b.shape[-2] == 1 and b.shape[-3] == 1 and b.shape[1] == N:
+            mask_bias = b                      # [B, N, 1, 1, L]
+        elif b.shape[1] == 1 and b.shape[-2] == L and b.shape[2] == H:
+            pair_bias = b                      # [B, 1, H, L, L]
+        else:
+            return None
+    if pair_bias is None:
+        return None
+    try:
+        from ..pallas.flash_bias import flash_attention_bias
+        out = flash_attention_bias(
+            Q.reshape(B * N, L, H, D), K.reshape(B * N, L, H, D),
+            V.reshape(B * N, L, H, D),
+            bias=pair_bias.reshape(B, H, L, L),    # Gb = N batch group
+            mask_bias=(None if mask_bias is None
+                       else mask_bias.reshape(B * N, 1, 1, L)),
+            causal=False)
+    except Exception as e:  # kernel construction can fail on real HW —
+        from ..attention import _warn_fallback  # same policy as attention_core
+        _warn_fallback(e)
+        return None
+    return out.reshape(B, N, L, H, D)
+
+
 def DS4Sci_EvoformerAttention(Q, K, V, biases):
     """Reference-parity entry (``evoformer_attn.py:88 DS4Sci_EvoformerAttention``).
 
     ``Q/K/V``: ``[B, N, L, H, D]`` MSA tensors; ``biases`` a list of at most
     two: mask bias ``[B, N, 1, 1, L]`` then pair bias ``[B, 1, H, L, L]``
-    (either may be None/absent).
+    (either may be None/absent).  With a full pair bias on TPU the call
+    runs the Pallas bias-operand flash kernel (dBias in-kernel); otherwise
+    the chunked-XLA path.
     """
     assert len(biases) <= 2, "at most two biases (mask, pair)"
     bs = [b for b in biases if b is not None]
@@ -123,4 +173,7 @@ def DS4Sci_EvoformerAttention(Q, K, V, biases):
     for b in bs:
         assert b.shape[-1] == L and b.ndim == Q.ndim, (
             f"bias shape {b.shape} incompatible with Q {Q.shape}")
+    out = _flash_bias_route(Q, K, V, bs)
+    if out is not None:
+        return out
     return evoformer_attention(Q, K, V, biases=bs)

@@ -160,6 +160,47 @@ def stage_timing(D=1024, H=8, S=512, dtype=jnp.bfloat16, iters=20):
             "backend": jax.default_backend()}
 
 
+def bias_attention_timing(B=2, N=8, L=512, H=4, D=32, iters=10):
+    """Pallas bias-operand flash (dBias in-kernel) vs the chunked-XLA
+    evoformer path — value+grad step on a pair-biased MSA attention
+    (VERDICT r3 item 4 microbench)."""
+    import os
+    from ..ops.deepspeed4science.evoformer_attn import (
+        DS4Sci_EvoformerAttention)
+    rng = np.random.default_rng(0)
+    Q, K, V = (jnp.asarray(rng.standard_normal((B, N, L, H, D)),
+                           jnp.float32) for _ in range(3))
+    pair = jnp.asarray(rng.standard_normal((B, 1, H, L, L)),
+                       jnp.float32) * 0.3
+
+    def loss(q, pb):
+        return jnp.sum(DS4Sci_EvoformerAttention(q, K, V, [pb]) ** 2)
+
+    results = {}
+    saved = os.environ.get("DS_TPU_EVOFORMER_FLASH")
+    try:
+        for name, flag in (("flash_kernel", "1"), ("chunked_xla", "0")):
+            os.environ["DS_TPU_EVOFORMER_FLASH"] = flag
+            g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+            out = g(Q, pair)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(Q, pair)
+            jax.block_until_ready(out)
+            results[name + "_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
+    finally:  # restore (not delete) any pre-existing operator setting
+        if saved is None:
+            os.environ.pop("DS_TPU_EVOFORMER_FLASH", None)
+        else:
+            os.environ["DS_TPU_EVOFORMER_FLASH"] = saved
+    results["speedup"] = round(results["chunked_xla_ms"] /
+                               results["flash_kernel_ms"], 3)
+    results["backend"] = jax.default_backend()
+    return results
+
+
 def main():
     import argparse
     p = argparse.ArgumentParser()
@@ -167,6 +208,8 @@ def main():
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--seq", type=int, default=512)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--bias-attn", action="store_true",
+                   help="also run the evoformer bias-kernel A/B")
     args = p.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -174,6 +217,10 @@ def main():
     print(json.dumps({"metric": "decoder_fusion_report", **rep}))
     tim = stage_timing(args.dim, args.heads, args.seq)
     print(json.dumps({"metric": "decoder_fusion_timing", **tim}))
+    if args.bias_attn:
+        bt = bias_attention_timing()
+        print(json.dumps({"metric": "evoformer_bias_attention_timing",
+                          **bt}))
 
 
 if __name__ == "__main__":

@@ -65,6 +65,18 @@ class _ParamGroup(dict):
         if key == "lr" and value is not None:
             self._engine._set_client_lr(float(value))
 
+    # dict.update/setdefault bypass __setitem__ on subclasses — route them
+    # through it, or an update({"lr": x}) would be silently inert (the
+    # round-2 bug class this facade exists to fix)
+    def update(self, *args, **kw):
+        for k, v in dict(*args, **kw).items():
+            self[k] = v
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self[key] = default
+        return self[key]
+
 
 class _OptimizerFacade:
     """torch-optimizer-shaped view of the engine's optimizer state, for user
@@ -638,8 +650,9 @@ class DeepSpeedEngine:
         self._pending_client_lr = None
         if self.opt_state is not None and hasattr(self.opt_state,
                                                   "lr_override"):
+            from ..ops.adam import no_lr_override
             self.opt_state = self.opt_state._replace(
-                lr_override=jnp.full((), jnp.nan, jnp.float32))
+                lr_override=no_lr_override())
 
     def _set_client_lr(self, value):
         """Route a torch-API ``param_groups[0]["lr"]`` write into the
