@@ -4,21 +4,26 @@ scheme, ``runtime/zero/stage_1_and_2.py state_dict()`` contents; reference
 consumer being mirrored: ``checkpoint/ds_to_universal.py:112
 extract_zero_shards`` / ``:232 merge``).
 
-Reads a ZeRO stage-0/1/2 checkpoint directory written by the torch
-DeepSpeed:
+Reads a ZeRO stage-0/1/2 **or stage-3** checkpoint directory written by
+the torch DeepSpeed:
 
     {tag}/mp_rank_00_model_states.pt          "module": model state_dict
+                                              (+ "param_shapes" at stage 3)
     {tag}/zero_pp_rank_{d}_mp_rank_00_optim_states.pt, one per dp rank:
-        sd["optimizer_state_dict"]:
+      stage ≤2 — sd["optimizer_state_dict"]:
             "param_slice_mappings":  per group {name: fragment(start, numel)}
             "base_optimizer_state":  {"state": per group {"exp_avg": flat,
                                       "exp_avg_sq": flat[, "step": n]}}
             "single_partition_of_fp32_groups": per group flat fp32 partition
+      stage 3 — sd["optimizer_state_dict"]:
+            "fp32_flat_groups": [flat fp32 slice of EVERY param]
+            "optimizer_state_dict": {"state": {0: {"exp_avg": flat, ...}}}
 
-and reassembles full per-parameter fp32 weights + Adam moments by
-concatenating each rank's named fragments in dp order, then writes the
-universal layout (``ds_to_universal.py`` output contract) under TORCH→FLAX
-renaming so ``load_universal_checkpoint`` can resume the run on a TPU mesh.
+and reassembles full per-parameter fp32 weights + Adam moments (stage ≤2:
+named fragments in dp order; stage 3: the per-param ceil(numel/dp) slice
+walk of ``ds_to_universal.py:152``), then writes the universal layout
+(``ds_to_universal.py`` output contract) under TORCH→FLAX renaming so
+``load_universal_checkpoint`` can resume the run on a TPU mesh.
 
 Unpickling note: those files reference ``deepspeed.utils.tensor_fragment.
 fragment_address`` — a namedtuple from a package this environment doesn't
@@ -140,42 +145,15 @@ def _resolve_tag(ckpt_dir, tag):
     return tag
 
 
-def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
-                             transform=default_torch_to_flax):
-    """Convert a torch-DeepSpeed ZeRO (stage ≤2) checkpoint into the
-    universal layout at ``output_dir``.  Returns ``output_dir``."""
-    tag = _resolve_tag(checkpoint_dir, tag)
-    root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
-    if not os.path.isdir(root):
-        raise FileNotFoundError(f"no checkpoint at {root}")
-
-    model_files = sorted(glob.glob(os.path.join(root,
-                                                "mp_rank_*_model_states.pt")))
-    if not model_files:
-        raise FileNotFoundError(f"no mp_rank_*_model_states.pt under {root}")
-    if len(model_files) > 1:
-        raise NotImplementedError(
-            "TP-sharded torch checkpoints (mp>1) need merge_tp_slices — "
-            "stage ≤2 single-mp migration is supported")
-    model_sd = _torch_load(model_files[0])
-    module = model_sd.get("module", model_sd)
-    shapes = {k: tuple(v.shape) for k, v in module.items()
-              if hasattr(v, "shape")}
-
-    optim_files = sorted(
-        glob.glob(os.path.join(root, "*_optim_states.pt")),
-        key=lambda p: [int(x) for x in re.findall(r"rank_(\d+)", p)])
-
-    # named fragments per state, concatenated across dp ranks in rank order
+def _assemble_stage2(module, shapes, optim_files, first_sd=None):
+    """Stage ≤2: per-rank flat group partitions + named fragment maps
+    (reference ``stage_1_and_2.py state_dict``; consumer
+    ``ds_to_universal.py:112 extract_zero_shards``)."""
     state_parts = {"fp32": {}, "exp_avg": {}, "exp_avg_sq": {}}
     step = None
-    for path in optim_files:
-        sd = _torch_load(path)
+    for i, path in enumerate(optim_files):
+        sd = first_sd if i == 0 and first_sd is not None else _torch_load(path)
         osd = sd.get("optimizer_state_dict", sd)
-        if "single_partition_of_fp32_groups" not in osd:
-            raise NotImplementedError(
-                f"{os.path.basename(path)} is not a stage ≤2 optim file "
-                "(stage-3 migration: not yet supported)")
         slice_maps = osd["param_slice_mappings"]
         base_state = osd["base_optimizer_state"]["state"]
         fp32_groups = osd["single_partition_of_fp32_groups"]
@@ -191,24 +169,127 @@ def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
                     state_parts[key].setdefault(name, []).append(
                         flat[start:start + numel])
 
-    zero_root = os.path.join(output_dir, ZERO_FILE_PREFIX)
-    os.makedirs(zero_root, exist_ok=True)
-    param_meta = {}
+    assembled = {}
     for name, shape in shapes.items():
         if name not in state_parts["fp32"]:
             logger.warning(f"migration: no optimizer fragments for {name} "
                            "(frozen param?) — copying module weight")
-            full = {"fp32": _to_numpy(module[name]).reshape(shape)}
-        else:
-            full = {}
-            for key in state_parts:
-                flat = np.concatenate(state_parts[key][name])
-                numel = int(np.prod(shape))
-                if flat.size < numel:
-                    raise ValueError(
-                        f"{name}: fragments cover {flat.size} of {numel} "
-                        "elements — checkpoint incomplete?")
-                full[key] = flat[:numel].reshape(shape)
+            assembled[name] = (shape,
+                               {"fp32": _to_numpy(module[name]).reshape(shape)})
+            continue
+        full = {}
+        for key in state_parts:
+            flat = np.concatenate(state_parts[key][name])
+            numel = int(np.prod(shape))
+            if flat.size < numel:
+                raise ValueError(
+                    f"{name}: fragments cover {flat.size} of {numel} "
+                    "elements — checkpoint incomplete?")
+            full[key] = flat[:numel].reshape(shape)
+        assembled[name] = (shape, full)
+    return assembled, step
+
+
+def _assemble_stage3(model_sd, optim_files, zero_model_sds=(),
+                     first_sd=None):
+    """Stage 3: every param is split across ALL dp ranks; each rank's flat
+    buffer concatenates its ceil(numel/dp)-sized slice of every param in
+    ``param_shapes`` order (reference producer ``stage3.py state_dict``
+    [fp32_flat_groups]; consumer ``ds_to_universal.py:152
+    extract_zero_shards_stage3`` — this mirrors its offset walk).
+
+    ``zero_model_sds``: the per-dp-rank ``zero_pp_rank_*_model_states.pt``
+    dicts, used for frozen params (absent from fp32_flat_groups): each rank
+    stores its ``ds_tensor`` partition in ``frozen_param_fragments``
+    (reference merge: ``utils/zero_to_fp32.py _zero3_merge_frozen_params``)."""
+    shapes_raw = model_sd.get("param_shapes")
+    if shapes_raw is None:
+        raise ValueError(
+            "stage-3 optim files present but model_states carries no "
+            "param_shapes — not a complete ZeRO-3 checkpoint")
+    param_shapes = {}
+    if isinstance(shapes_raw, (list, tuple)):
+        for d in shapes_raw:
+            param_shapes.update(d)
+    else:
+        param_shapes.update(shapes_raw)
+
+    dp = len(optim_files)
+    ranks = {"fp32": [], "exp_avg": [], "exp_avg_sq": []}
+    step = None
+    for i, path in enumerate(optim_files):
+        sd = first_sd if i == 0 and first_sd is not None else _torch_load(path)
+        osd = sd.get("optimizer_state_dict", sd)
+        groups = osd["fp32_flat_groups"]
+        inner = osd["optimizer_state_dict"]["state"]
+        if len(groups) != 1 or len(inner) != 1:
+            raise NotImplementedError(
+                f"stage-3 migration supports a single param group; got "
+                f"{len(groups)} flat groups / {len(inner)} state groups "
+                "(reference ds_to_universal.py:158 reads group 0 only)")
+        st = inner[0] if 0 in inner else next(iter(inner.values()))
+        ranks["fp32"].append(_to_numpy(groups[0]))
+        ranks["exp_avg"].append(_to_numpy(st["exp_avg"]))
+        ranks["exp_avg_sq"].append(_to_numpy(st["exp_avg_sq"]))
+        if step is None and "step" in st:
+            step = int(_to_numpy(st["step"]))
+
+    assembled = {}
+    offset = 0
+    for name, shape in param_shapes.items():
+        shape = tuple(int(x) for x in shape)
+        numel = int(np.prod(shape)) if shape else 1
+        pn = -(-numel // dp)  # ceil: per-rank slice incl. tail padding
+        full = {}
+        for key, flats in ranks.items():
+            segs = []
+            for r in range(dp):
+                valid = max(0, min(pn, numel - r * pn))
+                if valid:
+                    segs.append(flats[r][offset:offset + valid])
+            flat = np.concatenate(segs) if segs else np.zeros(0, np.float32)
+            if flat.size != numel:
+                raise ValueError(
+                    f"{name}: stage-3 slices cover {flat.size} of {numel} "
+                    "elements — dp degree / param_shapes mismatch?")
+            full[key] = flat.reshape(shape)
+        assembled[name] = (shape, full)
+        offset += pn
+
+    # frozen params: per-rank ds_tensor fragments concatenated then
+    # narrowed to numel (reference _zero3_merge_frozen_params)
+    frozen_shapes = (zero_model_sds[0].get("frozen_param_shapes")
+                     if zero_model_sds else
+                     model_sd.get("frozen_param_shapes")) or {}
+    for name, shape in frozen_shapes.items():
+        shape = tuple(int(x) for x in shape)
+        numel = int(np.prod(shape)) if shape else 1
+        sds = zero_model_sds or (model_sd, )
+        frags = []
+        for sd in sds:
+            fragments = sd.get("frozen_param_fragments") or {}
+            if name in fragments:
+                frags.append(_to_numpy(fragments[name]).reshape(-1))
+        if not frags:
+            raise ValueError(
+                f"frozen param {name} listed in frozen_param_shapes but no "
+                "rank carries its fragment — incomplete stage-3 checkpoint")
+        flat = np.concatenate(frags)
+        if flat.size < numel:
+            raise ValueError(
+                f"frozen param {name}: fragments cover {flat.size} of "
+                f"{numel} elements — missing per-rank "
+                "zero_pp_rank_*_model_states.pt files?")
+        assembled[name] = (shape, {"fp32": flat[:numel].reshape(shape)})
+    return assembled, step
+
+
+def _write_universal(output_dir, assembled, transform, step, global_steps,
+                     root):
+    zero_root = os.path.join(output_dir, ZERO_FILE_PREFIX)
+    os.makedirs(zero_root, exist_ok=True)
+    param_meta = {}
+    for name, (shape, full) in assembled.items():
         mapped = transform(name, full["fp32"])
         if mapped is None:
             continue
@@ -224,8 +305,8 @@ def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
                                 "source": name}
 
     meta = {
-        "engine_state": {"global_steps": model_sd.get("global_steps", 0)},
-        "step": step if step is not None else model_sd.get("global_steps", 0),
+        "engine_state": {"global_steps": global_steps},
+        "step": step if step is not None else global_steps,
         "params": param_meta,
         "migrated_from": "torch-deepspeed",
     }
@@ -237,6 +318,66 @@ def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
     logger.info(f"migrated {len(param_meta)} params from torch checkpoint "
                 f"{root} → {output_dir}")
     return output_dir
+
+
+def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
+                             transform=default_torch_to_flax):
+    """Convert a torch-DeepSpeed ZeRO (stage 0-3) checkpoint into the
+    universal layout at ``output_dir``.  Returns ``output_dir``.
+
+    Stage detection is by optim-file contents: stage ≤2 files carry
+    ``single_partition_of_fp32_groups`` + ``param_slice_mappings``; stage-3
+    files carry ``fp32_flat_groups`` with ``param_shapes`` in the model
+    states (reference ``ds_to_universal.py:486 _check_for_required_state``)."""
+    tag = _resolve_tag(checkpoint_dir, tag)
+    root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no checkpoint at {root}")
+
+    model_files = sorted(glob.glob(os.path.join(root,
+                                                "mp_rank_*_model_states.pt")))
+    # stage-3 checkpoints also (or only) write per-dp-rank model states
+    # carrying frozen-param fragments (zero_to_fp32.py:76 naming)
+    zero_model_files = sorted(
+        glob.glob(os.path.join(root, "zero_pp_rank_*_model_states.pt")),
+        key=lambda p: [int(x) for x in re.findall(r"rank_(\d+)", p)])
+    if not model_files and not zero_model_files:
+        raise FileNotFoundError(f"no *_model_states.pt under {root}")
+    if len(model_files) > 1:
+        raise NotImplementedError(
+            "TP-sharded torch checkpoints (mp>1) need merge_tp_slices — "
+            "single-mp migration is supported")
+    model_sd = _torch_load(model_files[0] if model_files
+                           else zero_model_files[0])
+    module = model_sd.get("module", model_sd) or {}
+    shapes = {k: tuple(v.shape) for k, v in module.items()
+              if hasattr(v, "shape")}
+
+    optim_files = sorted(
+        glob.glob(os.path.join(root, "*_optim_states.pt")),
+        key=lambda p: [int(x) for x in re.findall(r"rank_(\d+)", p)])
+    if not optim_files:
+        # weights-only checkpoint: migrate module weights alone (each param
+        # takes the copy-module-weight branch with a warning)
+        assembled, step = _assemble_stage2(module, shapes, optim_files)
+    else:
+        first = _torch_load(optim_files[0])
+        first_osd = first.get("optimizer_state_dict", first)
+        if "single_partition_of_fp32_groups" in first_osd:
+            assembled, step = _assemble_stage2(module, shapes, optim_files,
+                                               first_sd=first)
+        elif "fp32_flat_groups" in first_osd:
+            zero_model_sds = tuple(_torch_load(p) for p in zero_model_files)
+            assembled, step = _assemble_stage3(model_sd, optim_files,
+                                               zero_model_sds,
+                                               first_sd=first)
+        else:
+            raise ValueError(
+                f"{os.path.basename(optim_files[0])} is neither a stage ≤2 "
+                "(single_partition_of_fp32_groups) nor a stage-3 "
+                "(fp32_flat_groups) optim file")
+    return _write_universal(output_dir, assembled, transform, step,
+                            model_sd.get("global_steps", 0), root)
 
 
 def load_torch_deepspeed_checkpoint(engine, checkpoint_dir, tag=None,
@@ -254,7 +395,7 @@ def load_torch_deepspeed_checkpoint(engine, checkpoint_dir, tag=None,
 def main(argv=None):
     import argparse
     p = argparse.ArgumentParser(
-        description="Migrate a torch-DeepSpeed ZeRO (stage ≤2) checkpoint "
+        description="Migrate a torch-DeepSpeed ZeRO (stage 0-3) checkpoint "
         "to the universal layout")
     p.add_argument("--input_folder", required=True)
     p.add_argument("--output_folder", required=True)

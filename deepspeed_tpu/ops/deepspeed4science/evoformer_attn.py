@@ -22,8 +22,12 @@ Bias semantics match the reference exactly (``evoformer_attn.py:88-106``):
 * ``biases[1]`` — pair bias, shape ``[B, 1, H, L, L]`` (broadcast over the
   MSA row axis).
 
-Both gradients flow (the reference computes ``dB1``/``dB2`` when requested;
-here autodiff does, summing over broadcast axes automatically).
+Gradient contract: the PAIR bias gradient flows on every path.  The MASK
+bias gradient flows only on the chunked-XLA path — the Pallas flash route
+(taken on TPU when a full pair bias is present, see ``_flash_bias_route``)
+treats the mask as a -inf-style constant and returns a ZERO cotangent for
+it, like the reference kernel with ``bias1.requires_grad=False``.  Set
+``DS_TPU_EVOFORMER_FLASH=0`` to differentiate a trainable mask bias.
 """
 
 import math

@@ -179,6 +179,16 @@ def bias_attention_timing(B=2, N=8, L=512, H=4, D=32, iters=10):
     results = {}
     saved = os.environ.get("DS_TPU_EVOFORMER_FLASH")
     try:
+        # the route falls back (with a warning) on kernel-construction
+        # failure — probe it first so the A/B can't silently time the
+        # chunked path twice and report speedup ≈ 1.0 as a kernel result
+        from ..ops.deepspeed4science.evoformer_attn import _flash_bias_route
+        os.environ["DS_TPU_EVOFORMER_FLASH"] = "1"
+        if _flash_bias_route(Q, K, V, [pair]) is None:
+            os.environ.pop("DS_TPU_EVOFORMER_FLASH", None)
+            return {"error": "flash-bias kernel route unavailable on this "
+                             "backend (fell back to chunked XLA)",
+                    "backend": jax.default_backend()}
         for name, flag in (("flash_kernel", "1"), ("chunked_xla", "0")):
             os.environ["DS_TPU_EVOFORMER_FLASH"] = flag
             g = jax.jit(jax.grad(loss, argnums=(0, 1)))
