@@ -154,6 +154,11 @@ def _assemble_stage2(module, shapes, optim_files, first_sd=None):
     for i, path in enumerate(optim_files):
         sd = first_sd if i == 0 and first_sd is not None else _torch_load(path)
         osd = sd.get("optimizer_state_dict", sd)
+        if "param_slice_mappings" not in osd:
+            raise ValueError(
+                f"{os.path.basename(path)} is not a stage ≤2 optim file "
+                "(no param_slice_mappings) — mixed-stage or truncated "
+                "checkpoint?")
         slice_maps = osd["param_slice_mappings"]
         base_state = osd["base_optimizer_state"]["state"]
         fp32_groups = osd["single_partition_of_fp32_groups"]
@@ -220,6 +225,11 @@ def _assemble_stage3(model_sd, optim_files, zero_model_sds=(),
     for i, path in enumerate(optim_files):
         sd = first_sd if i == 0 and first_sd is not None else _torch_load(path)
         osd = sd.get("optimizer_state_dict", sd)
+        if "fp32_flat_groups" not in osd:
+            raise ValueError(
+                f"{os.path.basename(path)} is not a stage-3 optim file "
+                "(no fp32_flat_groups) — mixed-stage or truncated "
+                "checkpoint?")
         groups = osd["fp32_flat_groups"]
         inner = osd["optimizer_state_dict"]["state"]
         if len(groups) != 1 or len(inner) != 1:
@@ -367,7 +377,14 @@ def migrate_torch_checkpoint(checkpoint_dir, output_dir, tag=None,
             assembled, step = _assemble_stage2(module, shapes, optim_files,
                                                first_sd=first)
         elif "fp32_flat_groups" in first_osd:
-            zero_model_sds = tuple(_torch_load(p) for p in zero_model_files)
+            # load the per-rank model states only when frozen params exist
+            # (a dp=64 run would otherwise unpickle 64 files for nothing)
+            zero_model_sds = ()
+            if zero_model_files:
+                rank0 = _torch_load(zero_model_files[0])
+                if rank0.get("frozen_param_shapes"):
+                    zero_model_sds = (rank0, ) + tuple(
+                        _torch_load(p) for p in zero_model_files[1:])
             assembled, step = _assemble_stage3(model_sd, optim_files,
                                                zero_model_sds,
                                                first_sd=first)

@@ -25,8 +25,10 @@ TPU-native re-design of the reference's ZeRO++ stack (wiring at
 
 qgZ requires taking over the gradient reduction from GSPMD, so the engine
 switches its micro-step to a manual-SPMD (``shard_map``) variant — see
-:func:`build_manual_dp_micro`.  That path supports pure-DP meshes (ZeRO++ is
-a DP-communication optimization; reference scope is the same).
+:func:`build_manual_dp_micro`.  That path supports dp/ep meshes, and tp>1
+via PARTIAL-manual shard_map (manual over the dp axes, "tp" left auto so
+GSPMD keeps inserting the tensor-parallel collectives); sp/pp are rejected
+loudly (their collectives interleave with the reduction being replaced).
 """
 
 from functools import partial
@@ -52,6 +54,19 @@ def _zero_dim(spec, zero_axes):
         if present:
             return i, present
     return None, ()
+
+
+def _entry_names(entry):
+    """Spec entry → tuple of axis names (shared normalize for the three
+    spec rewriters below)."""
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry, )
+
+
+def _collapse(names):
+    """Axis-name tuple → spec entry (len-collapse inverse of _entry_names)."""
+    return names if len(names) > 1 else (names[0] if names else None)
 
 
 def _strip_axes(spec, dim, axes):
@@ -195,11 +210,17 @@ def build_manual_dp_micro(engine):
     gas = engine.gradient_accumulation_steps()
     apply_fn = engine._effective_apply_fn()
     grad_dtype = engine.grad_accum_dtype
-    if engine.mp_world_size > 1 or engine.seq_parallel_world_size > 1 or \
-            engine.pp_world_size > 1:
+    if engine.seq_parallel_world_size > 1 or engine.pp_world_size > 1:
         raise ValueError(
-            "zero_quantized_gradients requires a pure data-parallel mesh "
-            "(tp=sp=pp=1) — it replaces the DP gradient reduction")
+            "zero_quantized_gradients supports dp/ep (+tp) meshes only — "
+            "sp/pp interleave their own collectives with the DP gradient "
+            "reduction this path replaces; disable "
+            "zero_quantized_gradients or drop the sp/pp axes")
+    # tp > 1 runs in PARTIAL-manual mode: shard_map is manual over the dp
+    # axes (where the quantized collectives live) while "tp" stays an auto
+    # axis — GSPMD keeps inserting the tensor-parallel collectives inside
+    # the body exactly as in the normal micro-step.
+    manual_only = engine.mp_world_size > 1
     # With hpZ/MiCS the manual step runs over the reshaped hpz mesh, whose
     # (zp_outer, zp) axes tile the same device order as (dp, ep) on the
     # global mesh — full-dp specs are translated axis-for-axis.
@@ -213,13 +234,11 @@ def build_manual_dp_micro(engine):
         def _translate(spec):
             out = []
             for entry in spec:
-                names = (entry if isinstance(entry, tuple) else
-                         (entry, )) if entry is not None else ()
+                names = _entry_names(entry)
                 if any(a in ("dp", "ep") for a in names):
                     names = tuple(a for a in names
                                   if a not in ("dp", "ep")) + dp_axes
-                out.append(names if len(names) > 1 else
-                           (names[0] if names else None))
+                out.append(_collapse(names))
             return P(*out)
     else:
         mesh = plan.mesh
@@ -231,6 +250,16 @@ def build_manual_dp_micro(engine):
     from ..utils import make_scaled_loss_fn
     loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
+    manual_axes = frozenset(
+        a for a in (dp_axes if isinstance(dp_axes, tuple) else (dp_axes, )))
+
+    def _manual_spec(spec):
+        """Project a spec onto the manual axes (partial-manual shard_map
+        in/out specs may reference ONLY the manual axis names; auto-axis
+        sharding rides on the operands themselves)."""
+        return P(*[_collapse(tuple(a for a in _entry_names(e)
+                                   if a in manual_axes)) for e in spec])
+
     def micro(params, scale, inputs):
         param_specs = jax.tree_util.tree_map(_translate,
                                              plan.param_specs(params),
@@ -240,6 +269,13 @@ def build_manual_dp_micro(engine):
                                               plan.master_specs(params),
                                               is_leaf=lambda x: isinstance(
                                                   x, P))
+        if manual_only:
+            param_specs = jax.tree_util.tree_map(
+                _manual_spec, param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            master_specs = jax.tree_util.tree_map(
+                _manual_spec, master_specs,
+                is_leaf=lambda x: isinstance(x, P))
         batch_specs = tuple(
             P(*([dp_axes] + [None] * (x.ndim - 1))) for x in inputs)
 
@@ -279,8 +315,11 @@ def build_manual_dp_micro(engine):
             grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
             return loss, grads
 
-        fn = shard_map(body, mesh=mesh, in_specs=(param_specs, batch_specs),
-                       out_specs=(P(), master_specs), check_vma=False)
+        kw = dict(mesh=mesh, in_specs=(param_specs, batch_specs),
+                  out_specs=(P(), master_specs), check_vma=False)
+        if manual_only:
+            kw["axis_names"] = manual_axes  # tp stays auto (GSPMD)
+        fn = shard_map(body, **kw)
         return fn(params, inputs)
 
     return micro
