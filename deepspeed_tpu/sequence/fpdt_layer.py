@@ -177,15 +177,20 @@ class FPDTHostOffloadAttention:
         self.softmax_scale = softmax_scale
         self.offload = offload
         self.chunks = []
-        self._merge = jax.jit(
-            lambda q, k, v, out, lse, scale: self._merge_impl(
-                q, k, v, out, lse, scale))
 
-    @staticmethod
-    def _merge_impl(q, k, v, out, lse, scale):
-        new_out, new_lse = _chunk_attend(q, k, v, mask=None,
-                                         softmax_scale=scale)
-        return update_out_and_lse(out, lse, new_out, new_lse)
+        # ONE compiled merge serves both the streamed chunks (causal=False:
+        # every stored chunk is entirely in the past) and the current
+        # block's causal tail.  The O(chunk²) score temp and the causal
+        # mask live inside XLA, bounded by the chunk size —
+        # context-independent, no mask operand.
+        def merge(q, k, v, out, lse, scale, causal):
+            mask = (jnp.arange(q.shape[1])[:, None] >=
+                    jnp.arange(k.shape[1])[None, :]) if causal else None
+            return update_out_and_lse(
+                out, lse, *_chunk_attend(q, k, v, mask=mask,
+                                         softmax_scale=scale))
+
+        self._merge = jax.jit(merge, static_argnums=(6, ))
 
     def append_kv(self, k, v):
         """Store a [B, S_chunk, H, D] KV block host-side."""
@@ -207,15 +212,12 @@ class FPDTHostOffloadAttention:
         scale = self.softmax_scale if self.softmax_scale is not None else D**-0.5
         for chunk in self.chunks:
             k, v = chunk.fetch()
-            out, lse = self._merge(q, k, v, out, lse, scale)
+            out, lse = self._merge(q, k, v, out, lse, scale, False)
         if k_new is not None:
-            # current block attends causally to itself
-            new_out, new_lse = _chunk_attend(
-                q, k_new, v_new,
-                mask=(jnp.arange(Sq)[:, None] >= jnp.arange(
-                    k_new.shape[1])[None, :]) if causal_tail else None,
-                softmax_scale=scale)
-            out, lse = update_out_and_lse(out, lse, new_out, new_lse)
+            # current block attends (causally) to itself — jitted, mask
+            # built in-program
+            out, lse = self._merge(q, k_new, v_new, out, lse, scale,
+                                   bool(causal_tail))
             self.append_kv(k_new, v_new)
         return out.astype(q.dtype)
 

@@ -2,6 +2,8 @@
 (``tests/unit/sequence_parallelism``): chunked attention must match dense
 attention exactly, gradients must flow, host-offload streaming must agree."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -152,3 +154,72 @@ def test_fpdt_attention_over_sp_mesh():
                                    rtol=2e-4, atol=2e-5)
     finally:
         groups.reset_mesh()
+
+
+@pytest.mark.skipif(not os.environ.get("DS_TPU_RUN_SLOW"),
+                    reason="128k-token proof (~4 min CPU); DS_TPU_RUN_SLOW=1")
+def test_fpdt_host_offload_128k_flat_hbm():
+    """VERDICT r3 item 7: drive >=128k tokens through FPDT host-offloaded
+    attention and assert the DEVICE working set stays flat in context
+    length — the reference's ~M-token design point
+    (fpdt_layer.py:462-510) rests on exactly this property.
+
+    What is actually asserted (on any backend):
+    * every device program run during streaming (the jitted chunk merge and
+      the jitted causal tail) takes chunk-sized operands — the per-call
+      operand footprint is CONSTANT as context grows from 1 to 16 chunks
+      (a regression that fed concatenated KV into one call would fail);
+    * on backends with a pinned_host memory space (TPU), every stored KV
+      chunk physically resides there (sharding.memory_kind), so HBM holds
+      one in-flight chunk; on CPU the offload target is documented absent
+      and residency cannot be distinguished — the structural assertions
+      above still hold.
+    The O(CHUNK²) score temp inside the tail program is bounded by the
+    chunk size, not the context."""
+    from deepspeed_tpu.sequence.fpdt_layer import _host_sharding
+
+    B, H, D, CHUNK = 1, 1, 16, 8192
+    TOTAL = 131072  # 128k tokens
+    rng = np.random.default_rng(0)
+
+    attn = FPDTHostOffloadAttention(chunk_size=CHUNK)
+    call_bytes = []
+
+    def counting(orig):
+        def wrapped(*args):
+            call_bytes.append(sum(a.nbytes for a in args
+                                  if hasattr(a, "nbytes")))
+            return orig(*args)
+        return wrapped
+
+    attn._merge = counting(attn._merge)
+
+    outs = []
+    for start in range(0, TOTAL, CHUNK):
+        blk = jnp.asarray(
+            rng.standard_normal((B, CHUNK, H, D)) * 0.1, jnp.float32)
+        out = attn.attend(blk, k_new=blk, v_new=blk)
+        outs.append(np.asarray(out[:, -1]))
+    assert attn.context_length == TOTAL
+    assert all(np.isfinite(o).all() for o in outs)
+
+    # per-call operand footprint is constant in context: EVERY call —
+    # block 1 (empty cache) through block 16 (120k tokens cached) — has
+    # identical operand bytes (q + one kv chunk + out + lse), and the call
+    # count is exactly 16 tails + sum(0..15) past-chunk merges
+    assert len(set(call_bytes)) == 1, sorted(set(call_bytes))
+    assert len(call_bytes) == 16 + sum(range(16)), len(call_bytes)
+    chunk_bytes = CHUNK * B * H * D * 4
+    assert max(call_bytes) < 5 * chunk_bytes, (
+        f"a device call took {max(call_bytes)}B — more than q+k+v+out+lse "
+        f"chunk-equivalents ({chunk_bytes}B each); full KV is "
+        f"{2 * TOTAL * B * H * D * 4}B")
+
+    # physical host residency where the backend has a pinned_host space
+    if _host_sharding() is not None:
+        for c in attn.chunks:
+            assert c.k.sharding.memory_kind == "pinned_host", c.k.sharding
+            assert c.v.sharding.memory_kind == "pinned_host", c.v.sharding
+    elif jax.default_backend() != "cpu":
+        pytest.skip("backend has no pinned_host memory space — residency "
+                    "not observable; structural assertions above still ran")
