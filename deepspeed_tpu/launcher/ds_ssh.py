@@ -1,0 +1,86 @@
+"""ds_ssh — run a command on every host of a hostfile (reference
+``bin/ds_ssh``; that one shells out to pdsh, this one runs plain ``ssh``
+per host in a thread pool so there is no pdsh dependency on TPU pods).
+
+    ds_ssh [-f hostfile] [--serial] [--timeout S] -- <command...>
+
+Output is prefixed per host (pdsh-style ``host: line``); exit status is
+non-zero if any host fails.  Hostfile format is the launcher's
+(``host slots=N``, comments with '#') — ``fetch_hostfile`` is shared.
+"""
+
+import argparse
+import shlex
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+from .runner import DLTS_HOSTFILE, fetch_hostfile
+
+SSH_OPTS = ["-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+
+
+def _run_one(host, command, timeout):
+    try:
+        proc = subprocess.run(["ssh"] + SSH_OPTS + [host, command],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        return host, proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired:
+        return host, 124, "", f"timeout after {timeout}s\n"
+    except OSError as e:  # ssh binary missing etc.
+        return host, 127, "", f"{e}\n"
+
+
+def _emit(host, rc, out, err):
+    for line in out.splitlines():
+        print(f"{host}: {line}")
+    for line in err.splitlines():
+        print(f"{host}: {line}", file=sys.stderr)
+    if rc != 0:
+        print(f"{host}: [exit {rc}]", file=sys.stderr)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_ssh", description="run a command on every hostfile host")
+    parser.add_argument("-f", "--hostfile", default=DLTS_HOSTFILE,
+                        help=f"hostfile path (default {DLTS_HOSTFILE})")
+    parser.add_argument("--serial", action="store_true",
+                        help="one host at a time (default: parallel)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="per-host timeout in seconds")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run (prefix with -- if it has flags)")
+    args = parser.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":  # strip only the leading separator
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given")
+    command = shlex.join(cmd)
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print(f"Missing/empty hostfile at {args.hostfile}, unable to proceed",
+              file=sys.stderr)
+        return 1
+    hosts = list(resources.keys())
+
+    failed = 0
+    if args.serial:
+        for h in hosts:
+            res = _run_one(h, command, args.timeout)
+            _emit(*res)
+            failed += res[1] != 0
+    else:
+        with ThreadPoolExecutor(max_workers=min(64, len(hosts))) as pool:
+            for res in pool.map(
+                    lambda h: _run_one(h, command, args.timeout), hosts):
+                _emit(*res)
+                failed += res[1] != 0
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

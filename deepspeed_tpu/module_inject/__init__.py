@@ -4,3 +4,5 @@ from .containers import (InjectionPolicy, POLICIES, policy_for,
                          revert_transformer_layer)
 from .layers import ColumnParallelLinear, RowParallelLinear, LinearAllreduce, LinearLayer
 from .tp_parser import TpParser, derive_tp_rules_from_dataflow
+from .diffusers_injection import (fused_attention, generic_injection,
+                                  make_interceptor)

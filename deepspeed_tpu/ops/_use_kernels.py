@@ -1,0 +1,15 @@
+"""Shared should-we-run-Pallas gate for kernel dispatch sites."""
+
+import os
+
+
+def use_pallas_kernels() -> bool:
+    """True on real TPU backends (not interpret mode) unless the fleet-wide
+    kill switch is set.  DS_TPU_FORCE_PALLAS=1 forces True (tests drive the
+    kernels in interpret mode on CPU)."""
+    if os.environ.get("DS_TPU_DISABLE_PALLAS_ATTN"):
+        return False
+    if os.environ.get("DS_TPU_FORCE_PALLAS") == "1":
+        return True
+    from .pallas._common import interpret_mode
+    return not interpret_mode()

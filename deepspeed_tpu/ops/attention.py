@@ -44,12 +44,10 @@ def _xla_attention(q, k, v, causal=True, softmax_scale=None, window=0,
 
 
 def _use_pallas():
-    if os.environ.get("DS_TPU_DISABLE_PALLAS_ATTN"):
-        return False
-    # interpret_mode() recognizes proxied TPU platforms (device_kind check),
-    # where jax.default_backend() may not literally be "tpu"
-    from .pallas._common import interpret_mode
-    return not interpret_mode()
+    # one shared gate for every Pallas dispatch site (kill switch,
+    # interpret-mode detection, DS_TPU_FORCE_PALLAS for CPU tests)
+    from ._use_kernels import use_pallas_kernels
+    return use_pallas_kernels()
 
 
 _fallback_warned = False

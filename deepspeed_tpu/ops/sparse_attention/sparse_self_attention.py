@@ -36,24 +36,43 @@ def _row_gather_indices(layout_h):
     return idx, valid
 
 
+_GATHER_TABLE_CACHE = {}
+
+
+def layout_gather_tables(layout, num_heads):
+    """[H or 1, nq, nk] bool layout → (idx, valid) [H, nq, maxk] host
+    arrays, padded to the max row population.  Cached by layout contents —
+    the Python row walk runs once per distinct layout, not per forward
+    (shared by the gather formulation and the Pallas layout-skip kernel)."""
+    layout = np.asarray(layout)
+    if layout.shape[0] == 1:
+        layout = np.broadcast_to(layout, (num_heads, ) + layout.shape[1:])
+    key = (layout.shape, layout.tobytes())
+    hit = _GATHER_TABLE_CACHE.get(key)
+    if hit is not None:
+        return layout, hit[0], hit[1]
+    H = layout.shape[0]
+    idxs, valids = zip(*(_row_gather_indices(layout[h]) for h in range(H)))
+    maxk = max(i.shape[1] for i in idxs)
+    idx = np.stack([np.pad(i, ((0, 0), (0, maxk - i.shape[1])))
+                    for i in idxs]).astype(np.int32)   # [H, nq, maxk]
+    valid = np.stack([np.pad(m, ((0, 0), (0, maxk - m.shape[1])))
+                      for m in valids])                # [H, nq, maxk] bool
+    if len(_GATHER_TABLE_CACHE) > 64:  # layouts are few; bound anyway
+        _GATHER_TABLE_CACHE.clear()
+    _GATHER_TABLE_CACHE[key] = (idx, valid)
+    return layout, idx, valid
+
+
 def sparse_attention(q, k, v, layout, block, causal=False, scale=None):
     """q/k/v: [B, S, H, D]; layout: [H or 1, nq, nk] bool (block level).
     Returns [B, S, H, D].
     """
     B, S, H, D = q.shape
     nb = S // block
-    layout = np.asarray(layout)
-    if layout.shape[0] == 1:
-        layout = np.broadcast_to(layout, (H, ) + layout.shape[1:])
     scale = scale if scale is not None else D ** -0.5
-
-    # per-head gather tables (host, static)
-    idxs, valids = zip(*(_row_gather_indices(layout[h]) for h in range(H)))
-    maxk = max(i.shape[1] for i in idxs)
-    idx = np.stack([np.pad(i, ((0, 0), (0, maxk - i.shape[1])))
-                    for i in idxs])              # [H, nq, maxk]
-    valid = np.stack([np.pad(m, ((0, 0), (0, maxk - m.shape[1])))
-                      for m in valids])          # [H, nq, maxk]
+    layout, idx, valid = layout_gather_tables(layout, H)
+    maxk = idx.shape[2]
 
     qb = q.reshape(B, nb, block, H, D).transpose(3, 0, 1, 2, 4)  # [H,B,nq,bs,D]
     kb = k.reshape(B, nb, block, H, D).transpose(3, 0, 1, 2, 4)
@@ -114,6 +133,14 @@ class SparseSelfAttention:
         if causal is None:
             causal = self.sparsity_config.attention == "unidirectional" \
                 if hasattr(self.sparsity_config, "attention") else False
-        out = sparse_attention(q, k, v, self.layout(S),
-                               self.sparsity_config.block, causal=causal)
+        block = self.sparsity_config.block
+        fn = sparse_attention
+        from .._use_kernels import use_pallas_kernels
+        if use_pallas_kernels() and S % block == 0:
+            # TPU: stream only the live blocks (Pallas layout-skip kernel)
+            # instead of materializing the gathered K/V copy
+            from ..pallas.block_sparse_attention import (
+                block_sparse_flash_attention)
+            fn = block_sparse_flash_attention
+        out = fn(q, k, v, self.layout(S), block, causal=causal)
         return out if bshd else out.transpose(0, 2, 1, 3)

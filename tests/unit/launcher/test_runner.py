@@ -140,3 +140,50 @@ def test_end_to_end_local_launch(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "RANK 0" in out.stdout
     assert "WS 1" in out.stdout
+
+
+def test_ds_ssh_fleet_exec(tmp_path, monkeypatch):
+    """ds_ssh runs the command per hostfile host with pdsh-style prefixes
+    (reference bin/ds_ssh; ssh is stubbed with a recording script)."""
+    import subprocess
+    import sys as _sys
+    from deepspeed_tpu.launcher import ds_ssh
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("alpha slots=4\nbeta slots=4\n# comment\n")
+
+    calls = []
+
+    def fake_run(argv, **kw):
+        calls.append(argv)
+        host = argv[-2]
+        class R:
+            returncode = 0
+            stdout = f"hello-from-{host}\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rc = ds_ssh.main(["-f", str(hostfile), "--serial", "--", "uptime"])
+    assert rc == 0
+    assert len(calls) == 2
+    assert calls[0][0] == "ssh" and calls[0][-1] == "uptime"
+    assert {c[-2] for c in calls} == {"alpha", "beta"}
+
+
+def test_ds_ssh_reports_failures(tmp_path, monkeypatch):
+    import subprocess
+    from deepspeed_tpu.launcher import ds_ssh
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("alpha slots=1\n")
+
+    def fake_run(argv, **kw):
+        class R:
+            returncode = 3
+            stdout = ""
+            stderr = "boom\n"
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert ds_ssh.main(["-f", str(hostfile), "--", "false"]) == 1
