@@ -13,7 +13,8 @@ import argparse
 import shlex
 import subprocess
 import sys
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import (ThreadPoolExecutor,
+                                as_completed)
 
 from .runner import DLTS_HOSTFILE, fetch_hostfile
 
@@ -74,9 +75,13 @@ def main(argv=None):
             _emit(*res)
             failed += res[1] != 0
     else:
+        # stream each host's result as it finishes (pdsh behavior) — one
+        # hung host must not withhold the finished hosts' output
         with ThreadPoolExecutor(max_workers=min(64, len(hosts))) as pool:
-            for res in pool.map(
-                    lambda h: _run_one(h, command, args.timeout), hosts):
+            futs = [pool.submit(_run_one, h, command, args.timeout)
+                    for h in hosts]
+            for fut in as_completed(futs):
+                res = fut.result()
                 _emit(*res)
                 failed += res[1] != 0
     return 1 if failed else 0

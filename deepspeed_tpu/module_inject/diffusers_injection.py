@@ -126,6 +126,13 @@ def make_interceptor(policies=None, counter=None, assume_full_mask=False):
             mask = arg1 if arg1 is not None else kwargs.get("attention_mask")
         if kwargs.get("output_attentions") or _mask_blocks_fusion(mask):
             return next_fun(*args, **kwargs)
+        # training-mode attention dropout lives in the module's own path —
+        # the fused kernel has none, so non-deterministic calls with a
+        # nonzero rate keep the original implementation
+        rate = getattr(context.module, "dropout", 0.0)
+        if isinstance(rate, (int, float)) and rate > 0 and \
+                not kwargs.get("deterministic", True):
+            return next_fun(*args, **kwargs)
         hidden = args[0] if args else kwargs.get("hidden_states")
         if hidden is None:
             return next_fun(*args, **kwargs)

@@ -30,7 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_mode as _interpret
-from .flash_attention import _DEAD_ROW_LSE, _NEG_INF, _pad_to, _score_mask
+from .flash_attention import _NEG_INF, _pad_to, _score_mask
 
 
 def _kernel(idx_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
@@ -77,7 +77,7 @@ def _kernel(idx_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
 def _fwd(q, k, v, idx, valid, block, causal, scale, sq):
     """q/k/v padded [B, H, S_p, D_p]; idx/valid [H, nq, maxk] int32."""
-    B, H, sq_p, D = q.shape
+    B, H, _, D = q.shape  # S is layout-aligned already; only D is padded
     nq, maxk = idx.shape[1], idx.shape[2]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -303,12 +303,6 @@ class DeepSpeedEngine:
         # ------------------------------------------- progressive layer drop
         pld_cfg = getattr(config, "pld_config", None)
         if pld_cfg is not None and pld_cfg.enabled:
-            if zc.zero_quantized_gradients or self._onebit_opt is not None:
-                # their manual-SPMD micros shard every input over dp; the
-                # rank-0 theta / (2,) rng key can't ride that convention
-                raise NotImplementedError(
-                    "progressive_layer_drop cannot combine with "
-                    "zero_quantized_gradients or 1-bit optimizers")
             import inspect
             target = model.__call__ if self._flax else model
             # non-flax models additionally receive the rng key explicitly
@@ -333,6 +327,12 @@ class DeepSpeedEngine:
                 theta=pld_cfg.theta, gamma=pld_cfg.gamma)
         else:
             self.progressive_layer_drop = None
+        # the PLD theta scalar + rng key ride the END of the micro's input
+        # tuple and are replicated (not dp-sharded) by the manual-SPMD
+        # micros (qgZ / 1-bit) — reference composes PLD with comm
+        # compression the same way (engine-level curriculum, orthogonal)
+        self._n_replicated_batch_tail = (
+            2 if self.progressive_layer_drop is not None else 0)
 
         # ----------------------------------------------- eigenvalue (compression)
         eig_cfg = getattr(config, "eigenvalue_config", None)

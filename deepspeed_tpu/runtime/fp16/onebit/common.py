@@ -84,8 +84,9 @@ def build_local_grad_micro(engine):
     loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
     def micro(params, scale, inputs):
-        batch_specs = tuple(
-            P(*([axes] + [None] * (x.ndim - 1))) for x in inputs)
+        from ...utils import batch_input_specs
+        batch_specs = batch_input_specs(inputs, axes,
+                                        engine._n_replicated_batch_tail)
         param_specs = jax.tree_util.tree_map(lambda _: P(), params)
 
         def body(params, inputs):

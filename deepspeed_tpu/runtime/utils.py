@@ -110,3 +110,16 @@ def make_scaled_loss_fn(apply_fn, gas):
         return loss.astype(jnp.float32) * scale / gas, loss
 
     return loss_fn
+
+
+def batch_input_specs(inputs, axes, n_replicated_tail=0):
+    """shard_map in_specs for a micro-step's batch inputs: leading dim
+    sharded over the dp ``axes``, except the last ``n_replicated_tail``
+    inputs which are REPLICATED (engine-appended extras that aren't
+    per-sample data — e.g. PLD's theta scalar and rng key)."""
+    from jax.sharding import PartitionSpec as P
+    n = len(inputs)
+    return tuple(
+        P() if i >= n - n_replicated_tail
+        else P(*([axes] + [None] * (x.ndim - 1)))
+        for i, x in enumerate(inputs))

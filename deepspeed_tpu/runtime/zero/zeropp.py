@@ -276,8 +276,9 @@ def build_manual_dp_micro(engine):
             master_specs = jax.tree_util.tree_map(
                 _manual_spec, master_specs,
                 is_leaf=lambda x: isinstance(x, P))
-        batch_specs = tuple(
-            P(*([dp_axes] + [None] * (x.ndim - 1))) for x in inputs)
+        from ..utils import batch_input_specs
+        batch_specs = batch_input_specs(inputs, dp_axes,
+                                        engine._n_replicated_batch_tail)
 
         def body(params, inputs):
             # stage-3: reassemble full params from local shards (int8 when qwZ)

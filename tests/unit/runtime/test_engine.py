@@ -542,3 +542,48 @@ def test_muon_excludes_embeddings_and_head():
     assert st2.nu["mlp"]["kernel"].shape == ()
     assert all(np.isfinite(np.asarray(l)).all()
                for l in jax.tree_util.tree_leaves(updates))
+
+
+@pytest.mark.parametrize("combo", ["qgz", "onebit"])
+def test_pld_composes_with_comm_compression(combo):
+    """PLD is an engine-level curriculum, orthogonal to comm compression —
+    the reference composes them (round-2 weak #3: we rejected).  The
+    manual-SPMD micros replicate PLD's theta/rng tail instead of
+    dp-sharding it."""
+    import flax.linen as nn
+
+    class PldNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, pld_theta=None):
+            h = nn.Dense(16, name="fc")(x)
+            if pld_theta is not None:
+                h = h * pld_theta
+            return jnp.mean((h - y) ** 2)
+
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 1,
+           "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                      "gamma": 0.5}}
+    if combo == "qgz":
+        cfg["optimizer"] = {"type": "adam", "params": {"lr": 1e-3}}
+        cfg["zero_optimization"] = {"stage": 2,
+                                    "zero_quantized_gradients": True}
+    else:
+        cfg["optimizer"] = {"type": "onebitadam",
+                            "params": {"lr": 1e-3,
+                                       "freeze_step": 2}}
+        cfg["zero_optimization"] = {"stage": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=PldNet(), config=cfg)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    engine.initialize_parameters(0, x, 0.5 * x)
+    assert engine.progressive_layer_drop is not None
+    losses, thetas = [], []
+    for _ in range(4):
+        loss = engine(x, 0.5 * x)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+        thetas.append(engine.progressive_layer_drop.get_theta())
+    assert losses[-1] < losses[0], losses
+    assert thetas[0] > thetas[-1] > 0.5  # curriculum annealed
