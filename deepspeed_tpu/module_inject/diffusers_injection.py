@@ -130,8 +130,8 @@ def make_interceptor(policies=None, counter=None, assume_full_mask=False):
         # the fused kernel has none, so non-deterministic calls with a
         # nonzero rate keep the original implementation
         rate = getattr(context.module, "dropout", 0.0)
-        if isinstance(rate, (int, float)) and rate > 0 and \
-                not kwargs.get("deterministic", True):
+        det = args[2] if len(args) > 2 else kwargs.get("deterministic", True)
+        if isinstance(rate, (int, float)) and rate > 0 and not det:
             return next_fun(*args, **kwargs)
         hidden = args[0] if args else kwargs.get("hidden_states")
         if hidden is None:

@@ -38,15 +38,21 @@ def test_cached_decode_matches_full_forward(family):
                          jnp.int32)
     out = eng.generate(prompt, max_new_tokens=6)
     assert out.shape == (2, 11)
+    # the prompt must survive verbatim (the old growing-prefix oracle
+    # checked this implicitly; the single-forward oracle below is
+    # teacher-forcing self-consistent and would miss a clobbered prompt)
+    np.testing.assert_array_equal(np.asarray(out[:, :prompt.shape[1]]),
+                                  np.asarray(prompt))
 
-    # oracle: recompute logits on the growing prefix each step
-    seq = prompt
-    for _ in range(6):
-        logits = model.apply({"params": jax.tree.map(
-            lambda x: x.astype(jnp.float32), params)}, seq)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
-        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+    # oracle: ONE causal forward over the final sequence gives every
+    # prefix's next-token logits (position t-1 sees exactly prefix ≤ t-1),
+    # so the greedy chain is checked without recompiling per prefix length
+    logits = model.apply({"params": jax.tree.map(
+        lambda x: x.astype(jnp.float32), params)}, out)
+    for t in range(prompt.shape[1], out.shape[1]):
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(logits[:, t - 1]), axis=-1),
+            np.asarray(out[:, t]), err_msg=f"step {t}")
 
 
 def test_tp_sharded_generate():

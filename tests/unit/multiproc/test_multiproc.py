@@ -35,7 +35,10 @@ def _free_port():
     return port
 
 
-def _launch_workers(zero_stage, ckpt_dir="", timeout=420):
+def _launch_workers(stage_spec, ckpt_dir="", timeout=420):
+    """One 2-process launch running every comma-separated stage leg —
+    per-launch interpreter+jax boots dominated this block, so the suite
+    boots the pair ONCE (see worker docstring).  Returns {leg: losses}."""
     port = _free_port()
     repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                              "..", "..", ".."))
@@ -45,7 +48,7 @@ def _launch_workers(zero_stage, ckpt_dir="", timeout=420):
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(pid), "2", str(port),
-             str(zero_stage), ckpt_dir],
+             stage_spec, ckpt_dir],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
         for pid in range(2)
@@ -61,12 +64,14 @@ def _launch_workers(zero_stage, ckpt_dir="", timeout=420):
         outs.append((p.returncode, out, err))
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\n--- stdout\n{out}\n--- stderr\n{err[-3000:]}"
-    losses = None
+    losses = {}
     for rc, out, err in outs:
         for line in out.splitlines():
-            if line.startswith("LOSSES "):
-                losses = [float(v) for v in line.split()[1:]]
-    assert losses is not None, "rank 0 printed no LOSSES line"
+            if line.startswith("LOSSES-"):
+                tag, _, rest = line.partition(" ")
+                losses[tag.removeprefix("LOSSES-")] = [
+                    float(v) for v in rest.split()]
+    assert losses, "rank 0 printed no LOSSES lines"
     return losses
 
 
@@ -118,29 +123,39 @@ def _single_process_reference(zero_stage, with_ckpt=False, tmp_path=None):
     return losses
 
 
+@pytest.fixture(scope="module")
+def worker_losses(tmp_path_factory):
+    """ONE 2-process launch serves every test below: stage-1 and stage-3
+    parity legs plus the stage-2 checkpoint leg."""
+    ckpt_root = str(tmp_path_factory.mktemp("mp_ckpt"))
+    losses = _launch_workers("1,3,2c", ckpt_dir=ckpt_root)
+    return losses, ckpt_root
+
+
 @pytest.mark.parametrize("zero_stage", [1, 3])
-def test_two_process_zero_matches_single_process(zero_stage, tmp_path):
-    got = _launch_workers(zero_stage)
+def test_two_process_zero_matches_single_process(zero_stage, worker_losses):
+    got = worker_losses[0][str(zero_stage)]
     ref = _single_process_reference(zero_stage)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
 
 
-def test_two_process_checkpoint_roundtrip(tmp_path):
-    ckpt = str(tmp_path / "mp_ckpt")
-    got = _launch_workers(2, ckpt_dir=ckpt)
+def test_two_process_checkpoint_roundtrip(worker_losses, tmp_path):
+    losses, ckpt_root = worker_losses
+    got = losses["2c"]
     ref = _single_process_reference(2, with_ckpt=True, tmp_path=tmp_path)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
-    assert os.path.isdir(ckpt)
+    assert os.path.isdir(os.path.join(ckpt_root, "2c"))
 
 
-def test_cross_world_size_resume(tmp_path):
+def test_cross_world_size_resume(worker_losses):
     """A checkpoint written by a 2-process (dp=8 over 2×4 devices) run must
     resume in a SINGLE process at the same global topology — the reference's
     DistributedFixture elastic-resize pattern (``tests/unit/common.py:355``:
     save at one world size, consume at another). Orbax global arrays make
     this topology-free by construction; this proves it end-to-end."""
-    ckpt = str(tmp_path / "resize_ckpt")
-    got = _launch_workers(2, ckpt_dir=ckpt)   # workers save+reload at step 2
+    losses, ckpt_root = worker_losses
+    got = losses["2c"]                 # workers saved+reloaded at step 2
+    ckpt = os.path.join(ckpt_root, "2c")
 
     engine, rng, W = _make_engine_and_stream(zero_stage=2)
     # consume the first two batches (trained by the 2-proc run pre-save)

@@ -9,7 +9,13 @@ ZeRO training steps, and rank 0 prints per-step losses for the parent test
 to compare against a single-process run.  Optionally round-trips a
 checkpoint mid-run.
 
-Usage: worker_zero_parity.py <pid> <nproc> <port> <zero_stage> <ckpt_dir?>
+Usage: worker_zero_parity.py <pid> <nproc> <port> <stage_spec> <ckpt_dir?>
+
+``stage_spec``: comma-separated zero stages run back-to-back in THIS
+process pair (one interpreter/jax boot serves all legs — the multiproc
+block was dominated by per-launch imports).  A stage suffixed ``c``
+round-trips a checkpoint mid-run (dir = <ckpt_dir>/<stage>).  Rank 0
+prints one ``LOSSES-<spec-entry> ...`` line per leg.
 """
 
 import os
@@ -18,7 +24,7 @@ import sys
 
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
-    zero_stage = int(sys.argv[4])
+    stage_spec = sys.argv[4]
     ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else ""
 
     flags = os.environ.get("XLA_FLAGS", "")
@@ -34,6 +40,20 @@ def main():
 
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # share the suite's persistent compile cache — each worker pair
+    # otherwise recompiles the same SPMD programs from scratch (the
+    # multiproc tests were the slowest block in the suite)
+    cache = os.environ.get(
+        "DS_TPU_TEST_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cache))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
 
     import numpy as np
     import jax.numpy as jnp
@@ -41,6 +61,8 @@ def main():
 
     import deepspeed_tpu
     from deepspeed_tpu.utils import groups
+
+    import deepspeed_tpu.comm as dist
 
     D = 16
 
@@ -51,44 +73,54 @@ def main():
             out = nn.Dense(D, name="fc2")(h)
             return jnp.mean((out - y) ** 2)
 
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=Net(),
-        config={"train_micro_batch_size_per_gpu": 1,
-                "gradient_accumulation_steps": 1,
-                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
-                "zero_optimization": {"stage": zero_stage},
-                "mesh": {"dp": 8}})
-    assert jax.process_count() == nproc, jax.process_count()
-    assert jax.device_count() == 8
-    dp_rank = groups._get_data_parallel_rank()
-    assert dp_rank == pid * 4, (dp_rank, pid)
-    local_rows = 8 // nproc
+    first = True
+    for entry in stage_spec.split(","):
+        with_ckpt = entry.endswith("c")
+        zero_stage = int(entry.rstrip("c"))
+        if not first:
+            groups.reset_mesh()
+            dist.destroy_process_group()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=Net(),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": zero_stage},
+                    "mesh": {"dp": 8}})
+        if first:
+            assert jax.process_count() == nproc, jax.process_count()
+            assert jax.device_count() == 8
+        dp_rank = groups._get_data_parallel_rank()
+        assert dp_rank == pid * 4, (dp_rank, pid)
+        local_rows = 8 // nproc
 
-    rng = np.random.default_rng(0)
-    W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
-    sample = rng.standard_normal((8, D)).astype(np.float32)
-    engine.initialize_parameters(0, sample, sample @ W)
+        rng = np.random.default_rng(0)
+        W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
+        sample = rng.standard_normal((8, D)).astype(np.float32)
+        engine.initialize_parameters(0, sample, sample @ W)
 
-    def run_steps(n):
-        out = []
-        for _ in range(n):
-            x = rng.standard_normal((8, D)).astype(np.float32)
-            y = x @ W
-            sl = slice(dp_rank, dp_rank + local_rows)
-            loss = engine(x[sl], y[sl])
-            engine.backward(loss)
-            engine.step()
-            out.append(float(loss))
-        return out
+        def run_steps(n):
+            out = []
+            for _ in range(n):
+                x = rng.standard_normal((8, D)).astype(np.float32)
+                y = x @ W
+                sl = slice(dp_rank, dp_rank + local_rows)
+                loss = engine(x[sl], y[sl])
+                engine.backward(loss)
+                engine.step()
+                out.append(float(loss))
+            return out
 
-    losses = run_steps(2)
-    if ckpt_dir:
-        engine.save_checkpoint(ckpt_dir, tag="mp")
-        engine.load_checkpoint(ckpt_dir, tag="mp")
-    losses += run_steps(2)
-
-    if pid == 0:
-        print("LOSSES " + " ".join(f"{v:.8f}" for v in losses), flush=True)
+        losses = run_steps(2)
+        if with_ckpt:
+            leg_dir = os.path.join(ckpt_dir, entry)
+            engine.save_checkpoint(leg_dir, tag="mp")
+            engine.load_checkpoint(leg_dir, tag="mp")
+        losses += run_steps(2)
+        if pid == 0:
+            print(f"LOSSES-{entry} " +
+                  " ".join(f"{v:.8f}" for v in losses), flush=True)
+        first = False
 
 
 if __name__ == "__main__":

@@ -99,17 +99,17 @@ def test_qwz_fp_wire_format(fmt):
     from jax.sharding import Mesh, PartitionSpec as P
     from deepspeed_tpu.runtime.zero.zeropp import quantized_all_gather
 
-    # 4 devices halve the shard_map program (the 8-wide variant was the
-    # single slowest compile in the suite; wire-format correctness does
-    # not depend on the group width)
-    devs = np.array(jax.devices()[:4])
+    # 2 devices minimize the shard_map program (wire-format correctness
+    # does not depend on the group width; the 8-wide variant was the
+    # single slowest compile in the suite)
+    devs = np.array(jax.devices()[:2])
     mesh = Mesh(devs, ("dp", ))
     x = np.random.default_rng(4).standard_normal((4, 256)).astype(np.float32)
     fn = jax.shard_map(
         lambda t: quantized_all_gather(t, ("dp", ), 0, wire_format=fmt,
                                        group_size=128),
         mesh=mesh, in_specs=(P("dp"), ), out_specs=P("dp"), check_vma=False)
-    out = np.asarray(fn(jnp.asarray(x)))[:4]
+    out = np.asarray(fn(jnp.asarray(x)))[:4]  # compare the full array
     denom = np.maximum(np.abs(x), 1e-3)
     tol = 0.05 if fmt == "fp8" else 0.2
     assert np.median(np.abs(out - x) / denom) < tol

@@ -218,23 +218,15 @@ def test_flax_module_init():
 
 def test_offload_reload_states():
     """offload_states releases device state; training resumes identically
-    after reload (auto-reload on the next step)."""
-    import deepspeed_tpu
-    from deepspeed_tpu.models import llama
-    import jax
-
-    cfg = llama.llama_tiny(dtype="float32", remat=False)
-    model = llama.LlamaModel(cfg)
+    after reload (auto-reload on the next step).  Model-agnostic machinery
+    — the cheap MLP keeps the three train-step compiles fast."""
+    params0 = make_simple_mlp_params(HIDDEN)
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        config={"train_micro_batch_size_per_gpu": 2,
-                "gradient_accumulation_steps": 1,
-                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                "zero_optimization": {"stage": 2}})
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(16, 16)).astype(np.int32)
-    engine.initialize_parameters(0, ids, ids)
-    loss0 = engine(ids, ids); engine.backward(loss0); engine.step()
+        model=simple_mlp_apply, model_parameters=params0,
+        config=_config(stage=2))
+    data = batches(random_dataset(32, HIDDEN), 4 * engine.dp_world_size)
+    x, y = data[0]
+    loss0 = engine(x, y); engine.backward(loss0); engine.step()
 
     engine.offload_states()
     assert engine.params is None and engine.opt_state is None
@@ -244,18 +236,18 @@ def test_offload_reload_states():
 
     engine.reload_states()
     assert engine.params is not None
-    l1 = float(engine(ids, ids)); engine.backward(l1); engine.step()
+    l1 = float(engine(x, y)); engine.backward(l1); engine.step()
 
     # optim-only offload: a plain forward must NOT drag opt_state back to
     # device (the RLHF use-case — generation with optimizer state on host)
     engine.offload_states(include=["optim_states", "hp_params"])
     assert engine.opt_state is None and engine.params is not None
     engine.eval()
-    engine(ids)
+    engine(x, y)
     assert engine.opt_state is None, "forward reloaded optimizer state"
     engine.train()
     # step() at the boundary brings it back
-    l2 = engine(ids, ids); engine.backward(l2); engine.step()
+    l2 = engine(x, y); engine.backward(l2); engine.step()
     assert engine.opt_state is not None
     assert float(l2) < float(loss0)
 
@@ -265,7 +257,7 @@ def test_offload_reload_states():
     with tempfile.TemporaryDirectory() as d:
         engine.save_checkpoint(d, tag="t")
         assert engine.params is not None  # resident again for the save
-        l3 = engine(ids, ids); engine.backward(l3); engine.step()
+        l3 = engine(x, y); engine.backward(l3); engine.step()
         engine.load_checkpoint(d, tag="t")
     assert engine.params is not None and engine.opt_state is not None
 
