@@ -108,14 +108,18 @@ class PipelineEngine(DeepSpeedEngine):
         specs = model.specs
         sig = [(s.typename, s.module_args, tuple(sorted(s.module_kwargs.items())))
                for s in specs]
-        # longest run of equal signatures
+        # longest run of equal signatures; tied specs are NEVER block
+        # candidates (a tied pair around a single block would otherwise
+        # outrank the block run and land in the stacked region)
+        eligible = [not isinstance(s, TiedLayerSpec) for s in specs]
         best_start, best_len = 0, 0
         i = 0
         while i < len(sig):
             j = i
-            while j < len(sig) and sig[j] == sig[i]:
+            while j < len(sig) and sig[j] == sig[i] and \
+                    eligible[j] == eligible[i]:
                 j += 1
-            if j - i > best_len:
+            if eligible[i] and j - i > best_len:
                 best_start, best_len = i, j - i
             i = j
         if best_len < 1:
@@ -128,17 +132,50 @@ class PipelineEngine(DeepSpeedEngine):
         self.block_proto = self.block_specs[0].build()
         self.post_layers = [s.build() for s in self.post_specs]
         self.loss_fn = model.loss_fn
+        # Tied layers (reference TiedLayerSpec + tied-grad allreduce,
+        # ``pipe/module.py:77`` / ``engine.py _exec_reduce_tied_grads``):
+        # occurrences SHARE one param subtree under params["tied"][key].
+        # pre/post params are replicated over pp in the fused program, so
+        # the existing psum of their gradients across stages IS the
+        # reference's tied-gradient allreduce — no extra machinery.
+        # ``forward_fn`` (the reuse-site forward, e.g. lambda m, x:
+        # m.attend(x)) runs via flax's ``method=``.
+        self.pre_tied = [s.key if isinstance(s, TiedLayerSpec) else None
+                         for s in self.pre_specs]
+        self.post_tied = [s.key if isinstance(s, TiedLayerSpec) else None
+                          for s in self.post_specs]
 
     # ------------------------------------------------------------- model fns
+    def _layer_params(self, params, region, i, tied_key):
+        """Param subtree for pre/post layer i — tied layers read the shared
+        ``params["tied"][key]`` copy."""
+        if tied_key is not None:
+            return params["tied"][tied_key]
+        return params[region][f"layer_{i}"]
+
+    def _apply_region(self, params, region, x):
+        """Apply the pre or post layer list — THE single definition of the
+        non-block forward (tied lookup + per-spec forward_fn), shared by
+        the plain apply, the fused pipeline, and eval."""
+        layers, tied, specs = (
+            (self.pre_layers, self.pre_tied, self.pre_specs)
+            if region == "pre" else
+            (self.post_layers, self.post_tied, self.post_specs))
+        for i, layer in enumerate(layers):
+            p = self._layer_params(params, region, i, tied[i])
+            fwd = getattr(specs[i], "forward_fn", None)
+            if fwd is not None:
+                x = layer.apply({"params": p}, x, method=fwd)
+            else:
+                x = layer.apply({"params": p}, x)
+        return x
+
     def _forward_full(self, params, x):
         """pre → blocks → post over the stacked params (the single source
         of the non-pipelined forward composition)."""
-        for i, layer in enumerate(self.pre_layers):
-            x = layer.apply({"params": params["pre"][f"layer_{i}"]}, x)
+        x = self._apply_region(params, "pre", x)
         x = self._stage_scan(params["blocks"], self._block_valid, x)
-        for i, layer in enumerate(self.post_layers):
-            x = layer.apply({"params": params["post"][f"layer_{i}"]}, x)
-        return x
+        return self._apply_region(params, "post", x)
 
     def _build_apply(self):
         """A plain (non-pipelined) apply over the same params — used for
@@ -175,11 +212,19 @@ class PipelineEngine(DeepSpeedEngine):
         *inputs, labels = sample_batch
         x = jnp.asarray(inputs[0]) if len(inputs) == 1 else tuple(
             map(jnp.asarray, inputs))
-        pre = {}
+        pre, tied = {}, {}
         for i, layer in enumerate(self.pre_layers):
             rng, sub = jax.random.split(rng)
-            pre[f"layer_{i}"] = layer.init(sub, x)["params"]
-            x = layer.apply({"params": pre[f"layer_{i}"]}, x)
+            key = self.pre_tied[i]
+            fwd = getattr(self.pre_specs[i], "forward_fn", None)
+            mkw = {"method": fwd} if fwd is not None else {}
+            if key is not None:
+                if key not in tied:
+                    tied[key] = layer.init(sub, x, **mkw)["params"]
+                x = layer.apply({"params": tied[key]}, x, **mkw)
+            else:
+                pre[f"layer_{i}"] = layer.init(sub, x, **mkw)["params"]
+                x = layer.apply({"params": pre[f"layer_{i}"]}, x, **mkw)
 
         rng, sub = jax.random.split(rng)
         layer_rngs = jax.random.split(sub, self.n_blocks)
@@ -194,10 +239,20 @@ class PipelineEngine(DeepSpeedEngine):
         post = {}
         for i, layer in enumerate(self.post_layers):
             rng, sub = jax.random.split(rng)
-            post[f"layer_{i}"] = layer.init(sub, x)["params"]
-            x = layer.apply({"params": post[f"layer_{i}"]}, x)
+            key = self.post_tied[i]
+            fwd = getattr(self.post_specs[i], "forward_fn", None)
+            mkw = {"method": fwd} if fwd is not None else {}
+            if key is not None:
+                if key not in tied:
+                    tied[key] = layer.init(sub, x, **mkw)["params"]
+                x = layer.apply({"params": tied[key]}, x, **mkw)
+            else:
+                post[f"layer_{i}"] = layer.init(sub, x, **mkw)["params"]
+                x = layer.apply({"params": post[f"layer_{i}"]}, x, **mkw)
 
         params = {"pre": pre, "blocks": blocks, "post": post}
+        if tied:
+            params["tied"] = tied
         shardings = self.plan.master_shardings(params)
         params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params, shardings)
@@ -233,15 +288,11 @@ class PipelineEngine(DeepSpeedEngine):
         engine_self = self
         loss_fn = self.loss_fn
 
-        def pre_apply(pre_params, x):
-            for i, layer in enumerate(engine_self.pre_layers):
-                x = layer.apply({"params": pre_params[f"layer_{i}"]}, x)
-            return x
+        def pre_apply(params, x):
+            return engine_self._apply_region(params, "pre", x)
 
-        def post_apply(post_params, x):
-            for i, layer in enumerate(engine_self.post_layers):
-                x = layer.apply({"params": post_params[f"layer_{i}"]}, x)
-            return x
+        def post_apply(params, x):
+            return engine_self._apply_region(params, "post", x)
 
         def pipe(params, valid_local, batch_mb, labels_mb):
             """Runs inside shard_map over ("pp",).  blocks leaves are the
@@ -251,7 +302,7 @@ class PipelineEngine(DeepSpeedEngine):
             perm = [(i, (i + 1) % pp) for i in range(pp)]
 
             # boundary-state geometry from one microbatch (trace-only)
-            h_shape = jax.eval_shape(pre_apply, params["pre"], batch_mb[0])
+            h_shape = jax.eval_shape(pre_apply, params, batch_mb[0])
 
             def tick_body(carry, t):
                 state, total_loss, logit_acc = carry
@@ -265,7 +316,7 @@ class PipelineEngine(DeepSpeedEngine):
                 def feed_branch(state):
                     b = jax.lax.dynamic_index_in_dim(
                         batch_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                    return pre_apply(params["pre"], b)
+                    return pre_apply(params, b)
 
                 x = jax.lax.cond(
                     jnp.logical_and(stage == 0, t < M),
@@ -280,7 +331,7 @@ class PipelineEngine(DeepSpeedEngine):
                     lbl = jax.lax.dynamic_index_in_dim(
                         labels_mb, jnp.clip(m_idx, 0, M - 1), 0,
                         keepdims=False)
-                    out = post_apply(params["post"], y)
+                    out = post_apply(params, y)
                     l = (loss_fn(out, lbl).astype(jnp.float32)
                          if loss_fn is not None else jnp.zeros((), jnp.float32))
                     if logit_acc is not None:
@@ -290,7 +341,7 @@ class PipelineEngine(DeepSpeedEngine):
                 def skip_branch(y):
                     z = jnp.zeros((), jnp.float32)
                     if logit_acc is not None:
-                        out_sd = jax.eval_shape(post_apply, params["post"], y)
+                        out_sd = jax.eval_shape(post_apply, params, y)
                         return z, jnp.zeros(out_sd.shape, logit_acc.dtype)
                     return z
 
@@ -310,7 +361,7 @@ class PipelineEngine(DeepSpeedEngine):
             state0 = jnp.zeros(h_shape.shape, h_shape.dtype)
             if with_logits:
                 out_shape = jax.eval_shape(
-                    lambda p, h: post_apply(p, h), params["post"], state0)
+                    lambda p, h: post_apply(p, h), params, state0)
                 logit_acc0 = jnp.zeros((M, ) + out_shape.shape,
                                        out_shape.dtype)
             else:
@@ -335,6 +386,9 @@ class PipelineEngine(DeepSpeedEngine):
                                                  params["blocks"]),
                 "post": jax.tree_util.tree_map(lambda _: P(), params["post"]),
             }
+            if "tied" in params:  # shared copies: replicated like pre/post
+                param_specs["tied"] = jax.tree_util.tree_map(
+                    lambda _: P(), params["tied"])
             out_specs = (P(), P()) if with_logits else P()
             return jax.shard_map(
                 pipe, mesh=mesh,

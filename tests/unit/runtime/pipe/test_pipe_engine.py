@@ -311,3 +311,114 @@ def test_pipe_eval_batch_logits_pp1():
     expect_mse = float(np.mean((np.asarray(logits) - (x @ W)) ** 2))
     np.testing.assert_allclose(float(loss), expect_mse, rtol=1e-4)
     _teardown()
+
+
+class _TiedEmbed(nn.Module):
+    """Tied embedding/head (reference TiedLayerSpec usage): embeds int
+    inputs, projects float hiddens back to the vocab via the SAME table."""
+    @nn.compact
+    def __call__(self, x):
+        embed = nn.Embed(VOCAB, D, name="wte")
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return embed(x)
+        return embed.attend(x)
+
+
+def _tied_module(n_blocks=4):
+    from deepspeed_tpu.runtime.pipe import TiedLayerSpec
+    return PipelineModule(
+        layers=([TiedLayerSpec("embed", _TiedEmbed)] +
+                [LayerSpec(Block) for _ in range(n_blocks)] +
+                [TiedLayerSpec("embed", _TiedEmbed)]),
+        loss_fn=_xent)
+
+
+def _run_tied(pp, steps=4):
+    model = _tied_module()
+    dp = 8 // pp
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 8 // dp,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
+                "mesh": {"pp": pp, "dp": -1}})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, VOCAB, size=(8, 8)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    # ONE shared table: a single "tied" subtree, no per-occurrence copies
+    assert "tied" in engine.params and "embed" in engine.params["tied"]
+    assert not engine.params["pre"] and not engine.params["post"]
+
+    def gen():
+        r = np.random.default_rng(42)
+        while True:
+            x = r.integers(0, VOCAB, size=(8, 8)).astype(np.int32)
+            yield (x, x)
+
+    it = gen()
+    losses = [float(engine.train_batch(it)) for _ in range(steps)]
+    table = np.asarray(engine.params["tied"]["embed"]["wte"]["embedding"])
+    _teardown()
+    return losses, table
+
+
+def test_tied_embed_head_pipeline():
+    """TiedLayerSpec: the embed and head occurrences share one table; the
+    pp=2 fused program (embedding on stage 0, attend-head on stage 1)
+    matches pp=1 exactly — the pp-psum of the replicated tied params'
+    grads IS the reference's tied-grad allreduce."""
+    ref, table1 = _run_tied(pp=1)
+    got, table2 = _run_tied(pp=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-4)
+    np.testing.assert_allclose(table2, table1, rtol=1e-4, atol=1e-5)
+    assert ref[-1] < ref[0]  # and it actually learns
+
+
+def test_tied_forward_fn_reuse_site():
+    """The documented reference pattern: the head occurrence reuses the
+    embedding via ``forward_fn`` (flax ``method=``); a single-block model
+    also checks tied specs never get classified as the block run."""
+    from deepspeed_tpu.runtime.pipe import TiedLayerSpec
+
+    class PlainEmbed(nn.Module):
+        def setup(self):
+            self.wte = nn.Embed(VOCAB, D)
+
+        def __call__(self, ids):
+            return self.wte(ids)
+
+        def attend_out(self, x):
+            return self.wte.attend(x)
+
+    model = PipelineModule(
+        layers=[TiedLayerSpec("embed", PlainEmbed),
+                LayerSpec(Block),
+                TiedLayerSpec("embed", PlainEmbed,
+                              forward_fn=PlainEmbed.attend_out)],
+        loss_fn=_xent)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 3e-2}},
+                "mesh": {"pp": 1, "dp": -1}})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, VOCAB, size=(8, 6)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    assert engine.n_blocks == 1  # the Block, not a tied spec
+    assert "embed" in engine.params.get("tied", {})
+    assert not engine.params["pre"] and not engine.params["post"]
+
+    def gen():
+        r = np.random.default_rng(1)
+        while True:
+            x = r.integers(0, VOCAB, size=(8, 6)).astype(np.int32)
+            yield (x, x)
+
+    it = gen()
+    losses = [float(engine.train_batch(it)) for _ in range(20)]
+    # single tiny block + small-init table: the copy task moves slowly —
+    # the assertions above prove the forward_fn/tie mechanism; here we
+    # just need the tied gradient path to actually descend
+    assert losses[-1] < losses[0], losses
+    _teardown()
