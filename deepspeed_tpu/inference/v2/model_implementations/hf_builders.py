@@ -23,11 +23,11 @@ from ....utils.logging import logger
 SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral", "phi3",
                          "falcon", "opt", "phi", "qwen2_moe", "qwen",
                          "bloom", "gpt_neox", "gptj", "bert",
-                         "gpt_neo")
+                         "gpt_neo", "gpt2", "distilbert")
 
 # ingestable for v1 kernel-injection serving only — no ragged (v2) forward
 V1_ONLY_MODEL_TYPES = ("bloom", "gpt_neox", "gptj", "bert",
-                       "gpt_neo")
+                       "gpt_neo", "gpt2", "distilbert")
 
 _SKIP_SUFFIXES = (".rotary_emb.inv_freq", ".masked_bias", ".attn.bias")
 
@@ -1006,6 +1006,190 @@ def _ingest_falcon(cfg: FalconConfig,
     return tree
 
 
+def _gpt2_config_from_hf(cfg: dict, dtype: str):
+    """HF GPT2Config → GPT2Config (reference container ``containers/gpt2.py``
+    HFGPT2LayerPolicy; Conv1D weights are [in, out] — no transpose)."""
+    from ....models.gpt2 import GPT2Config
+    act = cfg.get("activation_function", "gelu_new")
+    if act != "gelu_new":
+        # GPT2Block hardcodes the tanh approximation (gelu_new); serving an
+        # erf-gelu checkpoint through it would silently diverge
+        raise ValueError(f"gpt2 activation_function {act!r} is not "
+                         "supported (gelu_new only)")
+    n_embd = cfg.get("n_embd", cfg.get("hidden_size"))
+    n_inner = cfg.get("n_inner")
+    if n_inner is not None and n_inner != 4 * n_embd:
+        raise ValueError(
+            f"gpt2 n_inner={n_inner} is not supported (the block hardcodes "
+            f"the 4*hidden MLP width = {4 * n_embd})")
+    return GPT2Config(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg.get("n_embd", cfg.get("hidden_size")),
+        num_hidden_layers=cfg.get("n_layer", cfg.get("num_hidden_layers")),
+        num_attention_heads=cfg.get("n_head", cfg.get("num_attention_heads")),
+        max_position_embeddings=cfg.get("n_positions",
+                                        cfg.get("max_position_embeddings",
+                                                1024)),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+        dtype=dtype, remat=False)
+
+
+def _ingest_gpt2(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF GPT2LMHeadModel → flax.  Conv1D stores [in, out]; the fused
+    c_attn [D, 3D] splits into q/k/v kernels [D, H, Dh]."""
+    H, Dh, D = cfg.num_attention_heads, cfg.head_dim, cfg.hidden_size
+    tree: Dict = {}
+    for name, arr in params_iter:
+        name = name.removeprefix("transformer.")
+        if name.endswith(_SKIP_SUFFIXES) or name == "lm_head.weight":
+            continue  # lm_head is tied to wte
+        if name == "wte.weight":
+            _set(tree, ("wte", "embedding"), arr)
+        elif name == "wpe.weight":
+            _set(tree, ("wpe", "embedding"), arr)
+        elif name.startswith("ln_f."):
+            kind = name.rsplit(".", 1)[1]
+            _set(tree, ("ln_f", "scale" if kind == "weight" else "bias"), arr)
+        elif name.startswith("h."):
+            _, idx, rest = name.split(".", 2)
+            layer = f"h_{idx}"
+            kind = rest.rsplit(".", 1)[1]
+            if rest.startswith("attn.c_attn."):
+                if kind == "weight":   # [D, 3D] Conv1D
+                    for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+                        _set(tree, (layer, proj, "kernel"),
+                             np.ascontiguousarray(
+                                 arr[:, i * D:(i + 1) * D]).reshape(D, H, Dh))
+                else:                  # [3D]
+                    for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+                        _set(tree, (layer, proj, "bias"),
+                             arr[i * D:(i + 1) * D].reshape(H, Dh))
+            elif rest.startswith("attn.c_proj."):
+                if kind == "weight":   # [D, D] Conv1D → [H, Dh, D]
+                    _set(tree, (layer, "c_proj", "kernel"),
+                         np.ascontiguousarray(arr).reshape(H, Dh, D))
+                else:
+                    _set(tree, (layer, "c_proj", "bias"), arr)
+            elif rest.startswith("mlp.c_fc."):
+                _set(tree, (layer, "c_fc", "kernel" if kind == "weight"
+                            else "bias"), arr)
+            elif rest.startswith("mlp.c_proj."):
+                _set(tree, (layer, "mlp_proj", "kernel" if kind == "weight"
+                            else "bias"), arr)
+            elif rest.startswith(("ln_1.", "ln_2.")):
+                ln = rest.split(".", 1)[0]
+                _set(tree, (layer, ln,
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF gpt2 ingest: skipping {name}")
+        else:
+            logger.warning(f"HF gpt2 ingest: skipping {name}")
+    return tree
+
+
+def _distilbert_config_from_hf(cfg: dict, dtype: str):
+    """HF DistilBertConfig → BertConfig (reference container
+    ``containers/distil_bert.py`` HFDistilBertLayerPolicy).  DistilBERT has
+    no token-type embeddings: type_vocab_size=1 with a zero table."""
+    from ....models.bert import BertConfig
+    if cfg.get("sinusoidal_pos_embds"):
+        raise ValueError("distilbert sinusoidal_pos_embds=True is not "
+                         "supported (learned positions only)")
+    if cfg.get("activation", "gelu") != "gelu":
+        raise ValueError(f"distilbert activation "
+                         f"{cfg.get('activation')!r} unsupported")
+    return BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg.get("dim", cfg.get("hidden_size")),
+        num_hidden_layers=cfg.get("n_layers", cfg.get("num_hidden_layers")),
+        num_attention_heads=cfg.get("n_heads",
+                                    cfg.get("num_attention_heads")),
+        intermediate_size=cfg.get("hidden_dim",
+                                  cfg.get("intermediate_size")),
+        max_position_embeddings=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=1, layer_norm_eps=1e-12,
+        mlm_transform=True, dtype=dtype, remat=False)
+
+
+def _ingest_distilbert(cfg, params_iter: Iterable[Tuple[str, np.ndarray]]):
+    """HF DistilBertForMaskedLM → flax (BertModel layout; the MLM head's
+    vocab_transform/vocab_layer_norm/vocab_projector map onto
+    mlm_dense/mlm_ln/mlm_bias, projector weight tied to the word
+    embeddings)."""
+    H, Dh, D = cfg.num_attention_heads, cfg.head_dim, cfg.hidden_size
+    tree: Dict = {}
+    proj_map = {"q_lin": "query", "k_lin": "key", "v_lin": "value"}
+    for name, arr in params_iter:
+        name = name.removeprefix("distilbert.")
+        kind = name.rsplit(".", 1)[1]
+        if name == "vocab_projector.weight":
+            continue  # tied to word_embeddings
+        if name.startswith("vocab_transform."):
+            _set(tree, ("mlm_dense", "kernel" if kind == "weight" else
+                        "bias"),
+                 np.ascontiguousarray(arr.T) if kind == "weight" else arr)
+        elif name.startswith("vocab_layer_norm."):
+            _set(tree, ("mlm_ln", "scale" if kind == "weight" else "bias"),
+                 arr)
+        elif name == "vocab_projector.bias":
+            _set(tree, ("mlm_bias", ), arr)
+        elif name.startswith("embeddings."):
+            base = name.split(".")[1]
+            if base in ("word_embeddings", "position_embeddings"):
+                _set(tree, (base, "embedding"), arr)
+            elif base == "LayerNorm":
+                _set(tree, ("embeddings_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF distilbert ingest: skipping {name}")
+        elif name.startswith("transformer.layer."):
+            _, _, idx, rest = name.split(".", 3)
+            layer = f"layer_{idx}"
+            head = rest.split(".")[0]
+            if head == "attention":
+                proj = rest.split(".")[1]
+                if proj in proj_map:
+                    if kind == "weight":
+                        _set(tree, (layer, proj_map[proj], "kernel"),
+                             np.ascontiguousarray(arr.T).reshape(D, H, Dh))
+                    else:
+                        _set(tree, (layer, proj_map[proj], "bias"),
+                             arr.reshape(H, Dh))
+                elif proj == "out_lin":
+                    if kind == "weight":
+                        _set(tree, (layer, "attention_output", "kernel"),
+                             np.ascontiguousarray(arr.T).reshape(H, Dh, D))
+                    else:
+                        _set(tree, (layer, "attention_output", "bias"), arr)
+            elif head == "sa_layer_norm":
+                _set(tree, (layer, "attention_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            elif head == "ffn":
+                lin = rest.split(".")[1]
+                target = "intermediate" if lin == "lin1" else "output"
+                _set(tree, (layer, target, "kernel" if kind == "weight"
+                            else "bias"),
+                     np.ascontiguousarray(arr.T) if kind == "weight"
+                     else arr)
+            elif head == "output_layer_norm":
+                _set(tree, (layer, "output_ln",
+                            "scale" if kind == "weight" else "bias"), arr)
+            else:
+                logger.warning(f"HF distilbert ingest: skipping {name}")
+        else:
+            logger.warning(f"HF distilbert ingest: skipping {name}")
+    # no token-type embeddings in distilbert: a zero table keeps the
+    # BertModel forward (which always adds the type embedding) exact
+    _set(tree, ("token_type_embeddings", "embedding"),
+         np.zeros((1, D), np.float32))
+    if "mlm_dense" not in tree or "mlm_bias" not in tree:
+        raise ValueError(
+            "distilbert checkpoint carries no MLM head weights "
+            "(vocab_transform/vocab_projector) — only "
+            "DistilBertForMaskedLM checkpoints are servable")
+    return tree
+
+
 def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
     """(model, params) from a checkpoint engine with a ``model_config`` dict
     (HF ``config.json``).  Reference analog: ``engine_factory.build_hf_engine``
@@ -1065,6 +1249,16 @@ def build_model_and_params(checkpoint_engine, dtype: str = "bfloat16"):
         cfg = _gpt_neo_config_from_hf(hf_cfg, dtype)
         params = _ingest_gpt_neo(cfg, checkpoint_engine.parameters())
         model = GPTNeoModel(cfg)
+    elif model_type == "gpt2":
+        from ....models.gpt2 import GPT2Model
+        cfg = _gpt2_config_from_hf(hf_cfg, dtype)
+        params = _ingest_gpt2(cfg, checkpoint_engine.parameters())
+        model = GPT2Model(cfg)
+    elif model_type == "distilbert":
+        from ....models.bert import BertModel
+        cfg = _distilbert_config_from_hf(hf_cfg, dtype)
+        params = _ingest_distilbert(cfg, checkpoint_engine.parameters())
+        model = BertModel(cfg)
     else:
         cfg = _llama_config_from_hf(hf_cfg, dtype)
         source = checkpoint_engine.parameters()

@@ -113,6 +113,20 @@ def _qwen2_moe_factory(hf_cfg, dtype="bfloat16"):
     return MixtralModel(_qwen2_moe_config_from_hf(hf_cfg, dtype))
 
 
+def _gpt2_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _gpt2_config_from_hf)
+    from ..models.gpt2 import GPT2Model
+    return GPT2Model(_gpt2_config_from_hf(hf_cfg, dtype))
+
+
+def _distilbert_factory(hf_cfg, dtype="bfloat16"):
+    from ..inference.v2.model_implementations.hf_builders import (
+        _distilbert_config_from_hf)
+    from ..models.bert import BertModel
+    return BertModel(_distilbert_config_from_hf(hf_cfg, dtype))
+
+
 # arch aliases the reference keeps one container file per entry for
 # (containers/llama.py, llama2, distil_llama, …): here one policy serves a
 # family because the flax model is config-parametrized.
@@ -133,6 +147,8 @@ POLICIES = {
     "falcon": InjectionPolicy("falcon", _falcon_factory),
     "opt": InjectionPolicy("opt", _opt_factory),
     "phi": InjectionPolicy("phi", _phi_factory),
+    "gpt2": InjectionPolicy("gpt2", _gpt2_factory),
+    "distilbert": InjectionPolicy("distilbert", _distilbert_factory),
 }
 
 

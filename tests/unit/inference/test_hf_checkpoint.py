@@ -583,3 +583,58 @@ def test_hf_gpt_neo_legacy_bin_buffers(tmp_path):
     with pytest.raises(ValueError, match="activation_function"):
         build_model_and_params(HuggingFaceCheckpointEngine(str(path)),
                                dtype="float32")
+
+
+def test_hf_gpt2_parity_and_v1_serving(tmp_path):
+    """GPT-2 (Conv1D [in,out] weights, fused c_attn, learned positions,
+    tied head): logits parity vs transformers and greedy decode through the
+    v1 engine (reference container containers/gpt2.py — v1 injection)."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        pad_token_id=0)
+    torch.manual_seed(13)
+    hf_model = transformers.GPT2LMHeadModel(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "gpt2")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 96, size=(2, 12),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+    eng = deepspeed_tpu.init_inference((model, params), dtype="float32")
+    prompt = jnp.asarray(ids[:1, :6], jnp.int32)
+    out = eng.generate(prompt, max_new_tokens=4)
+    hf_model.generation_config.eos_token_id = None
+    ref = hf_model.generate(
+        torch.tensor(ids[:1, :6]), max_new_tokens=4, do_sample=False,
+        pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out), ref.numpy())
+
+
+def test_hf_distilbert_mlm_parity(tmp_path):
+    """DistilBERT (no token-type embeddings, q_lin/k_lin naming, MLM head
+    via vocab_transform/projector): logits parity vs transformers
+    (reference container containers/distil_bert.py)."""
+    cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=64)
+    torch.manual_seed(17)
+    hf_model = transformers.DistilBertForMaskedLM(cfg)
+    hf_model.eval()
+    path = str(tmp_path / "distilbert")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    engine = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(engine, dtype="float32")
+    ids = np.random.default_rng(1).integers(0, 96, size=(2, 10),
+                                            dtype=np.int64)
+    ours = np.asarray(model.apply({"params": params}, ids.astype(np.int32)))
+    theirs = _hf_logits(hf_model, ids)
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
