@@ -1022,6 +1022,10 @@ def _gpt2_config_from_hf(cfg: dict, dtype: str):
         raise ValueError(
             f"gpt2 n_inner={n_inner} is not supported (the block hardcodes "
             f"the 4*hidden MLP width = {4 * n_embd})")
+    if not cfg.get("tie_word_embeddings", True):
+        raise ValueError(
+            "gpt2 tie_word_embeddings=False is not supported — GPT2Model "
+            "projects logits through the word-embedding table")
     return GPT2Config(
         vocab_size=cfg["vocab_size"],
         hidden_size=cfg.get("n_embd", cfg.get("hidden_size")),
@@ -1098,6 +1102,10 @@ def _distilbert_config_from_hf(cfg: dict, dtype: str):
     if cfg.get("activation", "gelu") != "gelu":
         raise ValueError(f"distilbert activation "
                          f"{cfg.get('activation')!r} unsupported")
+    if not cfg.get("tie_word_embeddings", True):
+        raise ValueError(
+            "distilbert tie_word_embeddings=False is not supported — the "
+            "MLM projector is served through the word-embedding table")
     return BertConfig(
         vocab_size=cfg["vocab_size"],
         hidden_size=cfg.get("dim", cfg.get("hidden_size")),

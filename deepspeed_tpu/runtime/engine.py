@@ -721,6 +721,25 @@ class DeepSpeedEngine:
                 jax.make_array_from_process_local_data(
                     self._batch_sharding(x), x)
                 for x in arrays)
+        for x in inputs:
+            shape = np.shape(x)  # no copy/D2H — device arrays stay put
+            if len(shape) >= 1 and shape[0] % max(1, self.dp_world_size):
+                # fail HERE with config vocabulary, not deep inside
+                # device_put with a raw sharding-divisibility error
+                raise ValueError(
+                    f"batch dim {shape[0]} is not divisible by the "
+                    f"data-parallel degree {self.dp_world_size} — feed "
+                    f"train_micro_batch_size_per_gpu × dp = "
+                    f"{self.train_micro_batch_size_per_gpu()} × "
+                    f"{self.dp_world_size} rows per micro-step (shape "
+                    f"{shape})")
+            if len(shape) >= 2 and self.seq_parallel_world_size > 1 and \
+                    shape[1] % self.seq_parallel_world_size:
+                raise ValueError(
+                    f"sequence dim {shape[1]} is not divisible by the "
+                    f"sequence-parallel degree "
+                    f"{self.seq_parallel_world_size} (mesh sp) — pad the "
+                    f"sequence (shape {shape})")
         return tuple(
             jax.device_put(jnp.asarray(x), self._batch_sharding(jnp.asarray(x)))
             for x in inputs)

@@ -24,8 +24,10 @@ program:
   P("pp") on the layer dim and the ZeRO axes shard the rest (same plan
   machinery as TP).
 
-The instruction schedule (``schedule.py``) is retained for parity tests and
-for the ``exec_schedule`` debugging path.
+The instruction schedule (``schedule.py``) is retained as a parity
+artifact: its 1F1B instruction streams are asserted against the reference's
+invariants in tests, documenting the schedule the fused program's AD
+reproduces implicitly.
 """
 
 from functools import partial

@@ -35,7 +35,7 @@ def _free_port():
     return port
 
 
-def _launch_workers(stage_spec, ckpt_dir="", timeout=420):
+def _launch_workers(stage_spec, ckpt_dir="", timeout=900):
     """One 2-process launch running every comma-separated stage leg —
     per-launch interpreter+jax boots dominated this block, so the suite
     boots the pair ONCE (see worker docstring).  Returns {leg: losses}."""

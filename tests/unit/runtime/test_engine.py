@@ -579,3 +579,14 @@ def test_pld_composes_with_comm_compression(combo):
         thetas.append(engine.progressive_layer_drop.get_theta())
     assert losses[-1] < losses[0], losses
     assert thetas[0] > thetas[-1] > 0.5  # curriculum annealed
+
+
+def test_bad_batch_dim_raises_with_config_vocabulary():
+    """A batch not divisible by dp used to surface as a raw jax device_put
+    sharding error; the engine now fails first with config terms."""
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params, config=_config())
+    x = np.zeros((engine.dp_world_size * 4 + 1, HIDDEN), np.float32)
+    with pytest.raises(ValueError, match="train_micro_batch_size_per_gpu"):
+        engine(x, x[:, :HIDDEN])
