@@ -148,6 +148,23 @@ class PipelineEngine(DeepSpeedEngine):
                           for s in self.post_specs]
 
     # ------------------------------------------------------------- model fns
+    def _dp_row_spec(self, ndim, lead=1):
+        """PartitionSpec sharding the batch-row dim over dp: rows live at
+        ``lead`` ([M, rows, ...] inside the fused program; [rows, ...] for
+        raw batches).  ONE definition — the jit-level device_put and the
+        shard_map in_specs must agree or GSPMD silently reshards."""
+        spec = [None] * ndim
+        spec[lead] = groups.dp_axes()
+        return P(*spec)
+
+    def _check_rows(self, rows, what):
+        dp = self.dp_world_size
+        if rows % max(1, dp):
+            raise ValueError(
+                f"{what} has {rows} rows — not divisible by the "
+                f"data-parallel degree {dp}; the fused pipeline shards "
+                f"batch rows over dp (pad or drop the ragged tail)")
+
     def _layer_params(self, params, region, i, tied_key):
         """Param subtree for pre/post layer i — tied layers read the shared
         ``params["tied"][key]`` copy."""
@@ -289,6 +306,7 @@ class PipelineEngine(DeepSpeedEngine):
         mesh = self.mesh
         engine_self = self
         loss_fn = self.loss_fn
+        dp_axes = groups.dp_axes()
 
         def pre_apply(params, x):
             return engine_self._apply_region(params, "pre", x)
@@ -372,16 +390,27 @@ class PipelineEngine(DeepSpeedEngine):
                 jax.checkpoint(tick_body), (state0, jnp.zeros((), jnp.float32),
                                             logit_acc0),
                 jnp.arange(M + pp - 1))
-            # loss/logits live on the last stage only → psum broadcasts
+            # loss/logits live on the last stage only → psum over pp
+            # broadcasts them; each dp group saw only ITS batch-row shard,
+            # so the scalar loss additionally pmeans over the dp axes.
+            # CONTRACT (same as the reference pipeline's dp loss allreduce,
+            # _aggregate_total_loss): loss_fn is a uniform per-row mean —
+            # sum-reductions or weighted means are equal-weight averaged
+            # per dp shard, not globally re-weighted.
             loss_out = jax.lax.psum(total_loss, "pp") / M
+            loss_out = jax.lax.pmean(loss_out, dp_axes)
             if with_logits:
                 return loss_out, jax.lax.psum(logit_acc, "pp")
             return loss_out
 
         def loss(params, batch_mb, labels_mb):
-            # shard_map in/out specs: blocks leaves carry P("pp") on dim 0 and
-            # are otherwise replicated inside the region; ZeRO/TP sharding of
-            # the non-layer dims is handled OUTSIDE by GSPMD via jit shardings.
+            # shard_map in/out specs: blocks leaves carry P("pp") on dim 0
+            # and are otherwise replicated inside the region; ZeRO/TP
+            # sharding of the non-layer dims is handled OUTSIDE by GSPMD
+            # via jit shardings.  Batch rows (dim 1 of [M, rows, ...]) are
+            # sharded over the dp axes: every dp group pipelines only ITS
+            # shard (previously P() replicated the batch into the manual
+            # region — correct loss, dp× redundant compute).
             param_specs = {
                 "pre": jax.tree_util.tree_map(lambda _: P(), params["pre"]),
                 "blocks": jax.tree_util.tree_map(lambda _: P("pp"),
@@ -391,10 +420,17 @@ class PipelineEngine(DeepSpeedEngine):
             if "tied" in params:  # shared copies: replicated like pre/post
                 param_specs["tied"] = jax.tree_util.tree_map(
                     lambda _: P(), params["tied"])
-            out_specs = (P(), P()) if with_logits else P()
+            bspec = engine_self._dp_row_spec(batch_mb.ndim)
+            lspec = engine_self._dp_row_spec(labels_mb.ndim)
+            if with_logits:
+                # logits [M, rows_local, ...]: rows sharded over dp,
+                # trailing dims unsharded (unspecified)
+                out_specs = (P(), P(None, dp_axes))
+            else:
+                out_specs = P()
             return jax.shard_map(
                 pipe, mesh=mesh,
-                in_specs=(param_specs, P("pp"), P(), P()),
+                in_specs=(param_specs, P("pp"), bspec, lspec),
                 out_specs=out_specs, check_vma=False)(
                     params, self._block_valid, batch_mb, labels_mb)
 
@@ -516,17 +552,14 @@ class PipelineEngine(DeepSpeedEngine):
             ys.append(np.asarray(y))
         batch_mb = jnp.asarray(np.stack(xs))   # [M, mb*dp, ...]
         labels_mb = jnp.asarray(np.stack(ys))
+        self._check_rows(batch_mb.shape[1], "train_batch microbatch")
 
-        # shard microbatch data over dp on dim 1
-        nd = batch_mb.ndim
-        spec = [None] * nd
-        spec[1] = groups.dp_axes()
-        batch_mb = jax.device_put(batch_mb, NamedSharding(self.mesh, P(*spec)))
-        nd = labels_mb.ndim
-        lspec = [None] * nd
-        lspec[1] = groups.dp_axes()
-        labels_mb = jax.device_put(labels_mb,
-                                   NamedSharding(self.mesh, P(*lspec)))
+        # shard microbatch data over dp on dim 1 (same helper as the fused
+        # program's in_specs — the layouts must agree)
+        batch_mb = jax.device_put(batch_mb, NamedSharding(
+            self.mesh, self._dp_row_spec(batch_mb.ndim)))
+        labels_mb = jax.device_put(labels_mb, NamedSharding(
+            self.mesh, self._dp_row_spec(labels_mb.ndim)))
 
         self.tput_timer.start()
         self._ensure_state_resident()  # NVMe offload: swap state back in
@@ -555,6 +588,8 @@ class PipelineEngine(DeepSpeedEngine):
         self._check_params()
         batch = next(data_iter)
         x, y = np.asarray(batch[0]), np.asarray(batch[1])
+        if self.pp_world_size > 1:
+            self._check_rows(x.shape[0], "eval_batch batch")
         batch_mb = jnp.asarray(x)[None]
         labels_mb = jnp.asarray(y)[None]
         key = (tuple(batch_mb.shape), str(batch_mb.dtype), bool(return_logits))
