@@ -148,13 +148,12 @@ class PipelineEngine(DeepSpeedEngine):
                           for s in self.post_specs]
 
     # ------------------------------------------------------------- model fns
-    def _dp_row_spec(self, ndim, lead=1):
-        """PartitionSpec sharding the batch-row dim over dp: rows live at
-        ``lead`` ([M, rows, ...] inside the fused program; [rows, ...] for
-        raw batches).  ONE definition — the jit-level device_put and the
+    def _dp_row_spec(self, ndim):
+        """PartitionSpec sharding dim 1 (the batch rows of [M, rows, ...])
+        over dp.  ONE definition — the jit-level device_put and the
         shard_map in_specs must agree or GSPMD silently reshards."""
         spec = [None] * ndim
-        spec[lead] = groups.dp_axes()
+        spec[1] = groups.dp_axes()
         return P(*spec)
 
     def _check_rows(self, rows, what):
@@ -404,13 +403,14 @@ class PipelineEngine(DeepSpeedEngine):
             return loss_out
 
         def loss(params, batch_mb, labels_mb):
-            # shard_map in/out specs: blocks leaves carry P("pp") on dim 0
-            # and are otherwise replicated inside the region; ZeRO/TP
-            # sharding of the non-layer dims is handled OUTSIDE by GSPMD
-            # via jit shardings.  Batch rows (dim 1 of [M, rows, ...]) are
-            # sharded over the dp axes: every dp group pipelines only ITS
-            # shard (previously P() replicated the batch into the manual
-            # region — correct loss, dp× redundant compute).
+            # PARTIAL-manual region: manual over pp (ppermute, stage
+            # branching) and the dp axes (batch-row sharding + loss pmean);
+            # tp/sp stay AUTO so GSPMD keeps the ZeRO/TP sharding of the
+            # non-layer param dims live INSIDE the region (a full-manual
+            # region all-gathered tp-sharded weights at the boundary —
+            # same dead-compute class as the batch replication fixed
+            # alongside).  Batch rows (dim 1 of [M, rows, ...]) are
+            # sharded over dp: every dp group pipelines only ITS shard.
             param_specs = {
                 "pre": jax.tree_util.tree_map(lambda _: P(), params["pre"]),
                 "blocks": jax.tree_util.tree_map(lambda _: P("pp"),
@@ -428,10 +428,14 @@ class PipelineEngine(DeepSpeedEngine):
                 out_specs = (P(), P(None, dp_axes))
             else:
                 out_specs = P()
+            manual = frozenset({"pp"} | set(
+                a for a in (dp_axes if isinstance(dp_axes, tuple)
+                            else (dp_axes, ))))
             return jax.shard_map(
                 pipe, mesh=mesh,
                 in_specs=(param_specs, P("pp"), bspec, lspec),
-                out_specs=out_specs, check_vma=False)(
+                out_specs=out_specs, check_vma=False,
+                axis_names=manual)(
                     params, self._block_valid, batch_mb, labels_mb)
 
         return loss

@@ -111,14 +111,20 @@ class DistributedAttention:
             cache = {}
             self._jit_cache = cache
         if key_ not in cache:
-            spec = P(None, a, None, None)  # [B, S(sp), H, D]
+            # PARTIAL-manual: only "sp" is a manual axis (the a2a lives on
+            # it); batch/head dims keep whatever dp/tp sharding GSPMD gave
+            # the operands.  A full-manual region with P(None, a) specs
+            # would replicate the batch into every dp group and the heads
+            # into every tp rank — correct numerics, dp·tp× dead compute.
+            spec = P(None, a)  # [B, S(sp), ...]; trailing dims auto
 
             def f(q, k, v):
                 return self.attend_local(q, k, v, **kwargs)
 
             cache[key_] = jax.jit(
                 jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
-                              out_specs=spec, check_vma=False))
+                              out_specs=spec, check_vma=False,
+                              axis_names=frozenset({a})))
         return cache[key_](query, key, value)
 
 

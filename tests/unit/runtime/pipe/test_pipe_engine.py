@@ -471,3 +471,33 @@ def test_pipe_ragged_rows_raise_clearly():
     with pytest.raises(ValueError, match="data-parallel degree"):
         engine.eval_batch(iter([(bad, bad)]))
     _teardown()
+
+
+def test_pipe_region_manual_over_pp_dp_only():
+    """The fused region is PARTIAL-manual: manual over pp + the dp axes,
+    tp/sp auto — GSPMD keeps ZeRO/TP shardings of the non-layer param dims
+    live inside (a full-manual region would all-gather tp-sharded weights
+    at the boundary)."""
+    engine = _make_engine(pp=2, gas=2)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, D)).astype(np.float32)
+    engine.initialize_parameters(0, x, x)
+    loss = engine._pipe_loss_fn(2)
+    batch = jnp.zeros((2, 8, D), jnp.float32)
+    jaxpr = jax.make_jaxpr(loss)(engine.params, batch, batch)
+
+    found = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            if "shard_map" in str(eqn.primitive):
+                found.append(eqn.params.get("manual_axes"))
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None:
+                    walk(getattr(sub, "jaxpr", sub))
+
+    walk(jaxpr.jaxpr)
+    assert found and all(ax == frozenset({"pp", "dp", "ep"})
+                         for ax in found), found
+    _teardown()

@@ -92,3 +92,32 @@ def test_ulysses_grads_flow():
     g_ref = jax.grad(loss_ref)(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-3,
                                rtol=1e-3)
+
+
+def test_ulysses_region_manual_over_sp_only():
+    """The a2a shard_map must be PARTIAL-manual (manual_axes == {sp}): a
+    full-manual region with P(None, 'sp') specs replicated the batch into
+    every dp group and the heads into every tp rank — correct numerics,
+    dp·tp× dead compute (round-3 fix, same class as the pipeline batch
+    replication)."""
+    groups.reset_mesh()
+    groups.initialize_mesh(dp=2, sp=2, tp=2)
+    att = DistributedAttention()
+    q = jnp.zeros((4, 8, 4, 16), jnp.float32)
+    jx = jax.make_jaxpr(lambda t: att(t, t, t, causal=True))(q)
+
+    found = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            if "shard_map" in str(eqn.primitive):
+                found.append(eqn.params.get("manual_axes"))
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None:
+                    walk(getattr(sub, "jaxpr", sub))
+
+    walk(jx.jaxpr)
+    assert found, "no shard_map in the Ulysses program"
+    assert all(ax == frozenset({"sp"}) for ax in found), found
+    groups.reset_mesh()
