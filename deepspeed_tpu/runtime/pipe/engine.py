@@ -428,9 +428,7 @@ class PipelineEngine(DeepSpeedEngine):
                 out_specs = (P(), P(None, dp_axes))
             else:
                 out_specs = P()
-            manual = frozenset({"pp"} | set(
-                a for a in (dp_axes if isinstance(dp_axes, tuple)
-                            else (dp_axes, ))))
+            manual = frozenset({"pp", *dp_axes})
             return jax.shard_map(
                 pipe, mesh=mesh,
                 in_specs=(param_specs, P("pp"), bspec, lspec),

@@ -105,19 +105,8 @@ def test_ulysses_region_manual_over_sp_only():
     att = DistributedAttention()
     q = jnp.zeros((4, 8, 4, 16), jnp.float32)
     jx = jax.make_jaxpr(lambda t: att(t, t, t, causal=True))(q)
-
-    found = []
-
-    def walk(j):
-        for eqn in j.eqns:
-            if "shard_map" in str(eqn.primitive):
-                found.append(eqn.params.get("manual_axes"))
-            for v in eqn.params.values():
-                sub = getattr(v, "jaxpr", None)
-                if sub is not None:
-                    walk(getattr(sub, "jaxpr", sub))
-
-    walk(jx.jaxpr)
+    from tests.unit.simple_model import collect_manual_axes
+    found = collect_manual_axes(jx)
     assert found, "no shard_map in the Ulysses program"
     assert all(ax == frozenset({"sp"}) for ax in found), found
     groups.reset_mesh()

@@ -48,3 +48,21 @@ def batches(dataset, batch_size):
         ys = np.stack([dataset[j][1] for j in range(i, i + batch_size)])
         out.append((xs, ys))
     return out
+
+
+def collect_manual_axes(jaxpr):
+    """All shard_map eqns' manual_axes in a jaxpr (recursive) — shared by
+    the partial-manual structural tests."""
+    found = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            if "shard_map" in str(eqn.primitive):
+                found.append(eqn.params.get("manual_axes"))
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None:
+                    walk(getattr(sub, "jaxpr", sub))
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return found
