@@ -62,19 +62,30 @@ class PipelineEngine(DeepSpeedEngine):
         # stacked blocks: leading layer dim sharded over pp
         rules.setdefault("blocks/*", P("pp"))
 
+        # Parse the config ONCE so the pre-super guards below handle every
+        # form the base engine accepts (dict, JSON path, None,
+        # DeepSpeedConfig) identically.
+        from ..config import DeepSpeedConfig
+        if not isinstance(config, DeepSpeedConfig):
+            config = DeepSpeedConfig(config)
         # PLD guard must fire BEFORE the base engine's pld signature check
         # sees our internal apply fn and gives misleading advice
-        if isinstance(config, dict):
-            pld_enabled = ((config.get("progressive_layer_drop") or {})
-                           .get("enabled"))
-        else:  # DeepSpeedConfig object (initialize() pre-parses)
-            pld_cfg = getattr(config, "pld_config", None)
-            pld_enabled = pld_cfg is not None and pld_cfg.enabled
-        if pld_enabled:
+        pld_cfg = getattr(config, "pld_config", None)
+        if pld_cfg is not None and pld_cfg.enabled:
             raise NotImplementedError(
                 "progressive_layer_drop is not supported by the pipeline "
                 "engine (its fused program builds its own apply path); "
                 "disable it or use the base engine")
+        # ZeRO++ quantized comm would be SILENTLY ignored here: the fused
+        # pipeline builds its own step (qgZ's manual-dp micro and qwZ's
+        # apply-fn wrapper never run).  Reject loudly instead.
+        if config.zero_config.zero_quantized_gradients or \
+                config.zero_config.zero_quantized_weights:
+            raise NotImplementedError(
+                "ZeRO++ quantized communication (zero_quantized_gradients/"
+                "zero_quantized_weights) is not wired into the fused "
+                "pipeline step — disable it or use the base engine "
+                "(dp/ep/tp meshes)")
         super().__init__(args=args, model=self._build_apply(), optimizer=optimizer,
                          model_parameters=model_parameters,
                          training_data=training_data, lr_scheduler=lr_scheduler,

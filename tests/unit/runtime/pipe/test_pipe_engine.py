@@ -551,33 +551,24 @@ def test_pipe_composes_with_zero23(stage):
     bucketed comm).  Here ZeRO stages are sharding policies on the same
     mesh, so the composition is just another layout: trajectory matches
     pp=1 at the same stage."""
-    ref = _run_stage(pp=1, stage=stage)
-    got = _run_stage(pp=2, stage=stage)
+    ref = _run(pp=1, gas=2, rows=16, stage=stage)
+    got = _run(pp=2, gas=2, rows=16, stage=stage)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-4)
 
 
-def _run_stage(pp, stage, steps=4):
+@pytest.mark.parametrize("key", ["zero_quantized_gradients",
+                                 "zero_quantized_weights"])
+def test_pipe_rejects_zeropp_quantized_comm(key):
+    """ZeRO++ quantized comm configs must fail loudly under the pipeline
+    engine — the fused step never runs the qgZ/qwZ paths, and a silently
+    ignored optimization is worse than a rejection."""
     model = _make_module(4)
-    dp = 8 // pp
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        config={"train_micro_batch_size_per_gpu": 8 // dp,
-                "gradient_accumulation_steps": 2,
-                "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
-                "zero_optimization": {"stage": stage},
-                "mesh": {"pp": pp, "dp": -1}})
-    rng = np.random.default_rng(0)
-    W = rng.standard_normal((D, D)).astype(np.float32) * 0.3
-    x0 = rng.standard_normal((8, D)).astype(np.float32)
-    engine.initialize_parameters(0, x0, x0 @ W)
-
-    def gen():
-        r = np.random.default_rng(42)
-        while True:
-            x = r.standard_normal((8, D)).astype(np.float32)
-            yield (x, x @ W)
-
-    it = gen()
-    losses = [float(engine.train_batch(it)) for _ in range(steps)]
+    with pytest.raises(NotImplementedError, match="quantized"):
+        deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3, key: True},
+                    "mesh": {"pp": 2, "dp": -1}})
     _teardown()
-    return losses
