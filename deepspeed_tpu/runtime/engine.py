@@ -144,6 +144,11 @@ class DeepSpeedEngine:
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_train_batch = {}
+        self._compiled_eval = {}
+        # fp16 overflow-skip count: accumulated on device, synced lazily
+        # (reading ``skipped_steps`` or the steps_per_print report drains it)
+        self._skipped_base = 0
+        self._overflow_acc = None
         # compression / user hooks
         self._param_transforms = []   # differentiable params→params, in fwd
         self._post_step_hooks = []    # called after each optimizer step
@@ -580,13 +585,7 @@ class DeepSpeedEngine:
 
     def _opt_state_shardings(self, target):
         """Optimizer moments shard like the master weights; scalars replicated."""
-        master_shardings = self.plan.master_shardings(target)
         state_shape = jax.eval_shape(self._grad_transform.init, target)
-
-        def match(leaf_shape):
-            # moments have param shapes → shard like the param; find by shape
-            return None
-
         # Build by structure: state trees contain `mu`/`nu` shaped like the
         # target params; suffix path-matching applies the same TP rules.
         from .zero.partition import path_str
@@ -673,6 +672,22 @@ class DeepSpeedEngine:
     def cur_scale(self):
         return float(self.scale_state.scale) if self.scale_state is not None else 1.0
 
+    @property
+    def skipped_steps(self):
+        """fp16 overflow-skipped step count.  The per-boundary overflow flag
+        stays on device (no host sync in ``step()``); reading this property
+        drains the device accumulator."""
+        acc = getattr(self, "_overflow_acc", None)
+        if acc is not None:
+            self._overflow_acc = None
+            self._skipped_base += int(jax.device_get(acc))
+        return self._skipped_base
+
+    @skipped_steps.setter
+    def skipped_steps(self, value):
+        self._skipped_base = int(value)
+        self._overflow_acc = None
+
     def is_gradient_accumulation_boundary(self):
         """Reference engine.py:2088."""
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
@@ -758,6 +773,7 @@ class DeepSpeedEngine:
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_train_batch = {}
+        self._compiled_eval = {}
 
     def _effective_apply_fn(self, with_pld=True):
         """apply_fn with registered param transforms composed in — the single
@@ -902,10 +918,7 @@ class DeepSpeedEngine:
         self._check_params()
         inputs = self.shard_batch(*inputs)
         if not self.training:
-            # transforms (QAT fake-quant, …) apply in eval too — otherwise
-            # validation measures a different model than is being optimized
-            out = self._effective_apply_fn()(self.params, *inputs, **kwargs)
-            return out
+            return self._eval_forward(inputs, kwargs)
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.progressive_layer_drop is not None:
             inputs = (*inputs,
@@ -918,6 +931,28 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._maybe_profile_flops(inputs)
         return loss
+
+    def _eval_forward(self, inputs, kwargs):
+        """Compiled eval/validation forward, shape-keyed like the train
+        micro-step (reference ``engine.py:3696`` compile wrapper role) —
+        transforms (QAT fake-quant, …) apply in eval too, otherwise
+        validation measures a different model than is being optimized.
+        kwargs are baked into the compiled closure only when they are mode
+        flags (bool/str/None — the flax ``train=False``/``deterministic=True``
+        style); anything else (arrays, rngs dicts, per-call-varying scalars)
+        falls back to op-by-op dispatch so the cache cannot grow one
+        executable per distinct kwarg value."""
+        if not all(isinstance(v, (bool, str, type(None)))
+                   for v in kwargs.values()):
+            return self._effective_apply_fn()(self.params, *inputs, **kwargs)
+        kw_key = tuple(sorted(kwargs.items()))
+        key = (tuple((tuple(x.shape), str(x.dtype)) for x in inputs), kw_key)
+        fn = self._compiled_eval.get(key)
+        if fn is None:
+            apply_fn = self._effective_apply_fn()
+            fn = jax.jit(lambda params, *i: apply_fn(params, *i, **kwargs))
+            self._compiled_eval[key] = fn
+        return fn(self.params, *inputs)
 
     def _maybe_profile_flops(self, inputs):
         """Flops profiler hook (reference engine wires FlopsProfiler at
@@ -1016,10 +1051,12 @@ class DeepSpeedEngine:
             self.global_samples += self.train_batch_size()
             if self.progressive_layer_drop is not None:
                 self.progressive_layer_drop.update_state(self.global_steps)
-            if bool(overflow):
-                self.skipped_steps += 1
-                log_dist(f"overflow at step {self.global_steps}, "
-                         f"scale → {self.cur_scale}", ranks=[0])
+            if self._config.fp16_enabled:
+                # NO host sync here: the overflow flag accumulates on device
+                # and drains at steps_per_print (or on a skipped_steps read)
+                ov = overflow.astype(jnp.int32)
+                self._overflow_acc = (ov if self._overflow_acc is None
+                                      else self._overflow_acc + ov)
             if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
                 self.lr_scheduler.step()
                 self._scheduler_reclaims_lr()
@@ -1038,6 +1075,14 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     def _report_step_metrics(self, gnorm):
+        if self._config.fp16_enabled and self.global_steps % \
+                self._config.steps_per_print == 0:
+            before = self._skipped_base
+            if self.skipped_steps != before:   # drains the device accumulator
+                log_dist(f"{self._skipped_base - before} overflow-skipped "
+                         f"step(s) since last report (step "
+                         f"{self.global_steps}), scale → {self.cur_scale}",
+                         ranks=[0])
         if self.monitor.enabled and self.global_steps % \
                 self._config.steps_per_print == 0:
             events = [("Train/Samples/lr", self.get_lr()[0] or 0.0,
@@ -1062,7 +1107,7 @@ class DeepSpeedEngine:
         """Convenience full-batch step (forward+backward+step × GAS)."""
         if data_iter is None:
             data_iter = iter(self.training_dataloader)
-        total = 0.0
+        losses = []
         self.tput_timer.start()
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(data_iter)
@@ -1071,9 +1116,14 @@ class DeepSpeedEngine:
             loss = self.forward(*batch)
             self.backward(loss)
             self.step()
-            total += float(loss)
+            losses.append(loss)
         self.tput_timer.stop(global_step=True)
-        return total / self.gradient_accumulation_steps()
+        # mean over the gas window as a DEVICE scalar (reference train_batch
+        # returns the aggregated loss tensor, engine.py:2029) — converting to
+        # float here would block async dispatch on every micro-batch window
+        if len(losses) == 1:
+            return losses[0].astype(jnp.float32)
+        return jnp.mean(jnp.stack([l.astype(jnp.float32) for l in losses]))
 
     def _check_params(self):
         offloaded = getattr(self, "_host_offloaded", None)
