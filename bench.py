@@ -72,6 +72,13 @@ def _tpu_peak_flops() -> float:
     return 197e12  # default: v5e
 
 
+def _logt(msg: str):
+    """Phase timestamps on stderr — when the device child dies on the
+    parent's timeout, the stderr tail says which phase ate the budget."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import deepspeed_tpu
@@ -84,9 +91,10 @@ def run_bench(on_tpu: bool) -> dict:
     # remat-off at B=4 gives ~0.39 MFU vs ~0.33 for B=8+full-remat (recompute
     # is not credited); larger B OOMs without remat, so fall back on
     # ResourceExhausted.
+    n_layers = int(os.environ.get("BENCH_LAYERS", "8"))
     if on_tpu:
         attempts = [(4, False, "none"), (8, True, "nothing_saveable")]
-        S, steps, warmup = 2048, 10, 2
+        S, steps, warmup = 2048, int(os.environ.get("BENCH_STEPS", "10")), 2
         peak_flops = _tpu_peak_flops()
     else:  # CPU smoke mode (sanity only)
         attempts = [(4, False, "none")]
@@ -98,7 +106,7 @@ def run_bench(on_tpu: bool) -> dict:
             if on_tpu:
                 cfg = llama.LlamaConfig(
                     vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-                    num_hidden_layers=8, num_attention_heads=16,
+                    num_hidden_layers=n_layers, num_attention_heads=16,
                     num_key_value_heads=16, max_position_embeddings=2048,
                     dtype="bfloat16", remat=remat, remat_policy=policy)
             else:
@@ -116,7 +124,11 @@ def run_bench(on_tpu: bool) -> dict:
 
             rng = np.random.default_rng(0)
             ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+            _logt(f"engine built (B={B} layers={cfg.num_hidden_layers} "
+                  f"remat={remat}); initializing params…")
             engine.initialize_parameters(0, ids, ids)
+            jax.block_until_ready(engine.params)
+            _logt("params initialized; warmup (train-step compile)…")
 
             def one_step():
                 loss = engine(ids, ids)
@@ -124,9 +136,17 @@ def run_bench(on_tpu: bool) -> dict:
                 engine.step()
                 return loss
 
-            for _ in range(warmup):
+            tw = time.perf_counter()
+            one_step()
+            jax.block_until_ready(engine.params)
+            _logt(f"warmup step 1 (compile) done in "
+                  f"{time.perf_counter()-tw:.1f}s")
+            tw = time.perf_counter()
+            for _ in range(warmup - 1):
                 one_step()
             jax.block_until_ready(engine.params)
+            warm_step = ((time.perf_counter() - tw) / max(1, warmup - 1))
+            _logt(f"warmup done; steady step ≈ {warm_step*1000:.0f}ms")
             break
         except Exception as e:  # OOM → next (smaller-footprint) config
             if "RESOURCE_EXHAUSTED" not in str(e) or \
@@ -142,25 +162,46 @@ def run_bench(on_tpu: bool) -> dict:
             dist.destroy_process_group()
             continue
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        one_step()
-    jax.block_until_ready(engine.params)
-    dt = time.perf_counter() - t0
-
-    step_time = dt / steps
-    tokens_per_sec = B * S / step_time
     n_params = llama.param_count(cfg)
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
-    mfu = tokens_per_sec * flops_per_token / peak_flops
 
-    return {
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s (B={B} S={S} params={n_params/1e6:.0f}M "
-                f"step={step_time*1000:.0f}ms MFU={mfu:.3f} backend={backend})",
-        "vs_baseline": round(mfu / 0.40, 3),
-    }
+    def record(step_time, note=""):
+        tokens_per_sec = B * S / step_time
+        mfu = tokens_per_sec * flops_per_token / peak_flops
+        return {
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": round(tokens_per_sec, 1),
+            "unit": f"tokens/s (B={B} S={S} params={n_params/1e6:.0f}M "
+                    f"step={step_time*1000:.0f}ms MFU={mfu:.3f} "
+                    f"backend={backend}{note})",
+            "vs_baseline": round(mfu / 0.40, 3),
+        }
+
+    if on_tpu and warm_step > 0:
+        # provisional record NOW: if the parent's timeout kills the timed
+        # loop below, the last stdout JSON line is still a real-chip number
+        print(json.dumps(record(warm_step, " [warmup-estimate]")), flush=True)
+
+    t0 = time.perf_counter()
+    done = 0
+    rec = None
+    schedule = ([1, 2, 3] if on_tpu else [steps])
+    while sum(schedule) < steps:
+        schedule.append(min(4, steps - sum(schedule)))
+    for chunk in schedule:
+        chunk = min(chunk, steps - done)
+        if chunk <= 0:
+            break
+        for _ in range(chunk):
+            one_step()
+        jax.block_until_ready(engine.params)
+        done += chunk
+        rec = record((time.perf_counter() - t0) / done,
+                     "" if done >= steps else f" [partial {done}/{steps}]")
+        if on_tpu and done < steps:
+            print(json.dumps(rec), flush=True)
+            _logt(f"measured {done}/{steps} steps")
+    return rec
 
 
 def _count_params(tree) -> int:
@@ -519,9 +560,16 @@ def run_serve_bench(on_tpu: bool) -> dict:
 def _child_device():
     """Benchmark on the default platform (TPU when the tunnel is up)."""
     import jax
-    # NOTE: no persistent compile cache here — serializing executables
-    # through the remote-TPU (axon) proxy stalls for minutes per program
+    # Persistent compile cache ON by default (BENCH_DEVICE_CACHE=0 opts out).
+    # Round-3 disabled it on a one-off observation that serializing
+    # executables through the axon proxy stalls; re-measured round 4 — a
+    # cache HIT skips the multi-minute tunnel compile entirely, and the
+    # phase logs below attribute any miss-path stall precisely.
+    if os.environ.get("BENCH_DEVICE_CACHE", "1") != "0":
+        _enable_compile_cache()
+    _logt("acquiring default backend (axon tunnel)…")
     backend = jax.default_backend()  # may block; parent's timeout bounds us
+    _logt(f"backend = {backend}, devices = {jax.devices()}")
     on_tpu = backend not in ("cpu",)
     print(json.dumps(run_bench(on_tpu)), flush=True)
 

@@ -145,10 +145,6 @@ class DeepSpeedEngine:
         self._compiled_apply = None
         self._compiled_train_batch = {}
         self._compiled_eval = {}
-        # fp16 overflow-skip count: accumulated on device, synced lazily
-        # (reading ``skipped_steps`` or the steps_per_print report drains it)
-        self._skipped_base = 0
-        self._overflow_acc = None
         # compression / user hooks
         self._param_transforms = []   # differentiable params→params, in fwd
         self._post_step_hooks = []    # called after each optimizer step

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-4 bench orchestrator: retry the on-chip bench until the axon tunnel
+# cooperates, then record the BASELINE-ladder legs (README perf table).
+#
+# Side effect that matters for the driver: every successful device run
+# populates .bench_jax_cache (persistent XLA compile cache), so the driver's
+# end-of-round `python bench.py` device leg compiles from cache instead of
+# paying the multi-minute tunnel RPC — VERDICT r3 "next round" item 1.
+#
+# Usage: nohup bash tools/bench_retry.sh > /tmp/bench_retry4.log 2>&1 &
+set -u
+cd /root/repo
+OUT=.bench_runs
+mkdir -p "$OUT"
+ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-2400}
+SLEEP_BETWEEN=${SLEEP_BETWEEN:-240}
+
+record_if_full() {  # $1 = json line; writes .bench_last_device.json on a full run
+  python - "$1" <<'EOF'
+import json, sys, time
+rec = json.loads(sys.argv[1])
+u = rec.get("unit", "")
+if "partial" not in u and "warmup-estimate" not in u and "backend=cpu" not in u:
+    json.dump({"when": time.strftime("%Y-%m-%d"), **rec},
+              open(".bench_last_device.json", "w"))
+    print("RECORDED full device run:", rec["value"], rec["vs_baseline"])
+EOF
+}
+
+main_done=""
+for i in $(seq 1 60); do
+  echo "=== device attempt $i $(date) ==="
+  timeout "$ATTEMPT_TIMEOUT" python bench.py --mode device \
+    > "$OUT/device_$i.out" 2> "$OUT/device_$i.err"
+  echo "--- stderr tail:"; tail -4 "$OUT/device_$i.err"
+  last=$(grep -E '^\{.*"metric"' "$OUT/device_$i.out" | tail -1)
+  if [ -n "$last" ]; then
+    echo "$last"
+    record_if_full "$last"
+    if [ -f .bench_last_device.json ] && \
+       grep -q "$(date +%Y-%m-%d)" .bench_last_device.json; then
+      main_done=1
+      break
+    fi
+  fi
+  sleep "$SLEEP_BETWEEN"
+done
+
+if [ -n "$main_done" ]; then
+  # cache is warm + tunnel is alive: grab the ladder legs back-to-back
+  for mode in gpt2 offload fpdt serve; do
+    echo "=== ladder $mode $(date) ==="
+    timeout "$ATTEMPT_TIMEOUT" python bench.py --mode "$mode" \
+      > "$OUT/${mode}.out" 2> "$OUT/${mode}.err"
+    tail -2 "$OUT/${mode}.err"
+    grep -E '^\{.*"metric"' "$OUT/${mode}.out" | tail -1 | tee "$OUT/${mode}.json"
+  done
+  # one more default-path device run to verify the cache-hit fast path the
+  # driver will see (should complete in a couple of minutes)
+  echo "=== cache-hit verification $(date) ==="
+  time timeout 900 python bench.py --mode device \
+    > "$OUT/device_cachehit.out" 2> "$OUT/device_cachehit.err"
+  tail -3 "$OUT/device_cachehit.err"
+  grep -E '^\{.*"metric"' "$OUT/device_cachehit.out" | tail -1
+fi
+echo "=== bench_retry done $(date) ==="
