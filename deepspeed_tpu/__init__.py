@@ -55,7 +55,16 @@ def initialize(args=None,
 
     ds_config = DeepSpeedConfig(config, mesh_param=mesh_param)
 
+    _offload_param_dev = (str(ds_config.zero_config.offload_param.device)
+                          if ds_config.zero_config.offload_param is not None
+                          else "none")
     if isinstance(model, PipelineModule):
+        if _offload_param_dev in ("cpu", "nvme"):
+            raise ValueError(
+                "offload_param (ZeRO-Infinity param streaming) does not "
+                "compose with PipelineModule — the fused pipeline program "
+                "needs its stage weights resident; use offload_optimizer "
+                "for state offload under pipeline parallelism")
         from .runtime.pipe.engine import PipelineEngine  # noqa
         engine = PipelineEngine(args=args,
                                 model=model,
@@ -66,6 +75,21 @@ def initialize(args=None,
                                 collate_fn=collate_fn,
                                 config=ds_config,
                                 mpu=mpu)
+    elif _offload_param_dev in ("cpu", "nvme"):
+        # ZeRO-Infinity param streaming (reference engine choice: stage-3
+        # offload_param routes through DeepSpeedZeroOptimizer_Stage3 +
+        # AsyncPartitionedParameterSwapper)
+        from .runtime.infinity_engine import InfinityEngine
+        engine = InfinityEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                collate_fn=collate_fn,
+                                config=ds_config,
+                                mpu=mpu,
+                                tp_rules=tp_rules)
     elif ds_config.hybrid_engine.enabled:
         # RLHF flip-flop engine (reference engine choice deepspeed/__init__.py:214)
         from .runtime.hybrid_engine import DeepSpeedHybridEngine

@@ -1,0 +1,242 @@
+"""ZeRO-Infinity parameter streaming (VERDICT r3 missing #1/#2): host/NVMe
+param residency, O(block) HBM footprint, host-native optimizer sweep.
+
+Reference parity targets: ``swap_tensor/partitioned_param_swapper.py:37``
+(param NVMe residency), ``zero/partitioned_param_coordinator.py:535``
+(prefetch), ``csrc/adam/cpu_adam_impl.cpp`` (host optimizer math — exercised
+here through the loss-parity assertions vs the on-device fused step).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models import llama
+
+
+def _tiny_cfg(layers=4):
+    return llama.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        dtype="float32", remat=False, tie_word_embeddings=False)
+
+
+def _data(cfg, bs, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, cfg.vocab_size, size=(bs, 16)).astype(np.int32), )
+            * 2 for _ in range(n)]
+
+
+def _host_params(cfg, bs, seed=0):
+    model = llama.LlamaModel(cfg)
+    ids = np.zeros((bs, 16), np.int32)
+    return model.init(jax.random.PRNGKey(seed), ids, ids)["params"]
+
+
+def _config(offload_device, gas=1, clip=0.0, nvme_path=None, opt="adam"):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": opt, "params": {"lr": 0.01}},
+        "gradient_clipping": clip,
+        "zero_optimization": {"stage": 3},
+    }
+    if offload_device is not None:
+        cfg["zero_optimization"]["offload_param"] = {
+            "device": offload_device,
+            **({"nvme_path": str(nvme_path)} if nvme_path else {})}
+    return cfg
+
+
+def _train(engine, data, steps):
+    losses = []
+    it = iter(data * 50)
+    for _ in range(steps):
+        for _ in range(engine.gradient_accumulation_steps()):
+            x, y = next(it)
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("gas,clip", [(1, 0.0), (2, 1.0)])
+def test_streaming_loss_parity_vs_monolithic(gas, clip):
+    """The streamed executor + host C++ Adam must reproduce the monolithic
+    on-device engine's trajectory (same params, same data)."""
+    cfg = _tiny_cfg()
+    params = _host_params(cfg, 2)
+    eng_ref, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config(None, gas=gas, clip=clip))
+    bs = 2 * eng_ref.dp_world_size
+    params = _host_params(cfg, bs)
+    eng_ref, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config(None, gas=gas, clip=clip))
+    eng_inf, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu", gas=gas, clip=clip))
+    from deepspeed_tpu.runtime.infinity_engine import InfinityEngine
+    assert isinstance(eng_inf, InfinityEngine)
+    data = _data(cfg, bs)
+    ref = _train(eng_ref, data, steps=6)
+    got = _train(eng_inf, data, steps=6)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+    assert got[-1] < got[0]
+
+
+def test_hbm_param_residency_bounded():
+    """The Infinity contract: device memory holds O(working set) of block
+    params — never the whole model — and nothing between steps."""
+    cfg = _tiny_cfg(layers=6)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    eng.initialize_parameters(0, np.zeros((bs, 16), np.int32),
+                              np.zeros((bs, 16), np.int32))
+    data = _data(cfg, bs)
+    _train(eng, data, steps=3)
+    assert 0 < eng.max_resident_blocks <= 3, eng.max_resident_blocks
+    assert eng.hbm_param_bytes() == 0      # all blocks released at boundary
+    assert eng.params is None and eng.master is None and eng.opt_state is None
+
+
+def test_nvme_param_streaming_matches_cpu(tmp_path):
+    """device:nvme keeps params + optimizer state in per-block files; the
+    trajectory must match host-RAM mode exactly (same bytes through aio)."""
+    cfg = _tiny_cfg()
+    params = _host_params(cfg, 2)
+    eng_cpu, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    bs = 2 * eng_cpu.dp_world_size
+    params = _host_params(cfg, bs)
+    eng_cpu, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    nv_cfg = _config("nvme", nvme_path=tmp_path)
+    nv_cfg["zero_optimization"]["offload_optimizer"] = {
+        "device": "nvme", "nvme_path": str(tmp_path)}
+    eng_nv, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=nv_cfg)
+    data = _data(cfg, bs)
+    ref = _train(eng_cpu, data, steps=4)
+    got = _train(eng_nv, data, steps=4)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    swp = list(tmp_path.rglob("*.swp"))
+    assert swp, "no per-block swap files written"
+    # params, master and both moments per block + resident group
+    assert len(swp) >= 4 * (cfg.num_hidden_layers + 1)
+
+
+def test_blockwise_init_trains():
+    """initialize_parameters never materializes the full tree; training
+    still learns."""
+    cfg = _tiny_cfg()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    eng.initialize_parameters(0, np.zeros((bs, 16), np.int32),
+                              np.zeros((bs, 16), np.int32))
+    data = _data(cfg, bs)
+    losses = _train(eng, data, steps=10)
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_eval_and_logits_path():
+    cfg = _tiny_cfg()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    x = np.zeros((bs, 16), np.int32)
+    eng.initialize_parameters(0, x, x)
+    eng.eval()
+    logits = eng(x)
+    assert logits.shape == (bs, 16, cfg.vocab_size)
+    loss = eng(x, x)
+    assert np.isfinite(float(loss))
+    eng.train()
+
+
+def test_checkpoint_resume(tmp_path):
+    cfg = _tiny_cfg()
+    params = _host_params(cfg, 2)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    params = _host_params(cfg, bs)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    data = _data(cfg, bs)
+    _train(eng, data, steps=3)
+    eng.save_checkpoint(str(tmp_path))
+    cont = _train(eng, data, steps=3)
+
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == eng.global_steps - 3
+    resumed = _train(eng2, data, steps=3)
+    np.testing.assert_allclose(resumed, cont, rtol=1e-5)
+
+
+def test_fp16_rejected_loudly():
+    cfg = _tiny_cfg()
+    c = _config("cpu")
+    c["fp16"] = {"enabled": True}
+    with pytest.raises(ValueError, match="bf16/fp32"):
+        deepspeed_tpu.initialize(model=llama.LlamaModel(cfg), config=c)
+
+
+def test_non_streaming_model_rejected_loudly():
+    with pytest.raises(TypeError, match="streaming_parts"):
+        deepspeed_tpu.initialize(
+            model=lambda p, x, y: ((p["w"] * x - y) ** 2).mean(),
+            model_parameters={"w": np.ones((4, 4), np.float32)},
+            config=_config("cpu"))
+
+
+def test_cpu_param_nvme_state_updates_device_weights(tmp_path):
+    """Regression (r4 review): fp32 wire + RAM param cache + NVMe optimizer
+    state — the sweep must copy the updated master back into the cache the
+    next fetch reads, or device weights silently freeze."""
+    cfg = _tiny_cfg(layers=2)
+    c = _config("cpu")
+    c["zero_optimization"]["offload_optimizer"] = {
+        "device": "nvme", "nvme_path": str(tmp_path)}
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), config=c)
+    bs = 2 * eng.dp_world_size
+    eng.initialize_parameters(0, np.zeros((bs, 16), np.int32),
+                              np.zeros((bs, 16), np.int32))
+    data = _data(cfg, bs)
+    key = eng._spec.block_keys[0]
+    before = eng._store._cache[key].copy()
+    losses = _train(eng, data, steps=3)
+    # the RAM cache the next fetch streams MUST carry the kernel's update
+    assert not np.array_equal(before, eng._store._cache[key])
+    assert np.isfinite(losses).all()
+
+
+def test_pipeline_offload_param_rejected_loudly():
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    class B(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    model = PipelineModule(layers=[LayerSpec(B)],
+                           loss_fn=lambda o, y: ((o - y) ** 2).mean())
+    with pytest.raises(ValueError, match="offload_param"):
+        deepspeed_tpu.initialize(model=model, config=_config("cpu"))
