@@ -153,6 +153,16 @@ class HybridEngineConfig(DeepSpeedConfigModel):
     tp_gather_partition_size: int = 8
 
 
+class DominoConfig(DeepSpeedConfigModel):
+    """Domino µ-stream TP overlap (reference ``runtime/domino/transformer.py``
+    — here ``runtime/domino/transformer.split_microstreams``): opt-in batch
+    split into independent streams so the scheduler can hide TP collectives
+    that GSPMD compilation leaves exposed.  A/B first (``domino_ab``) — on
+    most TP meshes XLA already hides them and plain wins."""
+    enabled: bool = False
+    n_streams: int = 2
+
+
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     """Reference ``runtime/activation_checkpointing/config.py`` schema; on TPU
     this steers ``jax.checkpoint`` policies (SURVEY.md §7)."""
@@ -320,6 +330,7 @@ class DeepSpeedConfig:
             **pd.get("flops_profiler", {}) or {})
         self.hybrid_engine = HybridEngineConfig(
             **pd.get("hybrid_engine", {}) or {})
+        self.domino_config = DominoConfig(**pd.get("domino", {}) or {})
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}) or {})
         self.pipeline_config = PipelineConfig(**pd.get("pipeline", {}) or {})

@@ -1,0 +1,110 @@
+"""Domino µ-stream TP blocks — the opt-in remedy when a TP collective is NOT
+hidden by the scheduler.
+
+Reference: ``deepspeed/runtime/domino/transformer.py:518`` — the reference
+splits each batch into two µ-streams on separate CUDA streams and hand-
+interleaves their TP all-reduces with the other stream's compute.
+
+TPU-native form: CUDA streams don't exist; what XLA's latency-hiding
+scheduler needs to overlap a collective is an *independent* computation to
+schedule inside the start→done window.  ``split_microstreams`` creates that
+independence explicitly — the batch is split into ``n_streams`` halves whose
+subgraphs share only the (read-only) parameters, so stream B's matmuls are
+legal filler for stream A's all-reduce window.  On a mesh where XLA already
+hides the collectives (the common case, measured by
+``measure_tp_overlap``), the plain form wins by avoiding the smaller-matmul
+efficiency loss — run :func:`domino_ab` and keep the winner; that is the
+A/B the reference's blog performs by hand.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from .overlap import measure_tp_overlap
+
+
+def split_microstreams(apply_fn, n_streams=2, batch_argnum=0):
+    """Wrap a loss-returning ``apply_fn(params, *inputs) -> scalar`` so every
+    batch-like input splits into ``n_streams`` independent µ-streams.
+
+    Returns the mean of the per-stream losses — identical to the unsplit
+    loss for the uniform per-row-mean losses the engine's dp aggregation
+    already assumes.  Gradients are exactly the unsplit gradients (the mean
+    of per-half grads of per-half means).
+    """
+    if n_streams < 2:
+        return apply_fn
+
+    def split_apply(params, *inputs, **kw):
+        B = inputs[batch_argnum].shape[0]
+        if B % n_streams != 0:
+            raise ValueError(
+                f"domino n_streams={n_streams} must divide the micro batch "
+                f"(got batch {B})")
+        parts = [jnp.split(x, n_streams, axis=0)
+                 if hasattr(x, "ndim") and x.ndim > 0 and x.shape[0] == B
+                 else [x] * n_streams for x in inputs]
+        losses = [apply_fn(params, *[p[i] for p in parts], **kw)
+                  for i in range(n_streams)]
+        return jnp.mean(jnp.stack(losses))
+
+    return split_apply
+
+
+def split_block_microstreams(block_fn, n_streams=2):
+    """Activation-level variant: ``block_fn(params, x) -> y`` runs as
+    ``n_streams`` independent half-batch calls (the reference's
+    DominoTransformerLayer shape, for hand-built blocks)."""
+    if n_streams < 2:
+        return block_fn
+
+    def split_block(params, x):
+        outs = [block_fn(params, p)
+                for p in jnp.split(x, n_streams, axis=0)]
+        return jnp.concatenate(outs, axis=0)
+
+    return split_block
+
+
+def domino_ab(apply_fn, params, *inputs, n_streams=2, time_steps=0):
+    """Compile the plain and µ-stream forms, report overlap structure for
+    both, optionally wall-time them (``time_steps`` > 0 on real hardware),
+    and name the winner.
+
+    Decision rule: if the plain form's collectives are already all
+    overlapped, plain wins (Domino's split only shrinks the matmuls); else
+    the form with more overlapped pairs — wall time trumps structure when
+    measured.
+    """
+    split_fn = split_microstreams(apply_fn, n_streams)
+    report = {
+        "plain": measure_tp_overlap(apply_fn, params, *inputs),
+        "domino": measure_tp_overlap(split_fn, params, *inputs),
+        "n_streams": n_streams,
+    }
+
+    def _time(fn):
+        j = jax.jit(fn)
+        out = j(params, *inputs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(time_steps):
+            out = j(params, *inputs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / time_steps
+
+    if time_steps > 0:
+        report["plain"]["step_s"] = _time(apply_fn)
+        report["domino"]["step_s"] = _time(split_fn)
+        report["winner"] = ("plain" if report["plain"]["step_s"]
+                            <= report["domino"]["step_s"] else "domino")
+    else:
+        p, d = report["plain"], report["domino"]
+        fully_hidden = (p["async_pairs"] > 0
+                        and p["overlapped_pairs"] >= p["async_pairs"])
+        report["winner"] = (
+            "plain" if fully_hidden or
+            d["overlapped_pairs"] <= p["overlapped_pairs"] else "domino")
+    return report

@@ -819,6 +819,17 @@ class DeepSpeedEngine:
             apply_fn = lambda params, *inputs: inner(
                 quantized_weight_gather(params, self.plan,
                                         wire_format=qw_fmt), *inputs)
+        dc = self._config.domino_config
+        if dc.enabled:
+            if self.progressive_layer_drop is not None:
+                raise ValueError(
+                    "domino µ-streams cannot compose with "
+                    "progressive_layer_drop (the PLD rng/theta tail would be "
+                    "batch-split); disable one of them")
+            # Domino µ-streams: independent half-batch subgraphs give the
+            # latency-hiding scheduler filler compute for TP collectives
+            from .domino.transformer import split_microstreams
+            apply_fn = split_microstreams(apply_fn, dc.n_streams)
         from .utils import make_scaled_loss_fn
         loss_fn = make_scaled_loss_fn(apply_fn, gas)
 
