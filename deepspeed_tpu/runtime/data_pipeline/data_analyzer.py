@@ -1,63 +1,174 @@
 """Offline data analysis — reference
 ``runtime/data_pipeline/data_sampling/data_analyzer.py:22`` (DataAnalyzer).
 
-Map-reduce over a dataset: worker i analyzes its contiguous shard with
-user-supplied metric functions, writes per-shard results, and ``merge``
-produces the final per-sample metric array + sample buckets that
-``DeepSpeedDataSampler`` consumes for curriculum learning.
+Map-reduce over a dataset into curriculum index files:
+
+* map: worker ``i`` walks its contiguous shard, evaluating each metric fn —
+  ``single_value_per_sample`` metrics record one value per sample;
+  ``accumulate_value_over_samples`` metrics fold into one running value
+  (e.g. total token count).
+* reduce: shards merge into the reference's artifact set per metric —
+  ``{m}_sample_to_metric``   (MMap indexed: sample id → value)
+  ``{m}_metric_to_sample``   (inverted: one document per distinct value,
+                              listing its sample ids)
+  ``{m}_index_to_sample``    (easy→hard consumption order)
+  ``{m}_index_to_metric``    (the sorted values themselves)
+  ``{m}_index_to_sample_percentile_merged`` (one document per percentile,
+                              the curriculum scheduler's lookup granularity)
+  plus ``{m}_values.npy`` for direct numpy consumption by
+  ``DeepSpeedDataSampler(metric_values=...)``.
+
+``custom_map_init/update/finalize`` and ``custom_reduce`` hooks mirror the
+reference's extension points.  ``run_map_reduce(num_workers=N)`` spawns the
+workers as processes (the reference uses multiprocessing the same way).
 """
 
 import json
 import os
+from multiprocessing import get_context
 
 import numpy as np
+
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+SINGLE = "single_value_per_sample"
+ACCUM = "accumulate_value_over_samples"
 
 
 class DataAnalyzer:
     def __init__(self, dataset, output_path, metric_names=None,
-                 metric_functions=None, num_workers=1, worker_id=0,
-                 batch_size=64):
-        """``metric_functions``: list of callables sample → scalar."""
+                 metric_functions=None, metric_types=None, num_workers=1,
+                 worker_id=0, batch_size=64, metric_dtypes=None,
+                 custom_map_init=None, custom_map_update=None,
+                 custom_map_finalize=None, custom_reduce=None,
+                 sample_indices=None):
+        """``metric_functions``: list of callables sample → scalar (SINGLE)
+        or (running, sample) → running (ACCUM)."""
         self.dataset = dataset
         self.output_path = os.path.abspath(output_path)
         self.metric_names = metric_names or ["metric"]
         self.metric_functions = metric_functions or []
+        self.metric_types = metric_types or [SINGLE] * len(self.metric_names)
+        self.metric_dtypes = metric_dtypes or \
+            [np.float64] * len(self.metric_names)
         self.num_workers = num_workers
         self.worker_id = worker_id
         self.batch_size = batch_size
+        self.custom_map_init = custom_map_init
+        self.custom_map_update = custom_map_update
+        self.custom_map_finalize = custom_map_finalize
+        self.custom_reduce = custom_reduce
+        self.sample_indices = sample_indices
         os.makedirs(self.output_path, exist_ok=True)
 
+    # ------------------------------------------------------------------ map
     def _shard_range(self):
-        n = len(self.dataset)
+        n = (len(self.sample_indices) if self.sample_indices is not None
+             else len(self.dataset))
         per = (n + self.num_workers - 1) // self.num_workers
         lo = self.worker_id * per
         return lo, min(n, lo + per)
 
     def _shard_file(self, name, worker_id=None):
         wid = self.worker_id if worker_id is None else worker_id
-        return os.path.join(self.output_path,
-                            f"{name}_worker{wid}.npy")
+        return os.path.join(self.output_path, f"{name}_worker{wid}.npy")
 
     def run_map(self):
         """Analyze this worker's shard; write {metric}_worker{i}.npy."""
         lo, hi = self._shard_range()
-        results = {name: [] for name in self.metric_names}
-        for i in range(lo, hi):
-            sample = self.dataset[i]
-            for name, fn in zip(self.metric_names, self.metric_functions):
-                results[name].append(float(fn(sample)))
-        for name in self.metric_names:
+        state = (self.custom_map_init() if self.custom_map_init else None)
+        results = {}
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            results[name] = [] if mtype == SINGLE else None
+        for j in range(lo, hi):
+            idx = (self.sample_indices[j] if self.sample_indices is not None
+                   else j)
+            sample = self.dataset[idx]
+            for name, fn, mtype in zip(self.metric_names,
+                                       self.metric_functions,
+                                       self.metric_types):
+                if mtype == SINGLE:
+                    results[name].append(float(fn(sample)))
+                elif mtype == ACCUM:
+                    results[name] = fn(results[name], sample)
+                else:
+                    raise ValueError(f"unknown metric_type {mtype!r} "
+                                     f"(have: {SINGLE!r}, {ACCUM!r})")
+            if self.custom_map_update:
+                state = self.custom_map_update(state, sample)
+        if self.custom_map_finalize:
+            state = self.custom_map_finalize(state)
+            with open(os.path.join(
+                    self.output_path,
+                    f"custom_worker{self.worker_id}.json"), "w") as f:
+                json.dump(state, f)
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            if mtype == SINGLE:
+                val = results[name]
+            else:
+                # an empty shard never ran the fold — contribute the sum
+                # identity instead of crashing np.asarray on None
+                val = [0.0 if results[name] is None else results[name]]
             np.save(self._shard_file(name),
-                    np.asarray(results[name], dtype=np.float64))
+                    np.asarray(val, dtype=np.float64))
         with open(os.path.join(self.output_path,
-                               f"shard_worker{self.worker_id}.json"), "w") as f:
+                               f"shard_worker{self.worker_id}.json"),
+                  "w") as f:
             json.dump({"lo": lo, "hi": hi}, f)
-        return {k: np.asarray(v) for k, v in results.items()}
+        return {k: (np.asarray(v) if isinstance(v, list) else v)
+                for k, v in results.items()}
+
+    # --------------------------------------------------------------- reduce
+    def _write_index_files(self, name, values, dtype):
+        """The reference's per-metric artifact set as MMap indexed files."""
+        pre = os.path.join(self.output_path, name)
+        s2m = MMapIndexedDatasetBuilder(f"{pre}_sample_to_metric",
+                                        dtype=dtype)
+        for v in values:
+            s2m.add_item(np.asarray([v], dtype=dtype))
+        s2m.finalize()
+
+        order = np.argsort(values, kind="stable")
+        i2s = MMapIndexedDatasetBuilder(f"{pre}_index_to_sample",
+                                        dtype=np.int64)
+        i2s.add_item(order.astype(np.int64))
+        i2s.finalize()
+        i2m = MMapIndexedDatasetBuilder(f"{pre}_index_to_metric", dtype=dtype)
+        i2m.add_item(values[order].astype(dtype))
+        i2m.finalize()
+
+        # inverted index: one document per distinct metric value (ascending)
+        m2s = MMapIndexedDatasetBuilder(f"{pre}_metric_to_sample",
+                                        dtype=np.int64)
+        distinct = []
+        sorted_vals = values[order]
+        start = 0
+        for k in range(1, len(order) + 1):
+            if k == len(order) or sorted_vals[k] != sorted_vals[start]:
+                m2s.add_item(order[start:k].astype(np.int64))
+                distinct.append(float(sorted_vals[start]))
+                start = k
+        m2s.finalize()
+        with open(f"{pre}_metric_to_sample_keys.json", "w") as f:
+            json.dump(distinct, f)
+
+        # percentile merge: 100 documents, percentile p → its sample ids
+        # (reference index_to_sample_percentile_merged — the curriculum
+        # difficulty lookup granularity)
+        pm = MMapIndexedDatasetBuilder(
+            f"{pre}_index_to_sample_percentile_merged", dtype=np.int64)
+        bounds = (np.arange(1, 101) * len(order) / 100).astype(np.int64)
+        start = 0
+        for b in bounds:
+            pm.add_item(order[start:b].astype(np.int64))
+            start = b
+        pm.finalize()
 
     def run_reduce(self):
-        """Merge all worker shards → {metric}_values.npy + index maps."""
+        """Merge all worker shards → index files + {metric}_values.npy."""
         merged = {}
-        for name in self.metric_names:
+        for name, mtype, dtype in zip(self.metric_names, self.metric_types,
+                                      self.metric_dtypes):
             parts = []
             for w in range(self.num_workers):
                 path = self._shard_file(name, w)
@@ -65,15 +176,31 @@ class DataAnalyzer:
                     raise FileNotFoundError(
                         f"worker {w} shard missing for metric {name}: {path}")
                 parts.append(np.load(path))
+            if mtype == ACCUM:
+                # fold shard accumulators (sum — the reference's semantics
+                # for token-count style metrics)
+                total = float(np.sum([p[0] for p in parts]))
+                with open(os.path.join(self.output_path,
+                                       f"{name}_total.json"), "w") as f:
+                    json.dump(total, f)
+                merged[name] = total
+                continue
             values = np.concatenate(parts)
             np.save(os.path.join(self.output_path, f"{name}_values.npy"),
                     values)
-            # sample index sorted by metric (easy→hard), the curriculum
-            # consumption order (reference index_to_sample files)
             order = np.argsort(values, kind="stable")
             np.save(os.path.join(self.output_path,
                                  f"{name}_index_to_sample.npy"), order)
+            self._write_index_files(name, values, dtype)
             merged[name] = values
+        if self.custom_reduce:
+            states = []
+            for w in range(self.num_workers):
+                p = os.path.join(self.output_path, f"custom_worker{w}.json")
+                if os.path.exists(p):
+                    with open(p) as f:
+                        states.append(json.load(f))
+            merged["custom"] = self.custom_reduce(states)
         return merged
 
     def run(self):
@@ -82,6 +209,46 @@ class DataAnalyzer:
             return self.run_reduce()
         return None
 
+    def run_map_reduce(self, num_workers=None):
+        """Spawn ``num_workers`` map processes, then reduce (the reference's
+        multiprocessing flow, ``data_analyzer.py`` run_map_reduce)."""
+        n = num_workers or self.num_workers
+        self.num_workers = n
+        if n == 1:
+            self.run_map()
+            return self.run_reduce()
+        ctx = get_context("fork")
+        procs = []
+        for w in range(n):
+            procs.append(ctx.Process(target=_map_worker, args=(self, w)))
+            procs[-1].start()
+        for p in procs:
+            p.join()
+            if p.exitcode != 0:
+                raise RuntimeError(f"map worker failed (exit {p.exitcode})")
+        return self.run_reduce()
+
+    # ------------------------------------------------------------- consumers
     @staticmethod
     def load_metric(output_path, metric_name="metric"):
         return np.load(os.path.join(output_path, f"{metric_name}_values.npy"))
+
+    @staticmethod
+    def load_index_to_sample(output_path, metric_name="metric"):
+        ds = MMapIndexedDataset(
+            os.path.join(output_path, f"{metric_name}_index_to_sample"))
+        return np.asarray(ds[0])
+
+    @staticmethod
+    def load_percentile_samples(output_path, metric_name="metric",
+                                percentile=100):
+        """Sample ids at difficulty ≤ the given percentile (1-100)."""
+        ds = MMapIndexedDataset(os.path.join(
+            output_path, f"{metric_name}_index_to_sample_percentile_merged"))
+        parts = [np.asarray(ds[p]) for p in range(min(percentile, len(ds)))]
+        return np.concatenate(parts) if parts else np.array([], np.int64)
+
+
+def _map_worker(analyzer, worker_id):
+    analyzer.worker_id = worker_id
+    analyzer.run_map()
