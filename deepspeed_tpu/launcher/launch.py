@@ -33,7 +33,13 @@ def parse_args(args=None):
                             "NODE_RANK",
                             os.environ.get(
                                 "OMPI_COMM_WORLD_RANK",
-                                os.environ.get("SLURM_PROCID", 0)))))
+                                os.environ.get(
+                                    "SLURM_PROCID",
+                                    # MPICH/IMPI Hydra + MVAPICH mpirun_rsh
+                                    os.environ.get(
+                                        "PMI_RANK",
+                                        os.environ.get(
+                                            "MV2_COMM_WORLD_RANK", 0)))))))
     parser.add_argument("--master_addr", type=str, default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--one_proc_per_device", action="store_true")

@@ -120,6 +120,77 @@ class OpenMPIRunner(MultiNodeRunner):
         return cmd
 
 
+class MPICHRunner(MultiNodeRunner):
+    """Reference ``multinode_runner.py:179`` — Hydra-style mpirun
+    (``-ppn`` / ``-genv`` / ``-hosts``).  One launcher process per node;
+    ``launch.py`` spawns the node-local workers (PMI_RANK → node_rank)."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        cmd = ["mpirun", "-n", str(len(active_resources)), "-ppn", "1",
+               "-hosts", ",".join(active_resources)]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        for k, v in {**environment, **self.exports}.items():
+            cmd += ["-genv", f"{k}={v}"]
+        launch = self._launch_cmd("0")
+        launch.remove("--node_rank=0")   # PMI_RANK supplies it per node
+        return cmd + launch
+
+
+class IMPIRunner(MPICHRunner):
+    """Reference ``multinode_runner.py:251`` — Intel MPI: the same Hydra
+    front-end with an explicit ssh bootstrap."""
+
+    def get_cmd(self, environment, active_resources):
+        cmd = super().get_cmd(environment, active_resources)
+        # insert after "mpirun": bootstrap selection is an Intel-ism
+        return cmd[:1] + ["-bootstrap", "ssh"] + cmd[1:]
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """Reference ``multinode_runner.py:384`` — ``mpirun_rsh`` with a written
+    hostfile and k=v environment args; the reference's MV2_* tuning exports
+    are applied minus the CUDA-only ones (N/A on TPU)."""
+
+    def __init__(self, args, world_info_base64):
+        super().__init__(args, world_info_base64)
+        self.add_export("MV2_SMP_USE_CMA", "0")        # CMA absent on Ubuntu
+        self.add_export("MV2_DEBUG_SHOW_BACKTRACE", "1")
+        self.add_export("MV2_SUPPORT_DL", "1")
+        self.add_export("MV2_ENABLE_AFFINITY", "0")    # MPI_THREAD_MULTIPLE
+
+    def backend_exists(self):
+        if shutil.which("mpirun_rsh") is None:
+            return False
+        mpiname = shutil.which("mpiname")
+        if mpiname is None:
+            return False
+        try:
+            import subprocess
+            out = subprocess.check_output([mpiname]).decode()
+            return "MVAPICH" in out
+        except (OSError, subprocess.CalledProcessError):
+            return False
+
+    def get_cmd(self, environment, active_resources):
+        hostfile = os.path.join(os.path.expanduser("~"),
+                                ".deepspeed_mvapich_hostfile")
+        with open(hostfile, "w") as f:
+            f.write("\n".join(active_resources) + "\n")
+        cmd = ["mpirun_rsh", "-np", str(len(active_resources)),
+               "-hostfile", hostfile]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        for k, v in {**environment, **self.exports}.items():
+            cmd += [f"{k}={v}"]     # mpirun_rsh takes env as k=v positionals
+        launch = self._launch_cmd("0")
+        launch.remove("--node_rank=0")   # MV2_COMM_WORLD_RANK / PMI_RANK
+        return cmd + launch
+
+
 class SlurmRunner(MultiNodeRunner):
     """Reference ``multinode_runner.py:336`` — srun."""
 

@@ -50,7 +50,8 @@ def parse_args(args=None):
                         default=os.environ.get("DS_MASTER_ADDR", ""),
                         help="Coordinator address (default: first host).")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=("pdsh", "openmpi", "slurm", "ssh", "local"),
+                        choices=("pdsh", "openmpi", "mpich", "impi",
+                                 "mvapich", "slurm", "ssh", "local"),
                         help="Multinode backend.")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="Extra args passed to the multinode backend.")
@@ -175,7 +176,8 @@ def _local_device_count():
 def build_launch_command(args, active_resources):
     """Construct the per-node ``launch.py`` command (single-node path) or the
     multinode runner command."""
-    from .multinode_runner import (OpenMPIRunner, PDSHRunner, SlurmRunner,
+    from .multinode_runner import (IMPIRunner, MPICHRunner, MVAPICHRunner,
+                                   OpenMPIRunner, PDSHRunner, SlurmRunner,
                                    SSHRunner)
     world_info = encode_world_info(active_resources)
     multi_node = args.force_multi or len(active_resources) > 1
@@ -197,6 +199,8 @@ def build_launch_command(args, active_resources):
         return cmd
 
     runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "mpich": MPICHRunner, "impi": IMPIRunner,
+                  "mvapich": MVAPICHRunner,
                   "slurm": SlurmRunner, "ssh": SSHRunner}[args.launcher]
     runner = runner_cls(args, world_info)
     if not runner.backend_exists():

@@ -187,3 +187,54 @@ def test_ds_ssh_reports_failures(tmp_path, monkeypatch):
 
     monkeypatch.setattr(subprocess, "run", fake_run)
     assert ds_ssh.main(["-f", str(hostfile), "--", "false"]) == 1
+
+
+def test_mpich_cmd_shape():
+    """Reference multinode_runner.py:179 — Hydra mpirun with -ppn/-genv/-hosts;
+    node_rank comes from PMI_RANK, not a flag."""
+    args = runner.parse_args(["--launcher", "mpich", "--master_addr",
+                              "10.0.0.1", "train.py"])
+    from deepspeed_tpu.launcher.multinode_runner import MPICHRunner
+    r = MPICHRunner(args, encode_world_info({"h0": [0], "h1": [0]}))
+    cmd = r.get_cmd({"PATH": "/usr/bin"}, {"h0": [0], "h1": [0]})
+    assert cmd[0] == "mpirun"
+    assert cmd[cmd.index("-ppn") + 1] == "1"
+    assert cmd[cmd.index("-hosts") + 1] == "h0,h1"
+    assert "-genv" in cmd and "PATH=/usr/bin" in cmd
+    assert not any(c.startswith("--node_rank") for c in cmd)
+    assert cmd[-1] == "train.py"
+
+
+def test_impi_cmd_adds_ssh_bootstrap():
+    args = runner.parse_args(["--launcher", "impi", "--master_addr",
+                              "10.0.0.1", "train.py"])
+    from deepspeed_tpu.launcher.multinode_runner import IMPIRunner
+    r = IMPIRunner(args, encode_world_info({"h0": [0], "h1": [0]}))
+    cmd = r.get_cmd({}, {"h0": [0], "h1": [0]})
+    assert cmd[0] == "mpirun" and cmd[1:3] == ["-bootstrap", "ssh"]
+
+
+def test_mvapich_cmd_shape(tmp_path, monkeypatch):
+    """Reference multinode_runner.py:384 — mpirun_rsh + written hostfile +
+    k=v env positionals + MV2_* tuning exports (CUDA-only ones omitted)."""
+    monkeypatch.setenv("HOME", str(tmp_path))
+    args = runner.parse_args(["--launcher", "mvapich", "--master_addr",
+                              "10.0.0.1", "train.py"])
+    from deepspeed_tpu.launcher.multinode_runner import MVAPICHRunner
+    r = MVAPICHRunner(args, encode_world_info({"h0": [0], "h1": [0]}))
+    cmd = r.get_cmd({}, {"h0": [0], "h1": [0]})
+    assert cmd[0] == "mpirun_rsh"
+    assert cmd[cmd.index("-np") + 1] == "2"
+    hostfile = cmd[cmd.index("-hostfile") + 1]
+    assert open(hostfile).read().splitlines() == ["h0", "h1"]
+    assert "MV2_SMP_USE_CMA=0" in cmd and "MV2_SUPPORT_DL=1" in cmd
+    assert not any("MV2_USE_CUDA" in c for c in cmd)
+
+
+def test_launch_node_rank_from_pmi_env(monkeypatch):
+    from deepspeed_tpu.launcher import launch
+    info = encode_world_info({"h0": [0], "h1": [0]})
+    monkeypatch.delenv("NODE_RANK", raising=False)
+    monkeypatch.setenv("PMI_RANK", "1")
+    args = launch.parse_args([f"--world_info={info}", "t.py"])
+    assert args.node_rank == 1
