@@ -10,8 +10,9 @@ from .comm import (all_gather, all_gather_coalesced, all_gather_into_tensor,
                    has_reduce_scatter_tensor, inference_all_reduce,
                    init_distributed, initialize_mesh_device, irecv, is_available,
                    is_initialized, isend, log_summary, monitored_barrier,
-                   new_group, recv, reduce, reduce_scatter,
-                   reduce_scatter_fn, reduce_scatter_tensor, scatter, send)
+                   new_group, recv, recv_obj, reduce, reduce_scatter,
+                   reduce_scatter_fn, reduce_scatter_tensor, scatter, send,
+                   send_obj)
 from .backend import MeshBackend, ProcessGroup
 from .reduce_op import ReduceOp
 from . import functional

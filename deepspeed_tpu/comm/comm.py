@@ -361,19 +361,83 @@ def reduce_scatter_fn(output_tensor, input_tensor, op=ReduceOp.SUM,
 def send(tensor, dst, group=None, tag=0):
     raise NotImplementedError(
         "eager decoupled send/recv does not exist under a single SPMD "
-        "controller — express p2p as lax.ppermute inside the compiled "
-        "program (see runtime/pipe/engine.py)")
+        "controller — express hot-path p2p as lax.ppermute inside the "
+        "compiled program (see runtime/pipe/engine.py); for host-side "
+        "control-plane traffic use dist.send_obj / dist.recv_obj")
 
 
 def recv(tensor, src, group=None, tag=0):
     raise NotImplementedError(
         "eager decoupled send/recv does not exist under a single SPMD "
-        "controller — express p2p as lax.ppermute inside the compiled "
-        "program (see runtime/pipe/engine.py)")
+        "controller — express hot-path p2p as lax.ppermute inside the "
+        "compiled program (see runtime/pipe/engine.py); for host-side "
+        "control-plane traffic use dist.send_obj / dist.recv_obj")
 
 
 isend = send
 irecv = recv
+
+
+# ------------------------------------------- out-of-band object p2p
+# Reference ``runtime/pipe/p2p.py:46`` (send_obj/recv_obj): a host-side
+# control-plane channel for debugging/elastic tooling — NOT the activation
+# hot path (that is ppermute inside the compiled program).  Multi-process:
+# rides the jax.distributed coordination service's KV store; single
+# process: an in-memory queue.
+_obj_queues = {}        # (src, dst, tag) → list of payloads (1-process)
+_obj_send_seq = {}      # (dst, tag) → next sequence number
+_obj_recv_seq = {}      # (src, tag) → next sequence number
+
+
+def _kv_client():
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client
+    except Exception:
+        return None
+
+
+def send_obj(obj, dst, tag=0):
+    """Send a picklable object to process ``dst`` (reference
+    ``pipe/p2p.py`` ``send_obj``).  Non-blocking-ish: the payload is posted
+    to the coordination-service KV store and consumed by ``recv_obj``."""
+    import base64
+    import pickle
+    me = get_rank()
+    seq = _obj_send_seq.get((dst, tag), 0)
+    _obj_send_seq[(dst, tag)] = seq + 1
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    client = _kv_client()
+    if client is None or get_world_size() == 1:
+        _obj_queues.setdefault((me, dst, tag), []).append(payload)
+        return
+    client.key_value_set(f"ds_p2p/{me}->{dst}/t{tag}/s{seq}",
+                         base64.b64encode(payload).decode("ascii"))
+
+
+def recv_obj(src, tag=0, timeout_s=300):
+    """Blocking receive of the next object from process ``src``."""
+    import base64
+    import pickle
+    me = get_rank()
+    seq = _obj_recv_seq.get((src, tag), 0)
+    _obj_recv_seq[(src, tag)] = seq + 1
+    client = _kv_client()
+    if client is None or get_world_size() == 1:
+        q = _obj_queues.get((src, me, tag))
+        if not q:
+            raise RuntimeError(
+                f"recv_obj: nothing sent from rank {src} (tag {tag})")
+        return pickle.loads(q.pop(0))
+    key = f"ds_p2p/{src}->{me}/t{tag}/s{seq}"
+    val = client.blocking_key_value_get(key, timeout_s * 1000)
+    try:
+        # consumed: free the coordinator's copy (payloads can be MBs; a
+        # long-running elastic loop would otherwise leak every message)
+        client.key_value_delete(key)
+    except Exception:
+        pass
+    return pickle.loads(base64.b64decode(val))
 
 
 def scatter(tensor, scatter_list=None, src=0, group=None, async_op=False):

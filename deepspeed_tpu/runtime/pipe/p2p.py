@@ -1,0 +1,38 @@
+"""Out-of-band point-to-point — reference ``runtime/pipe/p2p.py``.
+
+The activation hot path is ``lax.ppermute`` INSIDE the fused pipeline
+program (``engine.py``), so the reference's ``send``/``recv`` tensor calls
+have no eager analog here.  What this module keeps is the *control-plane*
+surface: host-side object exchange for debugging and elastic tooling
+(reference ``send_obj``/``recv_obj`` at ``p2p.py:46``), riding the
+coordination-service KV store via :mod:`deepspeed_tpu.comm`.
+"""
+
+from ... import comm as dist
+
+
+def init_process_groups(grid=None):
+    """Parity no-op: the mesh IS the process-group topology."""
+    dist.ensure_runtime_initialized()
+
+
+def can_send_recv():
+    return dist.get_world_size() > 1
+
+
+def send_obj(msg, dest, tag=0):
+    """Reference ``p2p.py`` ``send_obj`` — picklable object to rank ``dest``."""
+    dist.send_obj(msg, dest, tag=tag)
+
+
+def recv_obj(sender, tag=0, timeout_s=300):
+    """Reference ``p2p.py`` ``recv_obj`` — blocking object receive."""
+    return dist.recv_obj(sender, tag=tag, timeout_s=timeout_s)
+
+
+def send(tensor, dest_stage, tag=0):
+    dist.send(tensor, dest_stage, tag=tag)   # raises with the design note
+
+
+def recv(tensor, src_stage, tag=0):
+    dist.recv(tensor, src_stage, tag=tag)    # raises with the design note

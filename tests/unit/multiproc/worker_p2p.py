@@ -1,0 +1,47 @@
+"""2-process worker for the out-of-band object p2p channel
+(reference runtime/pipe/p2p.py send_obj/recv_obj)."""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=1").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_COUNT"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.runtime.pipe import p2p
+
+    dist.init_distributed()
+    p2p.init_process_groups()
+    assert p2p.can_send_recv()
+    if pid == 0:
+        p2p.send_obj({"cmd": "ping", "step": 7}, 1)
+        p2p.send_obj(np.arange(5, dtype=np.float32), 1)
+        back = p2p.recv_obj(1)
+        assert back == {"ack": 7}, back
+        print("P2P-OK rank0", flush=True)
+    else:
+        msg = p2p.recv_obj(0)
+        assert msg == {"cmd": "ping", "step": 7}, msg
+        arr = p2p.recv_obj(0)
+        np.testing.assert_array_equal(arr, np.arange(5, dtype=np.float32))
+        p2p.send_obj({"ack": msg["step"]}, 0)
+        print("P2P-OK rank1", flush=True)
+
+
+if __name__ == "__main__":
+    main()
