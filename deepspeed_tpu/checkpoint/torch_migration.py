@@ -254,6 +254,12 @@ def _assemble_stage3(model_sd, optim_files, zero_model_sds=(),
         for key, flats in ranks.items():
             segs = []
             for r in range(dp):
+                # DELIBERATE deviation from the reference's
+                # ds_to_universal.py:165 ``min(pn, abs(numel - r*pn))``: for
+                # ranks past the data (numel=5, dp=4 → rank 3) abs() would
+                # read padding bytes as parameters; the clamp at 0 is the
+                # mathematically correct count.  Do not "fix" this back to
+                # mirror the reference (ADVICE r3).
                 valid = max(0, min(pn, numel - r * pn))
                 if valid:
                     segs.append(flats[r][offset:offset + valid])

@@ -42,16 +42,21 @@ from ..utils.logging import logger
 # the meaning of the second POSITIONAL argument (diffusers passes the
 # cross-attention ``context`` there; transformers passes the padding mask).
 DEFAULT_POLICIES = {
+    # "scale": attribute names to probe for the softmax scale, part of the
+    # per-class policy (ADVICE r3) — a class whose scale lives under another
+    # name must say so here rather than silently computing with D**-0.5
     "FlaxAttention": dict(q="query", k="key", v="value", out="proj_attn",
                           heads=("heads", ), returns_tuple=False,
-                          arg1="context"),
+                          arg1="context", scale=("scale", )),
     "FlaxCrossAttention": dict(q="query", k="key", v="value",
                                out="proj_attn", heads=("heads", ),
-                               returns_tuple=False, arg1="context"),
+                               returns_tuple=False, arg1="context",
+                               scale=("scale", )),
     "FlaxCLIPAttention": dict(q="q_proj", k="k_proj", v="v_proj",
                               out="out_proj",
                               heads=("num_heads", "heads"),
-                              returns_tuple=True, arg1="attention_mask"),
+                              returns_tuple=True, arg1="attention_mask",
+                              scale=("scale", )),
 }
 
 # any of these kwargs being non-None means cross-attention / kv-from-
@@ -74,7 +79,11 @@ def _fused_call(mod, pol, hidden, counter):
     k = k.reshape(B, S, heads, Dh)
     v = v.reshape(B, S, heads, Dh)
     causal = bool(getattr(mod, "causal", False))
-    scale = getattr(mod, "scale", None)
+    scale = None
+    for attr in pol.get("scale", ("scale", )):
+        scale = getattr(mod, attr, None)
+        if scale is not None:
+            break
     out = attention_core(q, k, v, causal=causal, softmax_scale=scale)
     out = out.reshape(B, S, heads * Dh)
     out = getattr(mod, pol["out"])(out)

@@ -92,7 +92,10 @@ def muon(lr=2e-2, momentum=0.95, nesterov=True, ns_steps=5,
     def update(grads, state, params):
         count = state.count + 1
         cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
-        aw_lr = adamw_lr * (cur_lr / lr)  # follow the schedule's shape
+        # follow the schedule's shape; lr=0 (freeze-muon-leaves / warmup-
+        # from-zero base lr) must not divide by zero — the adamw leaves then
+        # run at their own configured rate (ADVICE r3)
+        aw_lr = adamw_lr * (cur_lr / lr) if lr else adamw_lr
         bc1 = 1.0 - b1**count.astype(jnp.float32)
         bc2 = 1.0 - b2**count.astype(jnp.float32)
 

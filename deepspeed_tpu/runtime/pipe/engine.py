@@ -15,11 +15,14 @@ program:
 * inside ``shard_map`` over "pp", each tick runs the stage's layers with
   ``lax.scan`` and hands activations to the next stage with ``ppermute``
   (the ``p2p.send/recv`` analog — a neighbor ICI hop);
-* the microbatch loop is unrolled over ``M + pp - 1`` ticks (GPipe filling/
-  draining); losses accumulate on the last stage and are ``psum``-averaged;
+* the microbatch loop is a ``lax.scan`` over the ``M + pp - 1`` GPipe
+  fill/drain ticks — compiled size flat in M (one tick body compiled once);
+  losses accumulate on the last stage and are ``psum``-averaged;
 * ``jax.grad`` through the whole program gives the backward schedule — XLA's
   scheduler overlaps the reverse ppermutes exactly where 1F1B would, and
-  per-block ``remat`` keeps activation memory at the 1F1B level;
+  per-block ``remat`` bounds the live activation set (validated by the
+  compiled-memory test in ``tests/unit/runtime/pipe/test_pipe_memory.py``
+  and the figures in ``docs/parallelism.md``);
 * ZeRO/bf16/fp16 compose unchanged: stacked block params get base spec
   P("pp") on the layer dim and the ZeRO axes shard the rest (same plan
   machinery as TP).
@@ -552,7 +555,13 @@ class PipelineEngine(DeepSpeedEngine):
     # -------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
         """One full training step over gas microbatches (reference
-        ``train_batch`` pipe/engine.py:338)."""
+        ``train_batch`` pipe/engine.py:338).
+
+        Loss aggregation contract (matches the reference's
+        ``_aggregate_total_loss``): the module's ``loss_fn`` must be a
+        uniform per-row-mean loss — the fused program psum-averages it over
+        pp/M and pmean-averages over dp with EQUAL weights, so a sum-reduced
+        or sample-weighted loss_fn returns a mis-weighted global loss."""
         self._check_params()
         if data_iter is None:
             data_iter = iter(self.training_dataloader)
@@ -584,8 +593,12 @@ class PipelineEngine(DeepSpeedEngine):
             self._nvme_swap_out()
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        if bool(overflow):
-            self.skipped_steps += 1
+        if self._config.fp16_enabled:
+            # no per-batch host sync: accumulate on device, drained lazily
+            # by the skipped_steps property / steps_per_print report
+            ov = overflow.astype(jnp.int32)
+            self._overflow_acc = (ov if self._overflow_acc is None
+                                  else self._overflow_acc + ov)
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
             self._scheduler_reclaims_lr()
@@ -597,7 +610,9 @@ class PipelineEngine(DeepSpeedEngine):
     def eval_batch(self, data_iter, return_logits=False):
         """Forward-only THROUGH the pipelined program (reference
         ``eval_batch`` pipe/engine.py:441; round 1 silently bypassed the
-        pipeline — round 2 runs the same fused schedule, grad-free)."""
+        pipeline — round 2 runs the same fused schedule, grad-free).
+        Same loss contract as :meth:`train_batch`: uniform per-row-mean
+        ``loss_fn`` (equal-weight pp/M/dp averaging)."""
         self._check_params()
         batch = next(data_iter)
         x, y = np.asarray(batch[0]), np.asarray(batch[1])
