@@ -681,6 +681,24 @@ def main():
             errors[mode] = (f"rc={proc.returncode} "
                             f"stderr tail: {(err or '')[-500:]}")
 
+    # fold recorded on-chip ladder legs (tools/bench_retry.sh writes them
+    # to .bench_runs/<mode>.json) into the headline record so the driver's
+    # single JSON line carries the whole BASELINE ladder
+    runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_runs")
+    ladder_bits = []
+    for mode in ("gpt2", "offload", "fpdt", "serve"):
+        try:
+            with open(os.path.join(runs_dir, f"{mode}.json")) as f:
+                rec = json.load(f)
+            if "backend=tpu" in rec.get("unit", ""):
+                ladder_bits.append(f"{mode}={rec['value']}"
+                                   f"@vs{rec['vs_baseline']}")
+        except (OSError, ValueError, KeyError):
+            continue
+    ladder_note = (" [on-chip ladder: " + " ".join(ladder_bits) + "]"
+                   if ladder_bits else "")
+
     # self-maintaining record of the last successful REAL-CHIP run, cited
     # for honest context when the tunnel is too slow today
     last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -692,6 +710,7 @@ def main():
                            **results["device"]}, f)
         except OSError:
             pass
+        results["device"]["unit"] += ladder_note
         print(json.dumps(results["device"]), flush=True)
     elif "cpu" in results:
         rec = results["cpu"]
@@ -705,7 +724,8 @@ def main():
         except (OSError, ValueError):
             pass
         rec["unit"] += (" [cpu-fallback: device attempt failed: "
-                        f"{errors.get('device', 'unknown')[:200]}{note}]")
+                        f"{errors.get('device', 'unknown')[:200]}{note}]"
+                        + ladder_note)
         print(json.dumps(rec), flush=True)
     else:
         print(json.dumps({

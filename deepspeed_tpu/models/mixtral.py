@@ -58,6 +58,18 @@ def moe_expert_ffn(x_sorted, group_sizes, w1, w2, w3):
     Returns [Tk, D].  ``ragged_dot`` is XLA's grouped matmul — each expert's
     contiguous token block hits the MXU with that expert's weights.
     """
+    import os
+    if os.environ.get("DS_TPU_MOE_GMM") == "1":
+        # opt-in Pallas grouped GEMM (ops/pallas/grouped_matmul.py) — the
+        # hand-schedulable alternative to XLA's ragged_dot for on-chip A/B
+        try:
+            from ..ops.pallas.grouped_matmul import gmm
+            gs = group_sizes.astype(jnp.int32)
+            gate = gmm(x_sorted, w1, gs)
+            up = gmm(x_sorted, w3, gs)
+            return gmm(nn.silu(gate) * up, w2, gs)
+        except ValueError:
+            pass   # dims not tile-divisible → XLA path below
     gate = jax.lax.ragged_dot(x_sorted, w1, group_sizes)
     up = jax.lax.ragged_dot(x_sorted, w3, group_sizes)
     return jax.lax.ragged_dot(nn.silu(gate) * up, w2, group_sizes)

@@ -627,20 +627,23 @@ class DeepSpeedEngine:
 
         m_leaves = [writable_f32(m)
                     for m in jax.tree_util.tree_leaves(master)]
-        count = int(np.asarray(opt.count)) + 1
+        count_leaf = np.asarray(opt.count)
+        count = int(count_leaf.ravel()[0]) + 1
         # mirror the device transform's lr exactly: lr_fn(count+1) with the
         # lr_override state leaf winning (resolve_lr semantics) — get_lr()
         # keys off global_steps, which lags count by one at the boundary
-        ov = float(np.asarray(getattr(opt, "lr_override", np.nan)))
+        ov_leaf = np.asarray(getattr(opt, "lr_override", np.nan))
+        ov = float(ov_leaf.ravel()[0]) if ov_leaf.size else np.nan
         if not np.isnan(ov):
             lr = ov
         elif self._pending_client_lr is not None:
             lr = float(self._pending_client_lr)
         else:
-            sched = getattr(self, "_sched_for_lr", None) or self.lr_scheduler
-            lr = (float(np.asarray(sched.get_lr(np.int32(count))))
-                  if sched is not None and hasattr(sched, "get_lr")
-                  else None)
+            # ONLY the config-wired scheduler — the device transform's lr_fn
+            # comes from cfg.scheduler_name, never from a client scheduler
+            sched = getattr(self, "_sched_for_lr", None)
+            lr = (float(np.asarray(sched.get_lr(np.int32(count))).ravel()[0])
+                  if sched is not None else None)
         mu_leaves = [writable_f32(x).ravel()
                      for x in jax.tree_util.tree_leaves(opt.mu)]
         bf16 = self.compute_dtype == jnp.bfloat16
@@ -676,8 +679,10 @@ class DeepSpeedEngine:
         self.params = jax.tree_util.tree_map(
             lambda v, s: jax.device_put(v, s), params_tree, param_shardings)
         # moments/master were updated in place; persist + bump the count
+        # (same leaf shape it arrived with — a later device-apply fallback
+        # must see the tree layout it expects)
         new_opt = opt._replace(
-            count=np.asarray(count, np.int32),
+            count=np.full_like(count_leaf, count),
             mu=jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(opt.mu),
                 [m.reshape(o.shape) for m, o in

@@ -257,6 +257,9 @@ class InfinityEngine(DeepSpeedEngine):
             acts.append(x)
             x = self._j_block(w, x)
             self._release_block(key)
+        # backward walks blocks in reverse: start its first fetch now so the
+        # (NVMe) read overlaps the head computation
+        self._fetch_async(keys[-1])
         # fused head: loss + dL/dx_L + d(resident) in one executable — the
         # head forward never runs twice
         loss, dres, dx = self._j_head_grad(res, x, *batch)
@@ -292,6 +295,10 @@ class InfinityEngine(DeepSpeedEngine):
         dres_embed = self._j_embed_grad(res, dx, *batch)
         self._store.accumulate_grads(self._resident_key,
                                      self._acc(dres, dres_embed))
+        if not self.is_gradient_accumulation_boundary():
+            # next micro's forward starts at block 0 — warm it (a boundary
+            # step invalidates every fetch, so skip there)
+            self._fetch_async(keys[0])
         self._stashed_grads = None
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
