@@ -6,6 +6,8 @@ LM head (GPT-2 convention).  Same 'returns loss with labels' contract as
 from dataclasses import dataclass
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
@@ -118,6 +120,60 @@ class GPT2Model(nn.Module):
             m = attention_mask[:, 1:].astype(jnp.float32)
             return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
         return jnp.mean(loss)
+
+    @nn.nowrap
+    def streaming_parts(self):
+        """ZeRO-Infinity streaming protocol (see ``models/llama.py`` — same
+        shape: embed → L homogeneous blocks → head; tied wte head)."""
+        return gpt2_streaming_parts(self.config)
+
+
+def gpt2_streaming_parts(cfg):
+    from ..runtime.zero.infinity import StreamingSpec
+    from .llama import _lm_loss
+    dtype = jnp.dtype(cfg.dtype)
+    wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                   param_dtype=jnp.float32)
+    wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                   dtype=dtype, param_dtype=jnp.float32)
+    block_mod = GPT2Block(cfg)
+    lnf = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                       param_dtype=jnp.float32)
+    block_keys = tuple(f"h_{i}" for i in range(cfg.num_hidden_layers))
+    resident_keys = ("wte", "wpe", "ln_f")
+
+    def embed_apply(res, input_ids, labels=None, attention_mask=None):
+        S = input_ids.shape[1]
+        pos = jnp.arange(S)[None, :]
+        return (wte.apply({"params": res["wte"]}, input_ids) +
+                wpe.apply({"params": res["wpe"]}, pos))
+
+    def block_apply(w, x):
+        return block_mod.apply({"params": w}, x, False)
+
+    def head_apply(res, x, input_ids, labels=None, attention_mask=None):
+        x = lnf.apply({"params": res["ln_f"]}, x)
+        logits = wte.apply({"params": res["wte"]}, x.astype(jnp.float32),
+                           method=wte.attend)
+        if labels is None:
+            return logits
+        return _lm_loss(logits, labels, attention_mask)
+
+    def init_block(rng, key, x):
+        return block_mod.init(rng, x, False)["params"]
+
+    def init_resident(rng, input_ids, labels=None, attention_mask=None):
+        r_wte, r_wpe, r_ln = jax.random.split(rng, 3)
+        S = np.asarray(input_ids).shape[1]
+        x = jnp.zeros((*np.asarray(input_ids).shape, cfg.hidden_size), dtype)
+        return {"wte": wte.init(r_wte, input_ids)["params"],
+                "wpe": wpe.init(r_wpe, jnp.arange(S)[None, :])["params"],
+                "ln_f": lnf.init(r_ln, x)["params"]}
+
+    return StreamingSpec(block_keys=block_keys, resident_keys=resident_keys,
+                         embed_apply=embed_apply, block_apply=block_apply,
+                         head_apply=head_apply, init_block=init_block,
+                         init_resident=init_resident)
 
 
 def tp_rules(config: GPT2Config):

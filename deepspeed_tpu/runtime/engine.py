@@ -1435,19 +1435,45 @@ class DeepSpeedEngine:
             load_lr_scheduler_states=load_lr_scheduler_states,
             load_module_only=load_module_only)
 
+    def _export_16bit_tree(self):
+        """Source tree for :meth:`save_16bit_model` — overridden by engines
+        whose parameters do not live on device (InfinityEngine)."""
+        return self.params
+
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin",
                          exclude_frozen_parameters=False):
         """Consolidated compute-dtype export (reference engine.py:3638 +
         _zero3_consolidated_16bit_state_dict :3569 — here a device_get of the
-        global arrays *is* the consolidation)."""
+        global arrays *is* the consolidation).
+
+        Written as ``.npz``; bf16 leaves are stored as uint16 raw views with
+        their names recorded under ``__bf16__`` (numpy cannot serialize the
+        ml_dtypes dtype) — reload with
+        :func:`deepspeed_tpu.runtime.utils.load_16bit_npz`."""
+        import ml_dtypes
         import numpy as onp
         from .utils import ensure_directory_exists
-        path = os.path.join(save_dir, save_filename.replace(".bin", ".npz"))
+        name = save_filename
+        if name.endswith(".bin"):
+            name = name[:-4] + ".npz"
+        elif not name.endswith(".npz"):
+            name += ".npz"   # np.savez appends it anyway; keep path honest
+        path = os.path.join(save_dir, name)
         ensure_directory_exists(path)
         from .zero.partition import path_str
-        flat = {}
-        for kp, leaf in jax.tree_util.tree_leaves_with_path(self.params):
-            flat[path_str(kp)] = onp.asarray(leaf)
+        flat, bf16_names = {}, []
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(
+                self._export_16bit_tree()):
+            arr = onp.asarray(leaf)
+            if self.compute_dtype == jnp.bfloat16 and \
+                    arr.dtype != ml_dtypes.bfloat16:
+                arr = arr.astype(ml_dtypes.bfloat16)
+            key = path_str(kp)
+            if arr.dtype == ml_dtypes.bfloat16:
+                bf16_names.append(key)
+                arr = arr.view(onp.uint16)
+            flat[key] = arr
+        flat["__bf16__"] = onp.asarray(bf16_names)
         onp.savez(path, **flat)
         return path
 

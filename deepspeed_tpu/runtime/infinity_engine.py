@@ -352,6 +352,12 @@ class InfinityEngine(DeepSpeedEngine):
         out.update(masters)
         return out
 
+    def _export_16bit_tree(self):
+        # the inherited save_16bit_model path reads device params, which
+        # never exist here — export the host master (base casts to the
+        # compute dtype)
+        return self.get_fp32_param()
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True, exclude_frozen_parameters=False,
                         async_save=False):

@@ -123,3 +123,16 @@ def batch_input_specs(inputs, axes, n_replicated_tail=0):
         P() if i >= n - n_replicated_tail
         else P(*([axes] + [None] * (x.ndim - 1)))
         for i, x in enumerate(inputs))
+
+
+def load_16bit_npz(path):
+    """Reload a :meth:`DeepSpeedEngine.save_16bit_model` export: bf16 leaves
+    (stored as uint16 raw views, names under ``__bf16__``) come back as
+    ml_dtypes.bfloat16 arrays; everything else as saved."""
+    import ml_dtypes
+    import numpy as onp
+    data = onp.load(path)
+    bf16 = (set(str(n) for n in data["__bf16__"])
+            if "__bf16__" in data.files else set())
+    return {n: (data[n].view(ml_dtypes.bfloat16) if n in bf16 else data[n])
+            for n in data.files if n != "__bf16__"}
