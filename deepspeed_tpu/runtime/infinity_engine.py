@@ -54,10 +54,10 @@ class InfinityEngine(DeepSpeedEngine):
         if zc.zero_quantized_weights or zc.zero_quantized_gradients:
             raise ValueError("ZeRO++ quantization cannot compose with "
                              "param streaming (weights live on host)")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-host param streaming is not yet supported — each "
-                "host would stream its own dp shard")
+        # multi-process: every host holds the same store bytes (fetches
+        # assemble via make_array_from_callback; grads arrive replicated or
+        # are process-allgathered) and runs the identical host sweep —
+        # exercised by the 2-process harness (tests/unit/multiproc)
         if not hasattr(self.module, "streaming_parts"):
             raise TypeError(
                 "offload_param requires a model exposing streaming_parts() "
@@ -174,7 +174,20 @@ class InfinityEngine(DeepSpeedEngine):
                if isinstance(rng_or_seed, int) else rng_or_seed)
         spec = self._spec
         batch = tuple(np.asarray(x) for x in sample_inputs)
-        cpu = jax.devices("cpu")[0]
+        # LOCAL cpu device — jax.devices() is the global list, and another
+        # process's device is not addressable here
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            # no cpu backend registered (e.g. JAX_PLATFORMS=tpu): init lands
+            # on the accelerator, materializing each block + the resident
+            # group in HBM — loudly, since it breaks the host-init contract
+            cpu = jax.local_devices()[0]
+            log_dist(
+                "ZeRO-Infinity: no cpu backend available for host-side "
+                f"init — initializing blocks on {cpu.platform} instead "
+                "(enable the cpu platform to keep init off-device)",
+                ranks=[0])
         with jax.default_device(cpu):
             r_res, rng = jax.random.split(rng)
             res = spec.init_resident(r_res, *batch)

@@ -131,8 +131,9 @@ def load_16bit_npz(path):
     ml_dtypes.bfloat16 arrays; everything else as saved."""
     import ml_dtypes
     import numpy as onp
-    data = onp.load(path)
-    bf16 = (set(str(n) for n in data["__bf16__"])
-            if "__bf16__" in data.files else set())
-    return {n: (data[n].view(ml_dtypes.bfloat16) if n in bf16 else data[n])
-            for n in data.files if n != "__bf16__"}
+    with onp.load(path) as data:
+        bf16 = (set(str(n) for n in data["__bf16__"])
+                if "__bf16__" in data.files else set())
+        return {n: (data[n].view(ml_dtypes.bfloat16) if n in bf16
+                    else data[n])
+                for n in data.files if n != "__bf16__"}

@@ -194,25 +194,43 @@ class BlockStore:
         """Complete a fetch: host flat vector → device pytree (async put).
         ``sharding``: one jax Sharding applied to every leaf (the executor
         passes mesh-replicated so multi-device steps don't re-broadcast the
-        block on every use)."""
+        block on every use).  Multi-process meshes assemble through
+        ``make_array_from_callback`` — every host holds the same store
+        bytes, so each process serves its addressable shards locally."""
         key = handle.key
         if handle.device_tree is not None:
             return handle.device_tree
         flat = (handle.aio_handle.wait() if handle.aio_handle is not None
                 else self._cache[key])
         views = _views(flat, self._meta[key])
-        put = (jax.device_put if sharding is None
-               else (lambda v: jax.device_put(v, sharding)))
+        if sharding is None:
+            put = jax.device_put
+        elif jax.process_count() > 1:
+            put = (lambda v: jax.make_array_from_callback(
+                v.shape, sharding, lambda idx: v[idx]))
+        else:
+            put = (lambda v: jax.device_put(v, sharding))
         tree = jax.tree_util.tree_map(put, views)
         handle.device_tree = tree
         return tree
 
     # ------------------------------------------------------------ grads
     def accumulate_grads(self, key, dev_grads):
-        """Device grad pytree → host stash (one flat vector per block)."""
+        """Device grad pytree → host stash (one flat vector per block).
+        Multi-process: grads are replicated post-GSPMD-reduce, but each
+        process only addresses its shard of the replication — allgather
+        them to full host values so every host steps identically."""
+        if jax.process_count() > 1 and any(
+                not getattr(l, "is_fully_replicated", True)
+                for l in jax.tree_util.tree_leaves(dev_grads)):
+            # GSPMD normally leaves block grads fully replicated (directly
+            # addressable); anything else must gather to full host values
+            from jax.experimental import multihost_utils
+            dev_grads = multihost_utils.process_allgather(dev_grads)
         leaves = jax.tree_util.tree_leaves(dev_grads)
         for l in leaves:   # start all D2H copies before blocking on any
-            l.copy_to_host_async()
+            if hasattr(l, "copy_to_host_async"):
+                l.copy_to_host_async()
         treedef, shapes, sizes = self._meta[key]
         stash = self._grads.get(key)
         first = stash is None

@@ -1,0 +1,73 @@
+"""2-process worker: ZeRO-Infinity param streaming across processes — every
+host streams the same store, grads land identically, losses must match the
+single-process trajectory printed by the test."""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_COUNT"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cache))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:
+        pass
+
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils import groups
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, dtype="float32", remat=False,
+        tie_word_embeddings=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 3,
+                                      "offload_param": {"device": "cpu"}}})
+    assert jax.process_count() == nproc
+    dp = engine.dp_world_size            # 8 global
+    dp_rank = groups._get_data_parallel_rank()
+    rng = np.random.default_rng(0)
+    ids_full = rng.integers(0, 128, (dp, 16)).astype(np.int32)
+    engine.initialize_parameters(0, ids_full, ids_full)
+
+    local_rows = dp // nproc
+    losses = []
+    for step in range(4):
+        x = rng.integers(0, 128, (dp, 16)).astype(np.int32)
+        sl = slice(dp_rank, dp_rank + local_rows)
+        loss = engine(x[sl], x[sl])
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    if pid == 0:
+        print("INF-LOSSES " + " ".join(f"{v:.8f}" for v in losses),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
