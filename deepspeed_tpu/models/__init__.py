@@ -2,3 +2,26 @@ from . import bert, gpt2, llama
 from .bert import BertConfig, BertModel
 from .gpt2 import GPT2Config, GPT2Model
 from .llama import LlamaConfig, LlamaModel
+
+
+def from_hf_pretrained(path, dtype="bfloat16", **config_overrides):
+    """HF checkpoint directory → ``(flax model, params)`` ready for
+    ``deepspeed_tpu.initialize`` — the fine-tuning entry (reference flow:
+    hand an HF model straight to ``deepspeed.initialize``, engine.py:143).
+
+    Reuses the FastGen ingestion (17 architectures,
+    ``inference/v2/model_implementations/hf_builders.py``); the inference
+    builders default ``remat=False`` — pass training-time config overrides
+    (``remat=True``, ``use_ulysses=...``) as kwargs.
+    """
+    import dataclasses
+    from ..inference.v2.checkpoint.huggingface_engine import (
+        HuggingFaceCheckpointEngine)
+    from ..inference.v2.model_implementations.hf_builders import (
+        build_model_and_params)
+    ckpt = HuggingFaceCheckpointEngine(path)
+    model, params = build_model_and_params(ckpt, dtype=dtype)
+    if config_overrides:
+        model = type(model)(
+            dataclasses.replace(model.config, **config_overrides))
+    return model, params
