@@ -15,6 +15,8 @@ def from_hf_pretrained(path, dtype="bfloat16", **config_overrides):
     (``remat=True``, ``use_ulysses=...``) as kwargs.
     """
     import dataclasses
+    import jax
+    import numpy as np
     from ..inference.v2.checkpoint.huggingface_engine import (
         HuggingFaceCheckpointEngine)
     from ..inference.v2.model_implementations.hf_builders import (
@@ -24,4 +26,20 @@ def from_hf_pretrained(path, dtype="bfloat16", **config_overrides):
     if config_overrides:
         model = type(model)(
             dataclasses.replace(model.config, **config_overrides))
+        # structural overrides (vocab_size, hidden_size, …) would silently
+        # mismatch the already-ingested params — nn.Embed clamps
+        # out-of-range ids under jit rather than erroring — so re-derive
+        # the shape tree and fail loudly on any drift
+        ids = np.zeros((1, 8), np.int32)
+        want = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                              ids)["params"]
+        got_shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+        want_shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), want)
+        if got_shapes != want_shapes:
+            raise ValueError(
+                f"config_overrides {sorted(config_overrides)} change the "
+                "parameter structure — they no longer match the ingested "
+                "checkpoint (only non-structural fields like remat/"
+                "remat_policy/use_ulysses/max_position_embeddings/rope_* "
+                "can be overridden)")
     return model, params

@@ -666,3 +666,11 @@ def test_from_hf_pretrained_trains(tmp_path):
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_from_hf_pretrained_rejects_structural_overrides(tmp_path):
+    from deepspeed_tpu.models import from_hf_pretrained
+    import pytest as _pytest
+    _, path = _hf_llama(tmp_path)
+    with _pytest.raises(ValueError, match="parameter structure"):
+        from_hf_pretrained(path, dtype="float32", vocab_size=4096)
