@@ -94,7 +94,11 @@ def run_bench(on_tpu: bool) -> dict:
     n_layers = int(os.environ.get("BENCH_LAYERS", "8"))
     if on_tpu:
         attempts = [(4, False, "none"), (8, True, "nothing_saveable")]
-        S, steps, warmup = 2048, int(os.environ.get("BENCH_STEPS", "10")), 2
+        if os.environ.get("BENCH_BATCH"):
+            b = int(os.environ["BENCH_BATCH"])
+            attempts = [(b, False, "none")] + attempts
+        S = int(os.environ.get("BENCH_SEQ", "2048"))
+        steps, warmup = int(os.environ.get("BENCH_STEPS", "10")), 2
         peak_flops = _tpu_peak_flops()
     else:  # CPU smoke mode (sanity only)
         attempts = [(4, False, "none")]
@@ -112,15 +116,18 @@ def run_bench(on_tpu: bool) -> dict:
             else:
                 cfg = llama.llama_tiny(dtype="float32", remat=False)
             model = llama.LlamaModel(cfg)
+            bench_cfg = {
+                "train_micro_batch_size_per_gpu": B,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "fusedadam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": on_tpu},
+                "zero_optimization": {"stage": 0},
+            }
+            if os.environ.get("BENCH_GRAD_DTYPE"):  # on-chip sweep knob
+                bench_cfg["data_types"] = {
+                    "grad_accum_dtype": os.environ["BENCH_GRAD_DTYPE"]}
             engine, _, _, _ = deepspeed_tpu.initialize(
-                model=model,
-                config={
-                    "train_micro_batch_size_per_gpu": B,
-                    "gradient_accumulation_steps": 1,
-                    "optimizer": {"type": "fusedadam", "params": {"lr": 1e-4}},
-                    "bf16": {"enabled": on_tpu},
-                    "zero_optimization": {"stage": 0},
-                })
+                model=model, config=bench_cfg)
 
             rng = np.random.default_rng(0)
             ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
