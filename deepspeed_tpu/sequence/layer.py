@@ -101,7 +101,14 @@ class DistributedAttention:
 
     # ---- eager/GSPMD form: global arrays, seq dim sp-sharded ---------------
     def __call__(self, query, key, value, mesh=None, **kwargs):
-        mesh = mesh or groups.get_global_mesh()
+        if mesh is None:
+            # inside another partial-manual region (e.g. the fused pipeline's
+            # {pp,dp,ep}-manual program) the inner shard_map must target the
+            # CONTEXT abstract mesh, not the concrete global mesh — enables
+            # pp×sp (BASELINE config-5 shape)
+            cur = jax.sharding.get_abstract_mesh()
+            mesh = (cur if getattr(cur, "manual_axes", ())
+                    else groups.get_global_mesh())
         a = self.sp_axis
         if mesh.shape.get(a, 1) == 1:
             return self.local_attn(query, key, value, **kwargs)
