@@ -143,7 +143,6 @@ class DeepSpeedEngine:
         self.flops_profiler = None
         self._compiled_micro = {}
         self._compiled_apply = None
-        self._compiled_train_batch = {}
         self._compiled_eval = {}
         # compression / user hooks
         self._param_transforms = []   # differentiable params→params, in fwd
@@ -910,7 +909,6 @@ class DeepSpeedEngine:
     def invalidate_compiled(self):
         self._compiled_micro = {}
         self._compiled_apply = None
-        self._compiled_train_batch = {}
         self._compiled_eval = {}
 
     def _effective_apply_fn(self, with_pld=True):
