@@ -112,7 +112,12 @@ def run_bench(on_tpu: bool) -> dict:
                     vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                     num_hidden_layers=n_layers, num_attention_heads=16,
                     num_key_value_heads=16, max_position_embeddings=2048,
-                    dtype="bfloat16", remat=remat, remat_policy=policy)
+                    dtype="bfloat16", remat=remat, remat_policy=policy,
+                    # bf16 logits matmul: the fp32 head runs the [B*S,D]×
+                    # [D,32k] matmul at the slow MXU rate (CE upcasts to
+                    # fp32 for logsumexp regardless)
+                    head_dtype=os.environ.get("BENCH_HEAD_DTYPE",
+                                              "bfloat16"))
             else:
                 cfg = llama.llama_tiny(dtype="float32", remat=False)
             model = llama.LlamaModel(cfg)

@@ -54,6 +54,12 @@ class LlamaConfig:
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
     dtype: str = "bfloat16"
+    # lm-head / final-logits matmul dtype.  fp32 (the HF default) runs the
+    # [B*S, D]×[D, V] matmul at the MXU's fp32 rate — ~4× below bf16 peak;
+    # with V=32k that single matmul can dominate a small model's step.
+    # "bfloat16" computes logits on the fast path (CE upcasts to fp32 for
+    # the logsumexp either way).
+    head_dtype: str = "float32"
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
     use_ulysses: bool = False
@@ -307,12 +313,13 @@ class LlamaModel(nn.Module):
             x = block(cfg, name=f"layers_{i}")(x, attention_mask, decode)
 
         x = RMSNorm(cfg.rms_norm_eps, dtype, name="norm")(x)
+        hd = jnp.dtype(cfg.head_dtype)
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            logits = embed.attend(x.astype(hd))
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
-                              dtype=jnp.float32, param_dtype=jnp.float32,
-                              name="lm_head")(x.astype(jnp.float32))
+                              dtype=hd, param_dtype=jnp.float32,
+                              name="lm_head")(x.astype(hd))
         if labels is None:
             return logits
         return _lm_loss(logits, labels, attention_mask)
@@ -337,8 +344,9 @@ def llama_streaming_parts(cfg):
                          param_dtype=jnp.float32, dtype=dtype)
     block_mod = LlamaBlock(cfg)
     norm_mod = RMSNorm(cfg.rms_norm_eps, dtype)
+    hd = jnp.dtype(cfg.head_dtype)
     head_mod = (None if cfg.tie_word_embeddings else
-                nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                nn.Dense(cfg.vocab_size, use_bias=False, dtype=hd,
                          param_dtype=jnp.float32))
     block_keys = tuple(f"layers_{i}" for i in range(cfg.num_hidden_layers))
     resident_keys = ("embed_tokens", "norm") + \
@@ -358,11 +366,11 @@ def llama_streaming_parts(cfg):
         x = norm_mod.apply({"params": res["norm"]}, x)
         if cfg.tie_word_embeddings:
             logits = embed_mod.apply({"params": res["embed_tokens"]},
-                                     x.astype(jnp.float32),
+                                     x.astype(hd),
                                      method=embed_mod.attend)
         else:
             logits = head_mod.apply({"params": res["lm_head"]},
-                                    x.astype(jnp.float32))
+                                    x.astype(hd))
         if labels is None:
             return logits
         return _lm_loss(logits, labels, attention_mask)
@@ -378,7 +386,7 @@ def llama_streaming_parts(cfg):
                "norm": norm_mod.init(r_norm, x)["params"]}
         if not cfg.tie_word_embeddings:
             res["lm_head"] = head_mod.init(
-                r_head, x.astype(jnp.float32))["params"]
+                r_head, x.astype(hd))["params"]
         return res
 
     return StreamingSpec(block_keys=block_keys,
