@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-4 on-chip sweep runbook — run AFTER tools/bench_retry.sh has landed
+# the headline + ladder legs (cache warm, tunnel alive).  Each leg ~2-6 min
+# warm.  Results land in .bench_runs/sweeps/.
+set -u
+cd /root/repo
+OUT=.bench_runs/sweeps
+mkdir -p "$OUT"
+T=${SWEEP_TIMEOUT:-1800}
+
+leg() {  # name, env..., -- cmd...
+  local name="$1"; shift
+  echo "=== $name $(date) ==="
+  ( timeout "$T" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err" )
+  tail -2 "$OUT/$name.err"
+  grep -E '^\{' "$OUT/$name.out" | tail -1 | tee "$OUT/$name.json"
+}
+
+# 1) head-dtype A/B on the headline model (bf16 default vs the old fp32)
+leg head_f32 env BENCH_HEAD_DTYPE=float32 python bench.py --mode device
+leg head_bf16 env BENCH_HEAD_DTYPE=bfloat16 python bench.py --mode device
+
+# 2) batch/remat frontier
+leg b6 env BENCH_BATCH=6 python bench.py --mode device
+leg s4096 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py --mode device
+
+# 3) grad dtype
+leg gradbf16 env BENCH_GRAD_DTYPE=bf16 python bench.py --mode device
+
+# 4) serving atom A/B
+leg serve_atom0 env DS_SERVE_ATOM=0 python bench.py --mode serve
+leg serve_atom16 env DS_SERVE_ATOM=16 python bench.py --mode serve
+
+# 5) MoE grouped-GEMM kernel A/B + BERT TFLOPS row
+leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
+leg bert python bench.py --mode bert
+
+echo "=== sweeps done $(date) ==="
+grep -h . "$OUT"/*.json 2>/dev/null
