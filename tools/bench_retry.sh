@@ -18,12 +18,16 @@ SLEEP_BETWEEN=${SLEEP_BETWEEN:-240}
 record_if_full() {  # $1 = json line; writes .bench_last_device.json on a full run
   python - "$1" <<'EOF'
 import json, sys, time
+sys.path.insert(0, ".")
+import bench
 rec = json.loads(sys.argv[1])
-u = rec.get("unit", "")
-if "partial" not in u and "warmup-estimate" not in u and "backend=cpu" not in u:
+why = bench._untrustworthy(rec)
+if why is None:
     json.dump({"when": time.strftime("%Y-%m-%d"), **rec},
               open(".bench_last_device.json", "w"))
     print("RECORDED full device run:", rec["value"], rec["vs_baseline"])
+else:
+    print(f"NOT recorded ({why}):", rec["value"])
 EOF
 }
 

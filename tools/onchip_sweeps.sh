@@ -13,7 +13,12 @@ leg() {  # name, env..., -- cmd...
   echo "=== $name $(date) ==="
   ( timeout "$T" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err" )
   tail -2 "$OUT/$name.err"
-  grep -E '^\{' "$OUT/$name.out" | tail -1 | tee "$OUT/$name.json"
+  # keep only FULL measurements: a leg killed mid-run leaves a provisional
+  # [partial]/[warmup-estimate] line, and a broken timing fence leaves
+  # [timing-implausible] — comparing those across an A/B is meaningless
+  grep -E '^\{' "$OUT/$name.out" \
+    | grep -vE 'partial|warmup-estimate|timing-implausible' \
+    | tail -1 | tee "$OUT/$name.json"
 }
 
 # 1) head-dtype A/B on the headline model (bf16 default vs the old fp32)
@@ -36,4 +41,4 @@ leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
 leg bert python bench.py --mode bert
 
 echo "=== sweeps done $(date) ==="
-grep -h . "$OUT"/*.json 2>/dev/null
+grep -H . "$OUT"/*.json 2>/dev/null
