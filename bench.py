@@ -152,7 +152,11 @@ def run_bench(on_tpu: bool) -> dict:
                     # [D,32k] matmul at the slow MXU rate (CE upcasts to
                     # fp32 for logsumexp regardless)
                     head_dtype=os.environ.get("BENCH_HEAD_DTYPE",
-                                              "bfloat16"))
+                                              "bfloat16"),
+                    # fused chunked head+loss (no [B,S,V] logits); 6400
+                    # divides V=32000 and is a lane multiple
+                    loss_chunk_vocab=int(os.environ.get("BENCH_LOSS_CHUNK",
+                                                        "0")))
             else:
                 cfg = llama.llama_tiny(dtype="float32", remat=False)
             model = llama.LlamaModel(cfg)

@@ -60,6 +60,14 @@ class LlamaConfig:
     # "bfloat16" computes logits on the fast path (CE upcasts to fp32 for
     # the logsumexp either way).
     head_dtype: str = "float32"
+    # > 0 → fused chunked head+loss: the lm-head matmul and cross entropy
+    # run per vocab-chunk under an online logsumexp (sequence/cross_entropy
+    # .fused_linear_cross_entropy) so the [B, S, V] logits are never
+    # materialized in either pass.  Frees ~V·S·B·(2+4) bytes of live HBM
+    # (bf16 logits + fp32 softmax), which is what forces remat at larger
+    # batch.  Value = chunk width; MXU-friendly divisors of V (multiples of
+    # 128) avoid padding, e.g. 6400 for V=32000.
+    loss_chunk_vocab: int = 0
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # or "dots_saveable", "none"
     use_ulysses: bool = False
@@ -174,6 +182,21 @@ def _lm_loss(logits, labels, attention_mask=None):
     loss = softmax_cross_entropy_with_logits(logits[:, :-1], labels[:, 1:])
     if attention_mask is not None:
         m = attention_mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(loss)
+
+
+def _lm_loss_chunked(x, w, labels, attention_mask, chunk, head_dtype):
+    """Shifted CE via the fused chunked head+loss (no [B, S, V] logits).
+    ``x``: [B, S, D] final hidden states, ``w``: [D, V] head kernel."""
+    from ..sequence.cross_entropy import fused_linear_cross_entropy
+    b, s, d = x.shape
+    n = b * (s - 1)
+    loss = fused_linear_cross_entropy(
+        x[:, :-1].reshape(n, d), w, labels[:, 1:].reshape(n),
+        chunk, logit_dtype=head_dtype)
+    if attention_mask is not None:
+        m = attention_mask[:, 1:].astype(jnp.float32).reshape(n)
         return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
     return jnp.mean(loss)
 
@@ -314,6 +337,22 @@ class LlamaModel(nn.Module):
 
         x = RMSNorm(cfg.rms_norm_eps, dtype, name="norm")(x)
         hd = jnp.dtype(cfg.head_dtype)
+        if cfg.loss_chunk_vocab and labels is not None and not decode:
+            # fused chunked head+loss: pull the head kernel and skip the
+            # monolithic [B, S, V] logits entirely
+            if cfg.tie_word_embeddings:
+                w = embed.variables["params"]["embedding"].T
+            else:
+                head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                dtype=hd, param_dtype=jnp.float32,
+                                name="lm_head")
+                # one-row call creates/binds lm_head with the standard
+                # {kernel} layout (checkpoint/HF-ingest compatible); the
+                # unused output is dead code to XLA
+                head(x[:, :1].astype(hd))
+                w = head.variables["params"]["kernel"]
+            return _lm_loss_chunked(x, w, labels, attention_mask,
+                                    cfg.loss_chunk_vocab, hd)
         if cfg.tie_word_embeddings:
             logits = embed.attend(x.astype(hd))
         else:

@@ -32,6 +32,12 @@ leg s4096 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py --mode device
 # 3) grad dtype
 leg gradbf16 env BENCH_GRAD_DTYPE=bf16 python bench.py --mode device
 
+# 3b) fused chunked head+loss: frees the [B,S,V] logits HBM, may unlock
+# remat-free larger batch (the MFU frontier)
+leg b4_fusedce env BENCH_LOSS_CHUNK=6400 python bench.py --mode device
+leg b6_fusedce env BENCH_BATCH=6 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
+leg b8_fusedce env BENCH_BATCH=8 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
+
 # 4) serving atom A/B
 leg serve_atom0 env DS_SERVE_ATOM=0 python bench.py --mode serve
 leg serve_atom16 env DS_SERVE_ATOM=16 python bench.py --mode serve
