@@ -290,7 +290,10 @@ def run_gpt2_bench(on_tpu: bool) -> dict:
     from deepspeed_tpu.models import gpt2
 
     if on_tpu:
-        cfg = gpt2.gpt2_350m(dtype="float16", remat=True)
+        cfg = gpt2.gpt2_350m(
+            dtype="float16",
+            remat=os.environ.get("BENCH_GPT2_REMAT", "1") != "0",
+            loss_chunk_vocab=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
         B, S, steps, warmup = 8, 1024, 10, 2
         peak_flops = _tpu_peak_flops()
     else:

@@ -23,6 +23,10 @@ class GPT2Config:
     max_position_embeddings: int = 1024
     layer_norm_epsilon: float = 1e-5
     dtype: str = "bfloat16"
+    # > 0 → fused chunked head+loss (see models/llama.py loss_chunk_vocab):
+    # the tied-head logits [B, S, V] never materialize; with V=50257 the
+    # fp32 logits+softmax are the largest activations in the model
+    loss_chunk_vocab: int = 0
     remat: bool = True
     use_ulysses: bool = False
 
@@ -111,6 +115,12 @@ class GPT2Model(nn.Module):
             x = block(cfg, name=f"h_{i}")(x, decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
+        if cfg.loss_chunk_vocab and labels is not None and not decode:
+            from .llama import _lm_loss_chunked
+            w = wte.variables["params"]["embedding"].T  # tied head [D, V]
+            return _lm_loss_chunked(x.astype(jnp.float32), w, labels,
+                                    attention_mask, cfg.loss_chunk_vocab,
+                                    jnp.float32)
         logits = wte.attend(x.astype(jnp.float32))
         if labels is None:
             return logits

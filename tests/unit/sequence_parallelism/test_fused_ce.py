@@ -124,3 +124,18 @@ def test_llama_chunked_loss_tied_embeddings():
     ld = m_d.apply({"params": params}, ids, ids)
     lc = m_c.apply({"params": params}, ids, ids)
     np.testing.assert_allclose(lc, ld, rtol=1e-5, atol=1e-5)
+
+
+def test_gpt2_chunked_loss_parity():
+    from deepspeed_tpu.models import gpt2
+
+    base = gpt2.gpt2_tiny(dtype="float32", remat=False)
+    cfg_c = gpt2.GPT2Config(**{**base.__dict__, "loss_chunk_vocab": 64})
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, base.vocab_size, size=(2, 16)).astype(np.int32)
+    m_d = gpt2.GPT2Model(base)
+    m_c = gpt2.GPT2Model(cfg_c)
+    params = m_d.init(jax.random.PRNGKey(0), ids, ids)["params"]
+    ld = m_d.apply({"params": params}, ids, ids)
+    lc = m_c.apply({"params": params}, ids, ids)
+    np.testing.assert_allclose(lc, ld, rtol=1e-5, atol=1e-5)
