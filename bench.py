@@ -902,7 +902,7 @@ def main():
     runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             ".bench_runs")
     ladder_bits = []
-    for mode in ("gpt2", "offload", "fpdt", "serve"):
+    for mode in ("gpt2", "offload", "fpdt", "serve", "bert", "hostopt"):
         try:
             with open(os.path.join(runs_dir, f"{mode}.json")) as f:
                 rec = json.load(f)

@@ -52,7 +52,7 @@ done
 
 if [ -n "$main_done" ]; then
   # cache is warm + tunnel is alive: grab the ladder legs back-to-back
-  for mode in gpt2 offload fpdt serve hostopt; do
+  for mode in gpt2 offload fpdt serve hostopt bert; do
     echo "=== ladder $mode $(date) ==="
     timeout "$ATTEMPT_TIMEOUT" python bench.py --mode "$mode" \
       > "$OUT/${mode}.out" 2> "$OUT/${mode}.err"
