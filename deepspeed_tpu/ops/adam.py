@@ -124,6 +124,15 @@ def cpu_adam(*args, **kwargs):
     return fused_adam(*args, **kwargs)
 
 
+# Reference import-surface aliases (``deepspeed/ops/adam/fused_adam.py:18``,
+# ``cpu_adam.py``): migrating code does ``from deepspeed.ops.adam import
+# FusedAdam`` — here these are the gradient-transformation constructors,
+# which ``initialize(optimizer=...)`` accepts directly.
+FusedAdam = fused_adam
+FusedAdamW = fused_adamw
+DeepSpeedCPUAdam = cpu_adam
+
+
 @register_op_builder
 class FusedAdamBuilder(PallasOpBuilder):
     NAME = "fused_adam"

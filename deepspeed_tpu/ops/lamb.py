@@ -76,3 +76,7 @@ def fused_lamb(lr=1e-3,
 class FusedLambBuilder(PallasOpBuilder):
     NAME = "fused_lamb"
     MODULE = "deepspeed_tpu.ops.lamb"
+
+
+# Reference import-surface alias (``deepspeed/ops/lamb``).
+FusedLamb = fused_lamb

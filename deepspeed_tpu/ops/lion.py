@@ -105,3 +105,8 @@ class FusedLionBuilder(PallasOpBuilder):
 class CPULionBuilder(PallasOpBuilder):
     NAME = "cpu_lion"
     MODULE = "deepspeed_tpu.ops.lion"
+
+
+# Reference import-surface aliases (``deepspeed/ops/lion``).
+FusedLion = fused_lion
+DeepSpeedCPULion = fused_lion

@@ -171,3 +171,17 @@ def test_optimizer_swapper_tree(tmp_path):
     assert set(back) == {"mu", "nu"}
     np.testing.assert_array_equal(back["nu"]["w"], 2.0 * np.ones((8, 8)))
     sw.cleanup()
+
+
+def test_reference_optimizer_class_aliases():
+    """Migrating code imports the reference class names
+    (deepspeed/ops/adam/fused_adam.py:18 etc.); here they alias the
+    gradient-transformation constructors initialize() accepts."""
+    from deepspeed_tpu.ops.adam import (FusedAdam, FusedAdamW,
+                                        DeepSpeedCPUAdam)
+    from deepspeed_tpu.ops.lamb import FusedLamb
+    from deepspeed_tpu.ops.lion import FusedLion, DeepSpeedCPULion
+    for ctor in (FusedAdam, FusedAdamW, DeepSpeedCPUAdam, FusedLamb,
+                 FusedLion, DeepSpeedCPULion):
+        t = ctor(lr=1e-3)
+        assert callable(t.init) and callable(t.update)
