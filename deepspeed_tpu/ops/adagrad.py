@@ -1,0 +1,59 @@
+"""Adagrad — TPU rebuild of the reference ``deepspeed/ops/adagrad/cpu_adagrad
+.py`` (``DeepSpeedCPUAdagrad``, native kernel ``csrc/adagrad/cpu_adagrad.cpp``).
+
+Same math as the native host kernel in ``csrc/optimizers/cpu_optimizers.cpp``
+(``ds_cpu_adagrad_step``): ``g += wd·p; s += g²; p -= lr·g/(√s + eps)`` —
+so the host-offload step (`engine._try_host_offload_step`) and this fused
+device transformation produce bit-comparable trajectories.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .adam import (GradientTransformation, no_lr_override, resolve_lr)
+
+
+class ScaleByAdagradState(NamedTuple):
+    count: jnp.ndarray  # int32 scalar
+    sum: any            # per-param squared-grad accumulator
+    lr_override: any = None
+
+
+def fused_adagrad(lr=1e-2, eps=1e-10, weight_decay=0.0, lr_fn=None):
+    """Fused Adagrad update (reference ``DeepSpeedCPUAdagrad`` semantics)."""
+
+    def init(params):
+        return ScaleByAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, jnp.float32), params),
+            lr_override=no_lr_override())
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cur_lr = resolve_lr(lr_fn(count) if lr_fn is not None else lr, state)
+
+        def upd(g, p, s):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p.astype(jnp.float32)
+            s_new = s + g * g
+            return -cur_lr * g / (jnp.sqrt(s_new) + eps), s_new
+
+        flat = jax.tree_util.tree_map(upd, grads, params, state.sum)
+        updates = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                         is_leaf=lambda t: isinstance(t, tuple))
+        new_sum = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                         is_leaf=lambda t: isinstance(t, tuple))
+        return updates, ScaleByAdagradState(count=count, sum=new_sum,
+                                            lr_override=state.lr_override)
+
+    return GradientTransformation(init=init, update=update)
+
+
+# Reference import-surface alias (``deepspeed/ops/adagrad``).  The
+# "cpu_adagrad" op builder is registered by ops/cpu_optimizers.py (the
+# native kernel this transformation mirrors).
+DeepSpeedCPUAdagrad = fused_adagrad

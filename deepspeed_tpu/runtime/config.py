@@ -32,6 +32,7 @@ ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
 ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
 SGD_OPTIMIZER = "sgd"
 MUON_OPTIMIZER = "muon"
+ADAGRAD_OPTIMIZER = "adagrad"
 
 DEEPSPEED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, LAMB_OPTIMIZER,

@@ -38,9 +38,10 @@ from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, NoopTimer,
                            SynchronizedWallClockTimer, ThroughputTimer)
-from .config import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, DeepSpeedConfig,
-                     FUSED_ADAM_OPTIMIZER, FUSED_LAMB_OPTIMIZER,
-                     LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER)
+from .config import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER,
+                     DeepSpeedConfig, FUSED_ADAM_OPTIMIZER,
+                     FUSED_LAMB_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER,
+                     SGD_OPTIMIZER)
 from .dataloader import DeepSpeedDataLoader
 from .loss_scaler import create_loss_scaler, has_overflow
 from .lr_schedules import get_lr_scheduler
@@ -490,6 +491,14 @@ class DeepSpeedEngine:
                 self._grad_transform = sgd(
                     lr=lr, momentum=p.pop("momentum", 0.0),
                     weight_decay=p.pop("weight_decay", 0.0), lr_fn=lr_fn)
+            elif name == ADAGRAD_OPTIMIZER:
+                from ..ops.adagrad import fused_adagrad
+                eps = p.pop("eps", 1e-10)
+                wd = p.pop("weight_decay", 0.0)
+                self._grad_transform = fused_adagrad(
+                    lr=lr, eps=eps, weight_decay=wd, lr_fn=lr_fn)
+                self._host_opt_desc = ("adagrad", dict(
+                    lr=lr, eps=eps, weight_decay=wd))
             elif name == MUON_OPTIMIZER:
                 self._grad_transform = muon(
                     lr=lr, momentum=p.pop("momentum", 0.95),
@@ -499,7 +508,7 @@ class DeepSpeedEngine:
             else:
                 raise ValueError(f"unsupported optimizer {name!r} (have: adam, "
                                  "adamw, fusedadam, lamb, fusedlamb, lion, "
-                                 "sgd, muon)")
+                                 "sgd, muon, adagrad)")
         else:
             self._grad_transform = fused_adam(lr=1e-3, lr_fn=lr_fn)
 
@@ -643,8 +652,11 @@ class DeepSpeedEngine:
             sched = getattr(self, "_sched_for_lr", None)
             lr = (float(np.asarray(sched.get_lr(np.int32(count))).ravel()[0])
                   if sched is not None else None)
+        # first moment / accumulator tree: adam+lion call it mu, adagrad sum
+        mu_attr = "mu" if hasattr(opt, "mu") else "sum"
+        mu_tree = getattr(opt, mu_attr)
         mu_leaves = [writable_f32(x).ravel()
-                     for x in jax.tree_util.tree_leaves(opt.mu)]
+                     for x in jax.tree_util.tree_leaves(mu_tree)]
         bf16 = self.compute_dtype == jnp.bfloat16
         import ml_dtypes
         new_params = []
@@ -659,6 +671,15 @@ class DeepSpeedEngine:
                 out = np.empty(m.size, np.uint16) if bf16 else None
                 kern.step_count = count - 1
                 kern.step(m.ravel(), g, mu, nu, bf16_out=out, lr=lr)
+                new_params.append(
+                    out.view(ml_dtypes.bfloat16).reshape(m.shape)
+                    if bf16 else m)
+        elif name == "adagrad":
+            kern = K.DeepSpeedCPUAdagrad(lr=p["lr"], eps=p["eps"],
+                                         weight_decay=p["weight_decay"])
+            for m, g, s in zip(m_leaves, g_leaves, mu_leaves):
+                out = np.empty(m.size, np.uint16) if bf16 else None
+                kern.step(m.ravel(), g, s, bf16_out=out, lr=lr)
                 new_params.append(
                     out.view(ml_dtypes.bfloat16).reshape(m.shape)
                     if bf16 else m)
@@ -682,10 +703,10 @@ class DeepSpeedEngine:
         # must see the tree layout it expects)
         new_opt = opt._replace(
             count=np.full_like(count_leaf, count),
-            mu=jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(opt.mu),
+            **{mu_attr: jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(mu_tree),
                 [m.reshape(o.shape) for m, o in
-                 zip(mu_leaves, jax.tree_util.tree_leaves(opt.mu))]))
+                 zip(mu_leaves, jax.tree_util.tree_leaves(mu_tree))])})
         if name == "adam":
             new_opt = new_opt._replace(nu=jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(opt.nu),
