@@ -669,3 +669,38 @@ def test_overflow_skip_lazy_accounting():
     engine.backward(loss)
     engine.step()
     assert engine.cur_scale <= before           # dynamic scaler backed off
+
+
+def test_load_checkpoint_module_only_and_no_optimizer_states(tmp_path):
+    """Reference load_checkpoint flags (engine.py:2794): load_module_only
+    restores weights but leaves optimizer state/step count fresh;
+    load_optimizer_states=False same for a full topology load."""
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(stage=2))
+    data = batches(random_dataset(32, HIDDEN), 4 * engine.dp_world_size)
+    _train(engine, data, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    saved_w = engine.get_fp32_param()
+    saved_count = int(np.asarray(engine.opt_state.count).ravel()[0])
+    assert saved_count == 3
+
+    _train(engine, data, steps=2)  # diverge weights AND optimizer state
+    path, _ = engine.load_checkpoint(str(tmp_path), tag="t",
+                                     load_module_only=True)
+    assert path is not None
+    restored_w = engine.get_fp32_param()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        saved_w, restored_w)
+    # optimizer state NOT loaded: count keeps the diverged value (5), not 3
+    assert int(np.asarray(engine.opt_state.count).ravel()[0]) == 5
+
+    path, _ = engine.load_checkpoint(str(tmp_path), tag="t",
+                                     load_optimizer_states=False)
+    assert path is not None
+    assert int(np.asarray(engine.opt_state.count).ravel()[0]) == 5
+    # and training continues fine from module-only state
+    losses = _train(engine, data, steps=2)
+    assert np.isfinite(losses[-1])
