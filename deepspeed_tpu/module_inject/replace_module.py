@@ -1,0 +1,6 @@
+"""Back-compat import path (reference ``deepspeed/module_inject/
+replace_module.py:183``) — kernel-injection entry points live in the
+package root modules (containers.py / diffusers_injection.py)."""
+
+from . import replace_transformer_layer  # noqa: F401
+from .diffusers_injection import generic_injection  # noqa: F401

@@ -1,0 +1,6 @@
+"""Back-compat package path (reference ``deepspeed/runtime/data_pipeline/
+data_sampling/``) — implementations live one level up (flat layout)."""
+
+from ..data_analyzer import DataAnalyzer  # noqa: F401
+from ..data_sampler import (DeepSpeedDataSampler,  # noqa: F401
+                            DistributedSampler)

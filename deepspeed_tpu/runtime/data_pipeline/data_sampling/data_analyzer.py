@@ -1,0 +1,5 @@
+"""Back-compat import path (reference ``deepspeed/runtime/data_pipeline/
+data_sampling/data_analyzer.py:22``)."""
+
+from ..data_analyzer import *  # noqa: F401,F403
+from ..data_analyzer import DataAnalyzer  # noqa: F401

@@ -1,0 +1,5 @@
+"""Back-compat import path (reference ``deepspeed/runtime/data_pipeline/
+data_sampling/data_sampler.py:36``)."""
+
+from ..data_sampler import (DeepSpeedDataSampler,  # noqa: F401
+                            DistributedSampler)

@@ -1,0 +1,10 @@
+"""Back-compat import path (reference ``deepspeed/runtime/fp16/loss_scaler
+.py:270``) — the implementation lives in ``deepspeed_tpu/runtime/loss_scaler
+.py`` (loss scaling is precision-neutral state on this engine, not an
+fp16-only wrapper)."""
+
+from ..loss_scaler import (DynamicLossScaler, StaticLossScaler,  # noqa: F401
+                           create_loss_scaler, has_overflow)
+
+# reference class name for the static variant
+LossScaler = StaticLossScaler

@@ -1,0 +1,8 @@
+"""Back-compat import path (reference ships the recovery script as
+``deepspeed/utils/zero_to_fp32.py``) — implementation lives in
+``deepspeed_tpu/checkpoint/zero_to_fp32.py`` (it is also copied into every
+checkpoint dir by the save path, reference engine.py:3540)."""
+
+from ..checkpoint.zero_to_fp32 import (  # noqa: F401
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint, main)

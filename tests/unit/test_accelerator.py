@@ -47,3 +47,19 @@ def test_visible_devices_envs():
     acc.set_visible_devices_envs(env, [0, 1])
     assert env.get("TPU_VISIBLE_CHIPS") == "0,1"
     set_accelerator_name("cpu")
+
+
+def test_reference_backcompat_import_paths():
+    """Reference-layout import paths resolve (migrating user code does
+    ``from deepspeed.runtime.fp16.loss_scaler import DynamicLossScaler``
+    etc.); implementations live at the flat TPU-native locations."""
+    from deepspeed_tpu.runtime.fp16.loss_scaler import (  # noqa: F401
+        DynamicLossScaler, LossScaler)
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer \
+        import DataAnalyzer  # noqa: F401
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler \
+        import DeepSpeedDataSampler  # noqa: F401
+    from deepspeed_tpu.utils.zero_to_fp32 import (  # noqa: F401
+        get_fp32_state_dict_from_zero_checkpoint)
+    from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
+        generic_injection, replace_transformer_layer)
