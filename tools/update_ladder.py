@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Fold measured on-chip ladder legs (.bench_runs/<mode>.json, written by
+tools/bench_retry.sh) into README.md's BASELINE-ladder table "on-chip"
+column.  Refuses provisional/implausible records via bench._untrustworthy.
+
+Usage: python tools/update_ladder.py [--dry-run]
+"""
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402  (no jax at module level)
+
+MODES = ("bert", "gpt2", "hostopt", "offload", "fpdt", "serve")
+
+
+def main():
+    dry = "--dry-run" in sys.argv
+    readme = os.path.join(ROOT, "README.md")
+    runs = os.path.join(ROOT, ".bench_runs")
+    src = open(readme).read()
+    changed = []
+    for mode in MODES:
+        path = os.path.join(runs, f"{mode}.json")
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "backend=tpu" not in rec.get("unit", ""):
+            continue
+        why = bench._untrustworthy(rec)
+        if why is not None:
+            print(f"{mode}: skipped ({why})")
+            continue
+        cell = f"**{rec['value']}** {rec['unit']}"
+        # row format: | `mode` | ... | ... | <on-chip cell> |
+        pat = re.compile(r"^(\| `" + mode + r"` \|.*\|.*\| )([^|]*)(\|)$",
+                         re.M)
+        m = pat.search(src)
+        if not m:
+            print(f"{mode}: README row not found")
+            continue
+        src = src[:m.start(2)] + cell + " " + src[m.end(2):]
+        changed.append(mode)
+    if changed and not dry:
+        open(readme, "w").write(src)
+    print("updated:" if not dry else "would update:", changed or "nothing")
+
+
+if __name__ == "__main__":
+    main()
