@@ -191,16 +191,20 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
     engine.params = _pytree_restore(
         os.path.join(root, "model"), template=engine.params,
         shardings=engine.plan.param_shardings(engine.params))
-    if load_module_only and engine.master is not None:
+    if load_module_only:
         # reference engine.py load_module_only path ends with
         # ``optimizer.refresh_fp32_params()``: the fp32 master must re-derive
         # from the just-loaded module weights — otherwise the next boundary
         # apply recasts params from the STALE master and silently reverts
-        # the load
-        import jax.numpy as jnp
-        engine.master = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(p.astype(jnp.float32), s),
-            engine.params, engine.plan.master_shardings(engine.master))
+        # the load.  NVMe-resident master first swaps back in (it would be
+        # swapped in stale by the next step otherwise).
+        if getattr(engine, "_state_on_nvme", False):
+            engine._ensure_state_resident()
+        if engine.master is not None:
+            import jax.numpy as jnp
+            engine.master = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p.astype(jnp.float32), s),
+                engine.params, engine.plan.master_shardings(engine.master))
     if not load_module_only:
         if engine.master is not None and os.path.isdir(os.path.join(root, "master")):
             engine.master = _pytree_restore(

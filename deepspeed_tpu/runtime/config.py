@@ -38,7 +38,7 @@ DEEPSPEED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, LAMB_OPTIMIZER,
     FUSED_LAMB_OPTIMIZER, LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
     ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER,
-    MUON_OPTIMIZER,
+    MUON_OPTIMIZER, ADAGRAD_OPTIMIZER,
 ]
 
 

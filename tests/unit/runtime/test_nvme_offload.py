@@ -268,3 +268,31 @@ def test_adagrad_host_step_matches_device_apply(tmp_path, monkeypatch):
     dev, n0 = run()
     assert n0 == 0
     np.testing.assert_allclose(host, dev, rtol=1e-4)
+
+
+def test_load_module_only_refreshes_nvme_resident_master(tmp_path):
+    """load_module_only with the master swapped out to NVMe: the stale
+    swapped master must not revert the loaded weights at the next step
+    (reference refresh_fp32_params role, NVMe-resident variant)."""
+    engine, W = _make(tmp_path / "run", nvme=True)
+    _train(engine, W, steps=2)
+    engine.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    saved = jax.device_get(engine.params)
+    _train(engine, W, steps=2)  # diverge; state swapped out again
+    assert engine._state_on_nvme
+    engine.load_checkpoint(str(tmp_path / "ck"), tag="t",
+                           load_module_only=True)
+    after = jax.device_get(engine.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        after, saved)
+    # one more training step: weights must move FROM the loaded point, not
+    # revert to the diverged master
+    losses = _train(engine, W, steps=1)
+    stepped = jax.device_get(engine.params)
+    diffs = [float(np.abs(a - b).max())
+             for a, b in zip(jax.tree_util.tree_leaves(stepped),
+                             jax.tree_util.tree_leaves(after))]
+    assert max(diffs) < 5e-2, "params jumped — stale master reverted the load"
+    assert np.isfinite(losses[-1])
+    _teardown()

@@ -13,12 +13,16 @@ leg() {  # name, env..., -- cmd...
   echo "=== $name $(date) ==="
   ( timeout "$T" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err" )
   tail -2 "$OUT/$name.err"
-  # keep only FULL measurements: a leg killed mid-run leaves a provisional
-  # [partial]/[warmup-estimate] line, and a broken timing fence leaves
-  # [timing-implausible] — comparing those across an A/B is meaningless
-  grep -E '^\{' "$OUT/$name.out" \
-    | grep -vE 'partial|warmup-estimate|timing-implausible' \
-    | tail -1 | tee "$OUT/$name.json"
+  # keep only FULL measurements (bench._untrustworthy is the single source
+  # of truth: partial / warmup-estimate / timing-implausible / cpu-fallback
+  # records must not enter an A/B comparison)
+  grep -E '^\{' "$OUT/$name.out" | python -c '
+import json, sys
+sys.path.insert(0, ".")
+import bench
+keep = [l for l in sys.stdin
+        if bench._untrustworthy(json.loads(l)) is None]
+sys.stdout.write(keep[-1] if keep else "")' | tee "$OUT/$name.json"
 }
 
 # 1) head-dtype A/B on the headline model (bf16 default vs the old fp32)
