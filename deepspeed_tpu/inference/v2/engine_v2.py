@@ -246,7 +246,29 @@ class InferenceEngineV2:
             last_idx[seq.slot] = idx
         return toks, pos, slots, last_idx, finishing, layout
 
-    def schedule_step(self, do_sample=False, temperature=1.0, rng=None):
+    @staticmethod
+    def _sample_row(row, temperature, top_k, top_p, rng):
+        """Host-side categorical sampling with the reference generate
+        options (temperature / top-k / nucleus top-p)."""
+        logits = row.astype(np.float64) / max(temperature, 1e-6)
+        if top_k:
+            kth = np.partition(logits, -int(top_k))[-int(top_k)]
+            logits = np.where(logits < kth, -np.inf, logits)
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        if top_p and top_p < 1.0:
+            order = np.argsort(-p)
+            csum = np.cumsum(p[order])
+            # smallest prefix whose mass reaches top_p (always ≥ 1 token)
+            keep = csum - p[order] < top_p
+            mask = np.zeros_like(p, dtype=bool)
+            mask[order[keep]] = True
+            p = np.where(mask, p, 0.0)
+            p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    def schedule_step(self, do_sample=False, temperature=1.0, rng=None,
+                      top_k=0, top_p=1.0):
         """One ragged iteration.  Returns {uid: sampled_next_token} for every
         sequence whose pending tokens were fully consumed this step.
 
@@ -280,8 +302,8 @@ class InferenceEngineV2:
             for seq, _ in finishing:
                 row = lg[seq.slot]
                 if do_sample:
-                    p = np.exp((row - row.max()) / max(temperature, 1e-6))
-                    token = int(self._rng.choice(len(row), p=p / p.sum()))
+                    token = self._sample_row(row, temperature, top_k, top_p,
+                                             self._rng)
                 else:
                     token = int(np.argmax(row))
                 out[seq.uid] = token
@@ -289,7 +311,8 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------- generate
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
-                 do_sample=False, temperature=1.0):
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 rng=None):
         """Convenience continuous-batching loop: all prompts in flight at
         once, chunked prefill + interleaved decode."""
         uids = list(range(len(prompts)))
@@ -298,7 +321,9 @@ class InferenceEngineV2:
         active = set(uids)
         while active:
             next_tokens = self.schedule_step(do_sample=do_sample,
-                                             temperature=temperature)
+                                             temperature=temperature,
+                                             top_k=top_k, top_p=top_p,
+                                             rng=rng)
             if not next_tokens:
                 # a chunked prefill step consumes budget without finishing
                 # any sequence — keep going while work remains

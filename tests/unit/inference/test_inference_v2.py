@@ -223,3 +223,34 @@ def test_v2_tp_mixtral_ep_rules_restricted():
         outs[tp] = eng.generate(prompts, max_new_tokens=4)
         eng.flush(range(1))
     assert outs[1] == outs[2]
+
+
+def test_sample_row_topk_topp():
+    """Sampling options on the v2 host sampler: top_k=1 == greedy; top_k
+    restricts support; top_p keeps the smallest nucleus (≥ 1 token)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    rng = np.random.default_rng(0)
+    row = np.array([4.0, 3.0, 1.0, 0.5, -2.0], np.float32)
+
+    # top_k=1 is argmax regardless of rng
+    for _ in range(5):
+        assert InferenceEngineV2._sample_row(row, 1.0, 1, 1.0, rng) == 0
+
+    # top_k=2: support is exactly {0, 1}
+    seen = {InferenceEngineV2._sample_row(row, 1.0, 2, 1.0, rng)
+            for _ in range(200)}
+    assert seen <= {0, 1} and len(seen) == 2
+
+    # top_p tiny: only the max survives (nucleus always keeps >= 1 token)
+    for _ in range(5):
+        assert InferenceEngineV2._sample_row(row, 1.0, 0, 1e-9, rng) == 0
+
+    # top_p=0.75 with p(max) ~= 0.72: nucleus is {0, 1}
+    seen = {InferenceEngineV2._sample_row(row, 1.0, 0, 0.75, rng)
+            for _ in range(200)}
+    assert seen == {0, 1}
+
+    # plain sampling at high temperature reaches beyond the top-2
+    seen = {InferenceEngineV2._sample_row(row, 10.0, 0, 1.0, rng)
+            for _ in range(300)}
+    assert len(seen) >= 4
