@@ -49,14 +49,38 @@ def debug_report():
     for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
         v = _version(mod)
         rows.append((f"{mod} version", v if v else "not installed"))
-    try:
-        import jax
-        rows.append(("jax backend", jax.default_backend()))
-        rows.append(("device count", jax.device_count()))
-        rows.append(("devices", ", ".join(
-            str(d) for d in jax.devices()[:8])))
-    except Exception as e:  # no backend available
-        rows.append(("jax backend", f"unavailable ({e})"))
+    # Backend acquisition can BLOCK indefinitely (remote-TPU tunnels): a
+    # report tool must never hang, so probe in a bounded worker thread.
+    # DS_REPORT_DEVICE_TIMEOUT_S=0 skips the probe entirely.
+    timeout_s = float(os.environ.get("DS_REPORT_DEVICE_TIMEOUT_S", "20"))
+    probe = {}
+
+    def _probe():
+        try:
+            import jax
+            probe["backend"] = jax.default_backend()
+            probe["count"] = jax.device_count()
+            probe["devices"] = ", ".join(str(d) for d in jax.devices()[:8])
+        except Exception as e:  # no backend available
+            probe["error"] = str(e)
+
+    if timeout_s > 0:
+        import threading
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            rows.append(("jax backend",
+                         f"acquisition timed out after {timeout_s:.0f}s "
+                         "(remote tunnel down?)"))
+        elif "error" in probe:
+            rows.append(("jax backend", f"unavailable ({probe['error']})"))
+        else:
+            rows.append(("jax backend", probe["backend"]))
+            rows.append(("device count", probe["count"]))
+            rows.append(("devices", probe["devices"]))
+    else:
+        rows.append(("jax backend", "probe skipped"))
     rows.append(("DS_ACCELERATOR", os.environ.get("DS_ACCELERATOR", "auto")))
     return rows
 
