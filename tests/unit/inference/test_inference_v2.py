@@ -254,3 +254,15 @@ def test_sample_row_topk_topp():
     seen = {InferenceEngineV2._sample_row(row, 10.0, 0, 1.0, rng)
             for _ in range(300)}
     assert len(seen) >= 4
+
+
+def test_generate_with_sampling_options_runs():
+    """e2e guard for the generate(do_sample, top_k, top_p, rng) surface."""
+    model, cfg, params = _model()
+    eng = _v2(model, params)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=10).tolist()
+               for _ in range(2)]
+    out = eng.generate(prompts, max_new_tokens=4, do_sample=True,
+                       temperature=0.8, top_k=8, top_p=0.9, rng=0)
+    assert all(len(o) == 4 for o in out)
