@@ -192,6 +192,22 @@ class MixtralModel(nn.Module):
             x = block(cfg, name=f"layers_{i}")(x, attention_mask, decode)
 
         x = RMSNorm(cfg.rms_norm_eps, dtype, name="norm")(x)
+        if cfg.loss_chunk_vocab and labels is not None and not decode:
+            # fused chunked head+loss (models/llama.py loss_chunk_vocab):
+            # no [B, S, V] logits in either pass
+            from .llama import _lm_loss_chunked
+            if cfg.tie_word_embeddings:
+                w = embed.variables["params"]["embedding"].T
+            else:
+                head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                dtype=jnp.float32, param_dtype=jnp.float32,
+                                name="lm_head")
+                head(x[:, :1].astype(jnp.float32))  # bind; dead code to XLA
+                w = head.variables["params"]["kernel"]
+            loss = _lm_loss_chunked(x.astype(jnp.float32), w, labels,
+                                    attention_mask, cfg.loss_chunk_vocab,
+                                    jnp.float32)
+            return loss
         if cfg.tie_word_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:

@@ -139,3 +139,18 @@ def test_gpt2_chunked_loss_parity():
     ld = m_d.apply({"params": params}, ids, ids)
     lc = m_c.apply({"params": params}, ids, ids)
     np.testing.assert_allclose(lc, ld, rtol=1e-5, atol=1e-5)
+
+
+def test_mixtral_chunked_loss_parity():
+    from deepspeed_tpu.models import mixtral
+
+    base = mixtral.mixtral_tiny(dtype="float32", remat=False)
+    cfg_c = mixtral.MixtralConfig(**{**base.__dict__, "loss_chunk_vocab": 32})
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, base.vocab_size, size=(2, 16)).astype(np.int32)
+    m_d = mixtral.MixtralModel(base)
+    m_c = mixtral.MixtralModel(cfg_c)
+    params = m_d.init(jax.random.PRNGKey(0), ids, ids)["params"]
+    ld = m_d.apply({"params": params}, ids, ids)
+    lc = m_c.apply({"params": params}, ids, ids)
+    np.testing.assert_allclose(lc, ld, rtol=1e-5, atol=1e-5)
