@@ -42,6 +42,10 @@ leg b4_fusedce env BENCH_LOSS_CHUNK=6400 python bench.py --mode device
 leg b6_fusedce env BENCH_BATCH=6 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
 leg b8_fusedce env BENCH_BATCH=8 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
 
+# 3c) gpt2 ladder leg: remat-off + chunked CE (the [B,S,50k] fp32 logits
+# are what force remat=True in the default leg)
+leg gpt2_chunk env BENCH_GPT2_REMAT=0 BENCH_LOSS_CHUNK=6400 python bench.py --mode gpt2
+
 # 4) serving atom A/B
 leg serve_atom0 env DS_SERVE_ATOM=0 python bench.py --mode serve
 leg serve_atom16 env DS_SERVE_ATOM=16 python bench.py --mode serve
