@@ -154,3 +154,37 @@ def test_mixtral_chunked_loss_parity():
     ld = m_d.apply({"params": params}, ids, ids)
     lc = m_c.apply({"params": params}, ids, ids)
     np.testing.assert_allclose(lc, ld, rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_loss_composes_with_zero3_tp():
+    """loss_chunk_vocab under ZeRO-3 × tp2 on the 8-device mesh — the
+    scanned head must shard (AutoTP dataflow rules derive through the
+    scan) and train without involuntary gathers blowing up."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
+
+    cfg = llama.llama_tiny(dtype="bfloat16", remat=False,
+                           loss_chunk_vocab=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "fusedadam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "mesh": {"dp": 4, "sp": 1, "tp": 2}})
+    rows = 2 * engine.dp_world_size
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(rows, 32)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    losses = []
+    for _ in range(3):
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    groups.reset_mesh()
+    dist.destroy_process_group()
