@@ -104,9 +104,16 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
 
 
 def topkgating(logits, k, capacity_factor=1.0, min_capacity=4, drop_tokens=True):
-    """Reference ``topkgating`` (sharded_moe.py:374) — general k."""
+    """Reference ``topkgating`` (sharded_moe.py:374) — general k.
+
+    ``drop_tokens=False``: capacity becomes the static worst case (T slots
+    per expert) so every token keeps its slot — positions past a smaller C
+    would silently fall out of the one-hot below, dropping tokens the mode
+    promises to keep (the reference instead pads C to the dynamic max,
+    which XLA's static shapes cannot express)."""
     T, E = logits.shape
-    C = _capacity(T, E, capacity_factor * k, min_capacity)
+    C = _capacity(T, E, capacity_factor * k, min_capacity) if drop_tokens \
+        else T
     gates = jax.nn.softmax(logits, axis=-1)
     topk_gates, topk_idx = jax.lax.top_k(gates, k)  # [T, k]
     mask = jnp.sum(_one_hot(topk_idx, E), axis=1)  # [T, E]

@@ -135,3 +135,26 @@ def test_expert_checkpoint_files_roundtrip(tmp_path):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b))
             checked += 1
     assert checked > 0
+
+
+def test_aux_loss_prefers_balanced_routing():
+    """Load-balance aux loss (reference sharded_moe.py algebra): skewed
+    routing must cost more than balanced routing."""
+    T, E = 64, 4
+    rng = np.random.default_rng(3)
+    balanced = jnp.asarray(rng.standard_normal((T, E)) * 0.01, jnp.float32)
+    skew = jnp.zeros((T, E), jnp.float32).at[:, 0].set(8.0)
+    l_bal, *_ = top1gating(balanced, capacity_factor=4.0)
+    l_skew, *_ = top1gating(skew, capacity_factor=4.0)
+    assert float(l_skew) > float(l_bal) * 2
+
+
+def test_topk_no_drop_routes_every_token():
+    """drop_tokens=False (reference TopKGate no-drop mode): capacity grows
+    so no token is dropped even under fully-skewed routing."""
+    T, E, K = 32, 4, 2
+    skew = jnp.zeros((T, E), jnp.float32).at[:, 0].set(9.0).at[:, 1].set(8.0)
+    _, combine, dispatch, _ = topkgating(skew, K, capacity_factor=1.0,
+                                         drop_tokens=False)
+    per_tok = jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2))
+    assert int(per_tok.min()) == K, "tokens dropped despite drop_tokens=False"
