@@ -153,11 +153,15 @@ class TopKGate:
 
     def __call__(self, logits, train=True, rng=None):
         cf = self.capacity_factor if train else self.eval_capacity_factor
-        if self.k == 1:
-            return top1gating(logits, cf, self.min_capacity,
-                              self.noisy_gate_policy if train else None, rng)
-        if self.k == 2:
-            return top2gating(logits, cf, self.min_capacity, rng)
+        if self.drop_tokens:
+            if self.k == 1:
+                return top1gating(logits, cf, self.min_capacity,
+                                  self.noisy_gate_policy if train else None,
+                                  rng)
+            if self.k == 2:
+                return top2gating(logits, cf, self.min_capacity, rng)
+        # general-k path; also the no-drop path for every k (worst-case
+        # static capacity — top1/top2 specializations always drop)
         return topkgating(logits, self.k, cf, self.min_capacity,
                           self.drop_tokens)
 
