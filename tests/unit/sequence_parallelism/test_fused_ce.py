@@ -188,3 +188,36 @@ def test_chunked_loss_composes_with_zero3_tp():
     assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
     groups.reset_mesh()
     dist.destroy_process_group()
+
+
+def test_gpt2_chunked_loss_fp16_zero1_engine():
+    """The gpt2 on-chip sweep-leg combination at tiny scale: fp16 dynamic
+    loss scaling + ZeRO-1 + chunked CE must compile and train (the scaled
+    loss flows through the scanned head's backward)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
+
+    cfg = gpt2.gpt2_tiny(dtype="float16", remat=False, loss_chunk_vocab=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.GPT2Model(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "fusedadam", "params": {"lr": 1e-3}},
+                "fp16": {"enabled": True, "initial_scale_power": 8},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"dp": 8}})
+    rows = 2 * engine.dp_world_size
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(rows, 24)).astype(np.int32)
+    engine.initialize_parameters(0, ids, ids)
+    losses = []
+    for _ in range(4):
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    groups.reset_mesh()
+    dist.destroy_process_group()
