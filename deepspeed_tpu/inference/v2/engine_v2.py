@@ -298,15 +298,20 @@ class InferenceEngineV2:
             use_kernel=self._tp == 1)
         out = {}
         if finishing:
-            lg = np.asarray(logits)
-            for seq, _ in finishing:
-                row = lg[seq.slot]
-                if do_sample:
-                    token = self._sample_row(row, temperature, top_k, top_p,
-                                             self._rng)
-                else:
-                    token = int(np.argmax(row))
-                out[seq.uid] = token
+            if do_sample:
+                # fetch ONLY the finishing rows ([F, V]), not every slot
+                slots_f = jnp.asarray([seq.slot for seq, _ in finishing])
+                lg = np.asarray(logits[slots_f])
+                for i, (seq, _) in enumerate(finishing):
+                    out[seq.uid] = self._sample_row(
+                        lg[i], temperature, top_k, top_p, self._rng)
+            else:
+                # greedy: argmax on device, fetch one int per slot instead
+                # of [max_seqs, V] logits (the per-step device→host tax on
+                # a decode loop)
+                toks = np.asarray(jnp.argmax(logits, axis=-1))
+                for seq, _ in finishing:
+                    out[seq.uid] = int(toks[seq.slot])
         return out
 
     # ------------------------------------------------------------- generate
