@@ -26,5 +26,6 @@ STATE_FIELD_TO_UNIVERSAL = {
     "momentum": EXP_AVG,
     "exp_avg": EXP_AVG,
     "exp_avg_sq": EXP_AVG_SQ,
+    "sum": EXP_AVG_SQ,   # adagrad squared-grad accumulator (torch key "sum")
 }
 UNIVERSAL_TO_STATE_FIELD = {EXP_AVG: "mu", EXP_AVG_SQ: "nu"}
