@@ -310,6 +310,7 @@ def run_gpt2_bench(on_tpu: bool) -> dict:
                 "zero_optimization": {"stage": 1}})
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    _logt("gpt2: initializing params…")
     engine.initialize_parameters(0, ids, ids)
 
     def one():
@@ -317,9 +318,10 @@ def run_gpt2_bench(on_tpu: bool) -> dict:
         engine.backward(loss)
         engine.step()
 
-    for _ in range(warmup):
+    for i in range(warmup):
         one()
-    _host_sync(engine.params)
+        _host_sync(engine.params)
+        _logt(f"gpt2: warmup step {i+1} done")
     t0 = time.perf_counter()
     for _ in range(steps):
         one()
@@ -510,6 +512,7 @@ def run_bert_bench(on_tpu: bool) -> dict:
     rows = B * engine.dp_world_size
     ids = rng.integers(0, cfg.vocab_size, size=(rows, S)).astype(np.int32)
     labels = np.where(rng.random((rows, S)) < 0.15, ids, -100).astype(np.int32)
+    _logt("bert: initializing params…")
     engine.initialize_parameters(0, ids, labels)
 
     def one():
@@ -518,10 +521,10 @@ def run_bert_bench(on_tpu: bool) -> dict:
         engine.step()
         return loss
 
-    for _ in range(warmup):
+    for i in range(warmup):
         one()
-    _host_sync(engine.params)
-    _logt("bert warmup done")
+        _host_sync(engine.params)
+        _logt(f"bert: warmup step {i+1} done")
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = one()
@@ -649,9 +652,11 @@ def run_fpdt_bench(on_tpu: bool) -> dict:
                       jnp.bfloat16 if on_tpu else jnp.float32)
     # compile BOTH executables: the causal tail (1st attend) and the
     # causal=False streamed-chunk merge (2nd attend sees a cached chunk)
+    _logt("fpdt: compiling tail + merge executables…")
     attn.attend(blk, k_new=blk, v_new=blk)
     attn.attend(blk, k_new=blk, v_new=blk)
     attn.reset()
+    _logt("fpdt: compile done; streaming…")
     t0 = time.perf_counter()
     for _ in range(TOTAL // CHUNK):
         out = attn.attend(blk, k_new=blk, v_new=blk)
@@ -805,8 +810,10 @@ def run_serve_bench(on_tpu: bool) -> dict:
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
                for _ in range(n_seqs)]
     # warmup (compile prefill+decode shapes)
+    _logt("serve: warmup generate (compile prefill+decode)…")
     eng.generate(prompts[:2], max_new_tokens=2)
     eng.flush(range(2))
+    _logt("serve: warmup done; timed generate…")
     t0 = time.perf_counter()
     out = eng.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0

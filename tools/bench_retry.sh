@@ -31,11 +31,31 @@ else:
 EOF
 }
 
+# Run one bench child with BOTH a hard cap and a stall watchdog: the round-4
+# tunnel failure mode is a hung RPC (client goes 0%-CPU and never returns),
+# so an attempt whose stderr phase log stops moving for STALL_S is dead —
+# kill it early instead of burning the whole ATTEMPT_TIMEOUT.
+STALL_S=${STALL_S:-600}
+run_with_watchdog() {  # $1 mode  $2 out  $3 err
+  timeout "$ATTEMPT_TIMEOUT" python bench.py --mode "$1" >"$2" 2>"$3" &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 30
+    local age=$(( $(date +%s) - $(stat -c %Y "$3" 2>/dev/null || date +%s) ))
+    if [ "$age" -gt "$STALL_S" ]; then
+      echo "[watchdog] $1 stalled ${age}s — killing"
+      # the child is `timeout` whose child is python; kill the whole group
+      pkill -9 -P "$pid" 2>/dev/null; kill -9 "$pid" 2>/dev/null
+      break
+    fi
+  done
+  wait "$pid" 2>/dev/null
+}
+
 main_done=""
 for i in $(seq 1 60); do
   echo "=== device attempt $i $(date) ==="
-  timeout "$ATTEMPT_TIMEOUT" python bench.py --mode device \
-    > "$OUT/device_$i.out" 2> "$OUT/device_$i.err"
+  run_with_watchdog device "$OUT/device_$i.out" "$OUT/device_$i.err"
   echo "--- stderr tail:"; tail -4 "$OUT/device_$i.err"
   last=$(grep -E '^\{.*"metric"' "$OUT/device_$i.out" | tail -1)
   if [ -n "$last" ]; then
@@ -54,8 +74,7 @@ if [ -n "$main_done" ]; then
   # cache is warm + tunnel is alive: grab the ladder legs back-to-back
   for mode in gpt2 offload fpdt serve hostopt bert; do
     echo "=== ladder $mode $(date) ==="
-    timeout "$ATTEMPT_TIMEOUT" python bench.py --mode "$mode" \
-      > "$OUT/${mode}.out" 2> "$OUT/${mode}.err"
+    run_with_watchdog "$mode" "$OUT/${mode}.out" "$OUT/${mode}.err"
     tail -2 "$OUT/${mode}.err"
     grep -E '^\{.*"metric"' "$OUT/${mode}.out" | tail -1 | tee "$OUT/${mode}.json"
   done
