@@ -704,3 +704,42 @@ def test_load_checkpoint_module_only_and_no_optimizer_states(tmp_path):
     # and training continues fine from module-only state
     losses = _train(engine, data, steps=2)
     assert np.isfinite(losses[-1])
+
+
+def test_train_step_single_compile_across_steps():
+    """r4: the loss-scale state used to be created with UnspecifiedValue
+    sharding, so the boundary step's committed NamedSharding(P()) outputs
+    changed the jit signature and the SECOND step recompiled both ``micro``
+    and ``apply`` (2× the multi-minute tunnel compile on the bench).  Guard:
+    steps 2..4 must reuse step 1's executables."""
+    import logging
+
+    params = make_simple_mlp_params(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params, config=_config())
+    bs = 4 * engine.dp_world_size
+    x, y = batches(random_dataset(2 * bs, HIDDEN), bs)[0]
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    loggers = [logging.getLogger("jax._src.interpreters.pxla"),
+               logging.getLogger("jax._src.dispatch")]
+    jax.config.update("jax_log_compiles", True)
+    for lg in loggers:
+        lg.addHandler(handler)
+    try:
+        for _ in range(4):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(handler)
+    n_micro = sum(1 for m in records
+                  if m.startswith("Compiling") and "jit(micro)" in m)
+    n_apply = sum(1 for m in records
+                  if m.startswith("Compiling") and "jit(apply)" in m)
+    assert n_micro == 1, f"micro compiled {n_micro}× across same-shape steps"
+    assert n_apply == 1, f"apply compiled {n_apply}× across same-shape steps"
