@@ -800,13 +800,15 @@ def run_serve_bench(on_tpu: bool) -> dict:
                   block_size=16, num_blocks=40)
     if os.environ.get("DS_SERVE_ATOM") is not None:  # A/B the atom layout
         sm["prefill_atom_size"] = int(os.environ["DS_SERVE_ATOM"])
+    econf = dict(dtype=cfg.dtype, state_manager=sm)
+    if os.environ.get("DS_SERVE_BURST") is not None:  # A/B fused decode
+        econf["decode_burst"] = int(os.environ["DS_SERVE_BURST"])
 
     model = llama.LlamaModel(cfg)
     rng = np.random.default_rng(0)
     ids0 = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0)["params"]
-    eng = InferenceEngineV2(model, params=params,
-                            config=dict(dtype=cfg.dtype, state_manager=sm))
+    eng = InferenceEngineV2(model, params=params, config=econf)
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
                for _ in range(n_seqs)]
     # warmup (compile prefill+decode shapes)
@@ -822,7 +824,9 @@ def run_serve_bench(on_tpu: bool) -> dict:
         "metric": "fastgen_serve_tokens_per_sec",
         "value": round(generated / dt, 1),
         "unit": (f"generated tokens/s (seqs={n_seqs} prompt={prompt_len} "
-                 f"new={new_tokens} backend={jax.default_backend()})"),
+                 f"new={new_tokens} "
+                 f"burst_steps={getattr(eng, 'burst_steps', 0)} "
+                 f"backend={jax.default_backend()})"),
         "vs_baseline": 0.0,  # no in-repo reference number (BASELINE.md)
     }
 

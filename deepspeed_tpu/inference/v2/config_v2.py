@@ -34,3 +34,8 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = DSStateManagerConfig()
     dtype: str = "bfloat16"
     quantization_mode: Optional[str] = None
+    # Max greedy decode steps fused into one device program when every
+    # running sequence is in pure decode (``ragged_forward.decode_burst``) —
+    # one host round-trip per ``decode_burst`` tokens instead of per token.
+    # 0/1 disables (exact per-step reference loop).
+    decode_burst: int = 16

@@ -314,6 +314,56 @@ class InferenceEngineV2:
                     out[seq.uid] = int(toks[seq.slot])
         return out
 
+    # ---------------------------------------------------------- decode burst
+    def _decode_burst_step(self, active_uids, produced, max_new_tokens,
+                           cap):
+        """Run up to ``cap`` greedy decode iterations on device in one
+        program (``ragged_forward.decode_burst``).  Eligible only when
+        EVERY active sequence has exactly one pending token (pure decode —
+        a pending prefill chunk keeps the per-step scheduler).  Returns
+        {uid: [k tokens]} or None if not eligible."""
+        sm = self.state_manager
+        seqs = []
+        for uid in active_uids:
+            seq = sm.get_sequence(uid)
+            if len(seq.tokens) - seq.seen_tokens != 1:
+                return None
+            seqs.append(seq)
+        if not seqs:
+            return None
+        k = min(cap, min(max_new_tokens - len(produced[s.uid])
+                         for s in seqs))
+        if k < 2:
+            return None
+        n = sm.max_seqs
+        tok0 = np.zeros(n, np.int32)
+        pos0 = np.zeros(n, np.int32)
+        act = np.zeros(n, bool)
+        for seq in seqs:
+            sm.ensure_capacity(seq, seq.seen_tokens + k)
+            tok0[seq.slot] = seq.tokens[seq.seen_tokens]
+            pos0[seq.slot] = seq.seen_tokens
+            act[seq.slot] = True
+        from .ragged_forward import decode_burst
+        toks_out, self._kv = decode_burst(
+            self.params, self._kv, jnp.asarray(tok0), jnp.asarray(pos0),
+            jnp.asarray(act), jnp.asarray(sm.block_table),
+            step_fn=self._step_fn, cfg=self.model_config,
+            block_size=self.kv_cache.block_size, k=k,
+            use_kernel=self._tp == 1)
+        toks_out = np.asarray(toks_out)      # ONE fetch for k×seqs tokens
+        self.burst_steps = getattr(self, "burst_steps", 0) + 1
+        out = {}
+        for seq in seqs:
+            # k tokens scheduled on device: t0 (the pending one) + the k-1
+            # fed-back generations; invariant len(tokens) == seen + 1 holds
+            # with the newest generation left pending for the next round
+            seq.seen_tokens += k
+            col = toks_out[:, seq.slot]
+            seq.tokens.extend(int(t) for t in col)
+            out[seq.uid] = [int(t) for t in col]
+        return out
+
     # ------------------------------------------------------------- generate
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -324,7 +374,25 @@ class InferenceEngineV2:
         self.put(uids, prompts)
         produced = {u: [] for u in uids}
         active = set(uids)
+        burst_cap = 0 if do_sample else int(self._config.decode_burst or 0)
         while active:
+            if burst_cap > 1:
+                burst = self._decode_burst_step(active, produced,
+                                                max_new_tokens, burst_cap)
+                if burst is not None:
+                    for uid, toks in burst.items():
+                        seq = self.state_manager.get_sequence(uid)
+                        for tok in toks:
+                            produced[uid].append(tok)
+                            if (eos_token_id is not None
+                                    and tok == eos_token_id) or \
+                                    len(produced[uid]) >= max_new_tokens:
+                                # overshoot past EOS is garbage the flush
+                                # drops; ``produced`` truncates exactly
+                                seq.done = True
+                                active.discard(uid)
+                                break
+                    continue
             next_tokens = self.schedule_step(do_sample=do_sample,
                                              temperature=temperature,
                                              top_k=top_k, top_p=top_p,

@@ -427,3 +427,54 @@ RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step,
                    "FalconModel": falcon_ragged_step,
                    "OPTModel": opt_ragged_step,
                    "PhiModel": phi_ragged_step}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("step_fn", "cfg", "block_size", "k", "use_kernel"),
+    donate_argnums=(1, ))
+def decode_burst(params, kv_data, tok0, pos0, active, block_tables, *,
+                 step_fn, cfg, block_size, k, use_kernel=True):
+    """``k`` greedy decode iterations in ONE compiled program.
+
+    The per-step serving loop pays a host round-trip per generated token
+    (fetch argmax → rebuild the ragged batch → re-upload).  When every
+    running sequence is in pure decode, that loop is a fixed-point the
+    device can run alone: a ``lax.scan`` feeds each step's argmax back as
+    the next step's input token, and the host fetches ``k`` tokens per
+    sequence in one transfer.  TPU answer to the role CUDA graphs play in
+    the reference's decode path (``inference/engine.py:519``
+    ``_create_cuda_graph``) — here the whole multi-token loop is one XLA
+    program, not a replayed capture.
+
+    Layout: row ``i`` of the [max_seqs]-token batch belongs to slot ``i``
+    (``last_token_idx = arange``); idle rows carry ``active=False`` and are
+    steered to slot 0, whose block-table row is the reserved garbage block.
+    Greedy only — sampling keeps the host loop (host RNG semantics).
+
+    Args:
+      tok0/pos0/active: [max_seqs] — each active slot's pending token and
+        its position; block capacity for ``pos0 + k`` must be pre-ensured.
+      step_fn: a RAGGED_FORWARDS value (the jitted wrapper's underlying
+        function is inlined into the scan body).
+
+    Returns ([k, max_seqs] int32 tokens (argmax per iteration), new kv).
+    """
+    n = tok0.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    slots = jnp.where(active, rows, 0)
+    inner = getattr(step_fn, "__wrapped__", step_fn)
+
+    def body(carry, _):
+        kv, toks, pos = carry
+        logits, kv = inner(params, kv, jnp.where(active, toks, 0),
+                           jnp.where(active, pos, 0), slots, block_tables,
+                           rows, cfg=cfg, block_size=block_size,
+                           layout=(0, 0), use_kernel=use_kernel)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (kv, nxt, pos + 1), nxt
+
+    (kv_data, _, _), toks_out = jax.lax.scan(
+        body, (kv_data, tok0.astype(jnp.int32), pos0.astype(jnp.int32)),
+        None, length=k)
+    return toks_out, kv_data

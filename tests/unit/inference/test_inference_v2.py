@@ -266,3 +266,64 @@ def test_generate_with_sampling_options_runs():
     out = eng.generate(prompts, max_new_tokens=4, do_sample=True,
                        temperature=0.8, top_k=8, top_p=0.9, rng=0)
     assert all(len(o) == 4 for o in out)
+
+
+# ------------------------------------------------------------- decode burst
+def _v2_burst(model, params, burst):
+    cfg = RaggedInferenceEngineConfig(
+        dtype="float32", decode_burst=burst,
+        state_manager=DSStateManagerConfig(
+            max_ragged_batch_size=16, block_size=8,
+            max_context=64, num_blocks=64,
+            max_ragged_sequence_count=8, max_tracked_sequences=8))
+    return InferenceEngineV2(model, params, cfg)
+
+
+def test_decode_burst_parity_with_per_step_loop():
+    """r4: fused multi-token greedy decode (``decode_burst``) must produce
+    the same tokens as the per-step scheduler, engage only after the mixed
+    prefill phase drains, and leave sequence bookkeeping consistent."""
+    model, cfg, params = _model()
+    rng = np.random.default_rng(3)
+    # mixed lengths: chunked prefill first (burst must NOT engage there)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist()
+               for n in (11, 5, 3)]
+    ref_eng = _v2_burst(model, params, burst=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=13)
+    assert not hasattr(ref_eng, "burst_steps")
+
+    eng = _v2_burst(model, params, burst=4)
+    out = eng.generate(prompts, max_new_tokens=13)
+    assert eng.burst_steps >= 2          # 13 tokens / cap 4 → several bursts
+    assert out == ref
+    # slots/blocks all released after generate's flush
+    assert len(eng.state_manager.tracked_sequences) == 0
+
+
+def test_decode_burst_eos_truncation_parity():
+    """EOS inside a burst window: overshoot tokens must be dropped from the
+    output exactly as the per-step loop would stop."""
+    model, cfg, params = _model()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, size=6).tolist()
+               for _ in range(2)]
+    probe = _v2_burst(model, params, burst=0)
+    ref = probe.generate(prompts, max_new_tokens=9)
+    # pick the token one row emits mid-stream as the "EOS" so one sequence
+    # stops early and the other keeps decoding
+    eos = ref[0][4]
+    ref_eos = _v2_burst(model, params, burst=0).generate(
+        prompts, max_new_tokens=9, eos_token_id=eos)
+    burst_eos = _v2_burst(model, params, burst=4).generate(
+        prompts, max_new_tokens=9, eos_token_id=eos)
+    assert burst_eos == ref_eos
+
+
+def test_decode_burst_sampling_keeps_per_step_loop():
+    model, cfg, params = _model()
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, size=4).tolist()]
+    eng = _v2_burst(model, params, burst=8)
+    out = eng.generate(prompts, max_new_tokens=5, do_sample=True, rng=0)
+    assert not hasattr(eng, "burst_steps")   # sampling → host loop
+    assert len(out[0]) == 5

@@ -46,9 +46,11 @@ leg b8_fusedce env BENCH_BATCH=8 BENCH_LOSS_CHUNK=6400 python bench.py --mode de
 # are what force remat=True in the default leg)
 leg gpt2_chunk env BENCH_GPT2_REMAT=0 BENCH_LOSS_CHUNK=6400 python bench.py --mode gpt2
 
-# 4) serving atom A/B
+# 4) serving atom A/B + decode-burst A/B (r4 fused multi-token decode)
 leg serve_atom0 env DS_SERVE_ATOM=0 python bench.py --mode serve
 leg serve_atom16 env DS_SERVE_ATOM=16 python bench.py --mode serve
+leg serve_burst0 env DS_SERVE_BURST=0 python bench.py --mode serve
+leg serve_burst32 env DS_SERVE_BURST=32 python bench.py --mode serve
 
 # 5) MoE grouped-GEMM kernel A/B + BERT TFLOPS row
 leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
