@@ -39,3 +39,8 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     # one host round-trip per ``decode_burst`` tokens instead of per token.
     # 0/1 disables (exact per-step reference loop).
     decode_burst: int = 16
+    # Opt-in: fuse SAMPLED decode too (device-side temperature/top-k/top-p
+    # categorical with the jax PRNG).  Off by default because the draws are
+    # a different (seed-deterministic) stream than the host loop's numpy
+    # Generator; requires ``rng`` passed as a seed, not a Generator.
+    decode_burst_sampling: bool = False

@@ -316,7 +316,8 @@ class InferenceEngineV2:
 
     # ---------------------------------------------------------- decode burst
     def _decode_burst_step(self, active_uids, produced, max_new_tokens,
-                           cap):
+                           cap, sample=False, temperature=1.0, top_k=0,
+                           top_p=1.0, seed=None):
         """Run up to ``cap`` greedy decode iterations on device in one
         program (``ragged_forward.decode_burst``).  Eligible only when
         EVERY active sequence has exactly one pending token (pure decode —
@@ -345,12 +346,22 @@ class InferenceEngineV2:
             pos0[seq.slot] = seq.seen_tokens
             act[seq.slot] = True
         from .ragged_forward import decode_burst
+        if sample:
+            if getattr(self, "_burst_key", None) is None or \
+                    seed != getattr(self, "_burst_seed", None):
+                self._burst_key = jax.random.PRNGKey(seed or 0)
+                self._burst_seed = seed
+            self._burst_key, key = jax.random.split(self._burst_key)
+        else:
+            key = None
         toks_out, self._kv = decode_burst(
             self.params, self._kv, jnp.asarray(tok0), jnp.asarray(pos0),
             jnp.asarray(act), jnp.asarray(sm.block_table),
             step_fn=self._step_fn, cfg=self.model_config,
             block_size=self.kv_cache.block_size, k=k,
-            use_kernel=self._tp == 1)
+            use_kernel=self._tp == 1, sample=sample, key=key,
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p))
         toks_out = np.asarray(toks_out)      # ONE fetch for k×seqs tokens
         self.burst_steps = getattr(self, "burst_steps", 0) + 1
         out = {}
@@ -374,11 +385,22 @@ class InferenceEngineV2:
         self.put(uids, prompts)
         produced = {u: [] for u in uids}
         active = set(uids)
-        burst_cap = 0 if do_sample else int(self._config.decode_burst or 0)
+        burst_cap = int(self._config.decode_burst or 0)
+        burst_sample = False
+        if do_sample:
+            # fused sampling is opt-in AND needs a seed (not a Generator —
+            # the device stream can't replicate numpy's)
+            if (self._config.decode_burst_sampling
+                    and not isinstance(rng, np.random.Generator)):
+                burst_sample = True
+            else:
+                burst_cap = 0
         while active:
             if burst_cap > 1:
-                burst = self._decode_burst_step(active, produced,
-                                                max_new_tokens, burst_cap)
+                burst = self._decode_burst_step(
+                    active, produced, max_new_tokens, burst_cap,
+                    sample=burst_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p, seed=rng)
                 if burst is not None:
                     for uid, toks in burst.items():
                         seq = self.state_manager.get_sequence(uid)

@@ -429,12 +429,35 @@ RAGGED_FORWARDS = {"LlamaModel": llama_ragged_step,
                    "PhiModel": phi_ragged_step}
 
 
+def _device_sample(logits, key, temperature, top_k, top_p):
+    """Per-row categorical with the engine's generate options (temperature /
+    top-k / nucleus top-p), all on device.  ``top_k`` is static (shapes);
+    temperature/top_p are traced scalars.  Same filtering semantics as the
+    host ``_sample_row``: smallest prefix reaching ``top_p``, always ≥ 1
+    candidate."""
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    p = jax.nn.softmax(logits, axis=-1)
+    sp = jnp.sort(p, axis=-1)[:, ::-1]                      # descending
+    csum = jnp.cumsum(sp, axis=-1)
+    # per row: the smallest kept probability of the nucleus prefix
+    kept = jnp.where(csum - sp < top_p, sp, jnp.inf)
+    thresh = jnp.min(kept, axis=-1, keepdims=True)
+    logits = jnp.where(p < thresh, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("step_fn", "cfg", "block_size", "k", "use_kernel"),
+    static_argnames=("step_fn", "cfg", "block_size", "k", "use_kernel",
+                     "sample", "top_k"),
     donate_argnums=(1, ))
 def decode_burst(params, kv_data, tok0, pos0, active, block_tables, *,
-                 step_fn, cfg, block_size, k, use_kernel=True):
+                 step_fn, cfg, block_size, k, use_kernel=True,
+                 sample=False, key=None, temperature=1.0, top_k=0,
+                 top_p=1.0):
     """``k`` greedy decode iterations in ONE compiled program.
 
     The per-step serving loop pays a host round-trip per generated token
@@ -458,23 +481,35 @@ def decode_burst(params, kv_data, tok0, pos0, active, block_tables, *,
       step_fn: a RAGGED_FORWARDS value (the jitted wrapper's underlying
         function is inlined into the scan body).
 
-    Returns ([k, max_seqs] int32 tokens (argmax per iteration), new kv).
+    With ``sample=True`` each iteration draws from the temperature/top-k/
+    top-p-filtered distribution with the jax PRNG ``key`` (split per
+    iteration) instead of argmax — seed-deterministic, but a DIFFERENT
+    stream than the host loop's numpy Generator, which is why the engine
+    gates it behind ``decode_burst_sampling``.
+
+    Returns ([k, max_seqs] int32 tokens (one per iteration), new kv).
     """
     n = tok0.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)
     slots = jnp.where(active, rows, 0)
     inner = getattr(step_fn, "__wrapped__", step_fn)
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     def body(carry, _):
-        kv, toks, pos = carry
+        kv, toks, pos, key = carry
         logits, kv = inner(params, kv, jnp.where(active, toks, 0),
                            jnp.where(active, pos, 0), slots, block_tables,
                            rows, cfg=cfg, block_size=block_size,
                            layout=(0, 0), use_kernel=use_kernel)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (kv, nxt, pos + 1), nxt
+        if sample:
+            key, sub = jax.random.split(key)
+            nxt = _device_sample(logits, sub, temperature, top_k, top_p)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (kv, nxt, pos + 1, key), nxt
 
-    (kv_data, _, _), toks_out = jax.lax.scan(
-        body, (kv_data, tok0.astype(jnp.int32), pos0.astype(jnp.int32)),
-        None, length=k)
+    (kv_data, _, _, _), toks_out = jax.lax.scan(
+        body, (kv_data, tok0.astype(jnp.int32), pos0.astype(jnp.int32),
+               key), None, length=k)
     return toks_out, kv_data

@@ -327,3 +327,44 @@ def test_decode_burst_sampling_keeps_per_step_loop():
     out = eng.generate(prompts, max_new_tokens=5, do_sample=True, rng=0)
     assert not hasattr(eng, "burst_steps")   # sampling → host loop
     assert len(out[0]) == 5
+
+
+def test_decode_burst_sampling_device_path():
+    """Opt-in fused sampling: seed-deterministic, top_k=1 degenerates to
+    greedy (exact match with the argmax burst), and distinct seeds draw
+    distinct streams."""
+    model, cfg, params = _model()
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size, size=5).tolist()
+               for _ in range(2)]
+
+    def eng(sampling):
+        c = RaggedInferenceEngineConfig(
+            dtype="float32", decode_burst=4,
+            decode_burst_sampling=sampling,
+            state_manager=DSStateManagerConfig(
+                max_ragged_batch_size=16, block_size=8,
+                max_context=64, num_blocks=64,
+                max_ragged_sequence_count=8, max_tracked_sequences=8))
+        return InferenceEngineV2(model, params, c)
+
+    greedy = eng(False).generate(prompts, max_new_tokens=9)
+    e = eng(True)
+    topk1 = e.generate(prompts, max_new_tokens=9, do_sample=True,
+                       top_k=1, rng=0)
+    assert e.burst_steps >= 1          # the sampled path DID fuse
+    assert topk1 == greedy
+    # determinism in the seed; variation across seeds
+    a = eng(True).generate(prompts, max_new_tokens=9, do_sample=True,
+                           temperature=5.0, rng=1)
+    b = eng(True).generate(prompts, max_new_tokens=9, do_sample=True,
+                           temperature=5.0, rng=1)
+    c2 = eng(True).generate(prompts, max_new_tokens=9, do_sample=True,
+                            temperature=5.0, rng=2)
+    assert a == b
+    assert a != c2
+    # a numpy Generator rng falls back to the host loop (stream contract)
+    e3 = eng(True)
+    e3.generate(prompts, max_new_tokens=4, do_sample=True,
+                rng=np.random.default_rng(0))
+    assert not hasattr(e3, "burst_steps")
