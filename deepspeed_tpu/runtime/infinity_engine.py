@@ -295,15 +295,28 @@ class InfinityEngine(DeepSpeedEngine):
         self._head_stash = None
         acts, batch = self._acts, self._fwd_batch
         self._acts = self._fwd_batch = None
+        pending = None   # (key, dev grads) whose D2H is in flight
         for i in range(len(keys) - 1, -1, -1):
             if i - 1 >= 0:
                 self._fetch_async(keys[i - 1])
             w = self._get_block(keys[i])
             dw, dx = self._j_block_grad(w, acts[i], dx)
+            # kick the D2H copies now, but BLOCK on them one iteration
+            # later — the host-side read of block i's grads overlaps the
+            # device computing block i-1's (costs one extra in-flight grad
+            # tree on the chip, still O(block))
+            for leaf in jax.tree_util.tree_leaves(dw):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
             acts[i] = None
             self._release_block(keys[i])
-            self._store.accumulate_grads(keys[i], dw)
+            if pending is not None:
+                self._store.accumulate_grads(*pending)
+            pending = (keys[i], dw)
             del dw
+        if pending is not None:
+            self._store.accumulate_grads(*pending)
+            pending = None
         res = self._get_resident()
         dres_embed = self._j_embed_grad(res, dx, *batch)
         self._store.accumulate_grads(self._resident_key,
