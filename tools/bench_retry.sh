@@ -36,12 +36,22 @@ EOF
 # so an attempt whose stderr phase log stops moving for STALL_S is dead —
 # kill it early instead of burning the whole ATTEMPT_TIMEOUT.
 STALL_S=${STALL_S:-600}
+ACQUIRE_S=${ACQUIRE_S:-180}
 run_with_watchdog() {  # $1 mode  $2 out  $3 err
   timeout "$ATTEMPT_TIMEOUT" python bench.py --mode "$1" >"$2" 2>"$3" &
   local pid=$!
   while kill -0 "$pid" 2>/dev/null; do
     sleep 30
     local age=$(( $(date +%s) - $(stat -c %Y "$3" 2>/dev/null || date +%s) ))
+    # a healthy tunnel answers the backend probe in <1s; if the child is
+    # still stuck acquiring after ACQUIRE_S the tunnel is down — probe
+    # again sooner rather than burning the full stall window
+    if [ "$age" -gt "$ACQUIRE_S" ] && \
+       ! grep -q "backend = " "$3" 2>/dev/null; then
+      echo "[watchdog] $1 tunnel-down (no backend after ${age}s) — killing"
+      pkill -9 -P "$pid" 2>/dev/null; kill -9 "$pid" 2>/dev/null
+      break
+    fi
     if [ "$age" -gt "$STALL_S" ]; then
       echo "[watchdog] $1 stalled ${age}s — killing"
       # the child is `timeout` whose child is python; kill the whole group
