@@ -44,6 +44,13 @@ class DeepSpeedDataLoader:
 
     ``batch_size`` here is the *global* effective micro batch
     (micro_batch_per_gpu × dp_world_size), matching what the engine shards.
+
+    ``num_local_io_workers`` (reference ``deepspeed_io`` engine.py:1753 /
+    torch DataLoader ``num_workers`` role): > 0 assembles upcoming batches
+    on a thread pool with a sliding window of ``workers + 1`` in flight, so
+    dataset ``__getitem__`` IO (e.g. ``indexed_dataset`` mmap reads) and
+    collation overlap the device step instead of serializing with it.
+    Ordering is preserved either way.
     """
 
     def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
@@ -54,6 +61,7 @@ class DeepSpeedDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        self.workers = int(num_local_io_workers or 0)
         self.epoch = 0
         n = len(dataset)
         self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
@@ -64,16 +72,80 @@ class DeepSpeedDataLoader:
     def set_epoch(self, epoch):
         self.epoch = epoch
 
-    def __iter__(self):
-        n = len(self.dataset)
-        order = np.arange(n)
+    def _batch_indices(self):
+        order = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(order)
         for b in range(self.len):
-            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
-            samples = [self.dataset[int(i)] for i in idx]
-            yield self.collate_fn(samples)
+            yield order[b * self.batch_size:(b + 1) * self.batch_size]
+
+    def _make(self, idx):
+        return self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def __iter__(self):
+        if self.workers <= 0:
+            for idx in self._batch_indices():
+                yield self._make(idx)
+            return
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(self.workers) as ex:
+            futs = deque()
+            it = self._batch_indices()
+            for idx in it:
+                futs.append(ex.submit(self._make, idx))
+                if len(futs) > self.workers:
+                    break
+            while futs:
+                batch = futs.popleft().result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(ex.submit(self._make, nxt))
+                yield batch
+
+
+class PrefetchLoader:
+    """Background-thread batch prefetch around ANY iterable loader (the
+    decoupled producer role the reference gets from torch DataLoader worker
+    processes): while the device runs step N, one filler thread assembles
+    batches N+1..N+depth into a bounded queue.  Exceptions in the source
+    iterator propagate to the consumer; each ``__iter__`` spins a fresh
+    filler so epochs restart cleanly."""
+
+    def __init__(self, loader, depth=2):
+        self.loader = loader
+        self.depth = max(1, int(depth))
+
+    def __len__(self):
+        return len(self.loader)
+
+    def set_epoch(self, epoch):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __iter__(self):
+        import queue
+        import threading
+        q = queue.Queue(maxsize=self.depth)
+        END = object()
+
+        def fill():
+            try:
+                for item in self.loader:
+                    q.put(item)
+                q.put(END)
+            except BaseException as e:       # noqa: BLE001 — re-raised below
+                q.put(e)
+
+        threading.Thread(target=fill, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
 
 
 class RepeatingLoader:

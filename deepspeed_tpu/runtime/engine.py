@@ -867,12 +867,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------- data path
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None,
                      route=None, data_sampler=None, num_local_io_workers=None):
-        """Reference ``deepspeed_io`` engine.py:1753: global-batch loader."""
+        """Reference ``deepspeed_io`` engine.py:1753: global-batch loader.
+        ``num_local_io_workers`` > 0 overlaps batch IO/collation with the
+        device step (threaded sliding window, see ``DeepSpeedDataLoader``)."""
         if batch_size is None:
             batch_size = (self.train_micro_batch_size_per_gpu() *
                           self.dp_world_size)
         return DeepSpeedDataLoader(dataset, batch_size=batch_size,
-                                   collate_fn=collate_fn)
+                                   collate_fn=collate_fn,
+                                   num_local_io_workers=num_local_io_workers)
 
     def _batch_sharding(self, x):
         """Shard batch dim 0 over dp (and sequence dim 1 over sp if enabled)."""

@@ -743,3 +743,44 @@ def test_train_step_single_compile_across_steps():
                   if m.startswith("Compiling") and "jit(apply)" in m)
     assert n_micro == 1, f"micro compiled {n_micro}× across same-shape steps"
     assert n_apply == 1, f"apply compiled {n_apply}× across same-shape steps"
+
+
+def test_dataloader_worker_prefetch_order_and_prefetch_loader():
+    """r4: threaded batch assembly (``num_local_io_workers``) and the
+    PrefetchLoader wrapper must preserve order, restart across epochs, and
+    propagate source exceptions."""
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  PrefetchLoader)
+
+    class SlowSet:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return (np.full((3, ), i, np.int32), np.int32(i))
+
+    plain = DeepSpeedDataLoader(SlowSet(), batch_size=4, shuffle=True, seed=7)
+    threaded = DeepSpeedDataLoader(SlowSet(), batch_size=4, shuffle=True,
+                                   seed=7, num_local_io_workers=3)
+    a = [tuple(np.asarray(x).tolist() for x in b) for b in plain]
+    b = [tuple(np.asarray(x).tolist() for x in bt) for bt in threaded]
+    assert a == b and len(a) == 6
+
+    pf = PrefetchLoader(threaded, depth=2)
+    c = [tuple(np.asarray(x).tolist() for x in bt) for bt in pf]
+    assert c == a
+    # epochs restart cleanly (fresh filler thread per __iter__)
+    assert len(list(pf)) == 6
+
+    class Boom:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i >= 2:
+                raise RuntimeError("boom")
+            return np.zeros(2, np.int32)
+
+    bad = PrefetchLoader(DeepSpeedDataLoader(Boom(), batch_size=2))
+    with pytest.raises(RuntimeError, match="boom"):
+        list(bad)
