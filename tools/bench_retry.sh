@@ -95,5 +95,10 @@ if [ -n "$main_done" ]; then
     > "$OUT/device_cachehit.out" 2> "$OUT/device_cachehit.err"
   tail -3 "$OUT/device_cachehit.err"
   grep -E '^\{.*"metric"' "$OUT/device_cachehit.out" | tail -1
+  # fold the measured legs into README's ladder table (commit is manual)
+  python tools/update_ladder.py || true
+  # and run the knob sweeps while the tunnel is known-alive
+  echo "=== chaining onchip sweeps $(date) ==="
+  bash tools/onchip_sweeps.sh
 fi
 echo "=== bench_retry done $(date) ==="
