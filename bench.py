@@ -478,8 +478,11 @@ def run_offload_bench(on_tpu: bool) -> dict:
                 gc.collect()
                 groups.reset_mesh()
                 dist.destroy_process_group()
-                if "RESOURCE_EXHAUSTED" not in str(e):
-                    break   # try the next mode's ladder
+                # device OOM *or* host OOM → next (smaller) candidate;
+                # anything else is a real failure → next mode's ladder
+                if "RESOURCE_EXHAUSTED" not in str(e) and \
+                        not isinstance(e, MemoryError):
+                    break
     raise RuntimeError(
         "all offload candidates failed on both modes") from last_exc
 
