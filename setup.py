@@ -1,0 +1,37 @@
+"""Install deepspeed_tpu (reference ``setup.py`` role).
+
+Plain ``pip install .`` ships the Python package and the ``bin/`` CLIs.
+The native host libraries (aio, cpu optimizers) JIT-build on first use via
+``ops/op_builder.py`` (g++ + ctypes — no torch cpp_extension); set
+``DS_BUILD_OPS=1`` to prebuild them at install time instead, the analog of
+the reference's prebuild flow (``op_builder/builder.py:514,533``).
+"""
+
+import os
+
+from setuptools import find_packages, setup
+
+if os.environ.get("DS_BUILD_OPS") == "1":
+    import deepspeed_tpu.ops  # noqa: F401  (populates the registry)
+    from deepspeed_tpu.ops.op_builder import ALL_OPS
+    for name, cls in ALL_OPS.items():
+        try:
+            path = cls().build()
+            print(f"DS_BUILD_OPS: built {name} -> {path}")
+        except Exception as e:  # noqa: BLE001 — report and continue
+            print(f"DS_BUILD_OPS: {name} failed ({e}); will JIT at runtime")
+
+setup(
+    name="deepspeed_tpu",
+    version=open("version.txt").read().strip()
+    if os.path.exists("version.txt") else "0.4.0",
+    description="TPU-native framework with DeepSpeed's capabilities "
+                "(JAX/XLA/Pallas/pjit)",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    package_data={"deepspeed_tpu": ["csrc/**/*.cpp", "csrc/**/*.h"]},
+    scripts=["bin/deepspeed", "bin/ds_report", "bin/ds_bench",
+             "bin/ds_elastic", "bin/ds_io", "bin/ds_nvme_tune", "bin/ds_ssh"],
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "orbax-checkpoint", "numpy",
+                      "ml_dtypes", "pydantic>=2"],
+)
