@@ -814,9 +814,13 @@ def run_serve_bench(on_tpu: bool) -> dict:
     eng = InferenceEngineV2(model, params=params, config=econf)
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
                for _ in range(n_seqs)]
-    # warmup (compile prefill+decode shapes)
-    _logt("serve: warmup generate (compile prefill+decode)…")
-    eng.generate(prompts[:2], max_new_tokens=2)
+    # warmup with the SAME max_new_tokens as the timed run: the burst
+    # executors are static in k, and the k schedule is a function of
+    # remaining tokens — an identical generation length compiles exactly
+    # the programs the timed loop will replay (2 seqs suffice: the step is
+    # shape-static in the token budget, not the sequence count)
+    _logt("serve: warmup generate (compile prefill+decode+burst)…")
+    eng.generate(prompts[:2], max_new_tokens=new_tokens)
     eng.flush(range(2))
     _logt("serve: warmup done; timed generate…")
     t0 = time.perf_counter()

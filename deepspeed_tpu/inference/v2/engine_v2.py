@@ -336,6 +336,10 @@ class InferenceEngineV2:
                          for s in seqs))
         if k < 2:
             return None
+        # quantize to the floor power of two: each distinct static k is its
+        # own compiled program, so arbitrary k values would compile per
+        # remaining-token count — pow2 bounds the variants to log2(cap)
+        k = 1 << (k.bit_length() - 1)
         n = sm.max_seqs
         tok0 = np.zeros(n, np.int32)
         pos0 = np.zeros(n, np.int32)
