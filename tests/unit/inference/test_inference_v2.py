@@ -368,3 +368,29 @@ def test_decode_burst_sampling_device_path():
     e3.generate(prompts, max_new_tokens=4, do_sample=True,
                 rng=np.random.default_rng(0))
     assert not hasattr(e3, "burst_steps")
+
+
+def test_decode_burst_memory_flat_in_k():
+    """The burst is a scan whose carry (kv cache, token vector) aliases —
+    compiled temp memory must NOT scale with the burst length k (the whole
+    point vs unrolling k decode steps)."""
+    from deepspeed_tpu.inference.v2.ragged_forward import decode_burst
+
+    model, cfg, params = _model()
+    eng = _v2(model, params)
+    n = eng.state_manager.max_seqs
+    tok0 = jnp.zeros(n, jnp.int32)
+    pos0 = jnp.zeros(n, jnp.int32)
+    act = jnp.ones(n, bool)
+    bt = jnp.asarray(eng.state_manager.block_table)
+    temp = {}
+    for k in (4, 16):
+        lowered = decode_burst.lower(
+            eng.params, eng._kv, tok0, pos0, act, bt, step_fn=eng._step_fn,
+            cfg=eng.model_config, block_size=eng.kv_cache.block_size, k=k,
+            use_kernel=True)
+        ma = lowered.compile().memory_analysis()
+        if ma is None:
+            pytest.skip("backend exposes no memory_analysis")
+        temp[k] = ma.temp_size_in_bytes
+    assert temp[16] <= temp[4] * 1.25, temp
