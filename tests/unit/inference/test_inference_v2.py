@@ -175,6 +175,9 @@ def test_v2_tensor_parallel_matches_single():
         outs[tp] = eng.generate(prompts, max_new_tokens=5)
         eng.flush(range(len(prompts)))
     assert outs[1] == outs[2]
+    # the default decode_burst engaged on the GSPMD-partitioned tp=2 step
+    # too (fused multi-token decode composes with tensor parallelism)
+    assert getattr(eng, "burst_steps", 0) >= 1
 
 
 def test_v2_tp_rejects_indivisible():
