@@ -478,10 +478,12 @@ def run_offload_bench(on_tpu: bool) -> dict:
                 gc.collect()
                 groups.reset_mesh()
                 dist.destroy_process_group()
-                # device OOM *or* host OOM → next (smaller) candidate;
-                # anything else is a real failure → next mode's ladder
+                # device OOM, host OOM, or disk-full (the 6.7B candidate
+                # needs ~80G of NVMe swap; this box has ~79G free) → next
+                # (smaller) candidate; anything else is a real failure →
+                # next mode's ladder
                 if "RESOURCE_EXHAUSTED" not in str(e) and \
-                        not isinstance(e, MemoryError):
+                        not isinstance(e, (MemoryError, OSError)):
                     break
     raise RuntimeError(
         "all offload candidates failed on both modes") from last_exc
