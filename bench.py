@@ -368,10 +368,15 @@ def run_offload_bench(on_tpu: bool) -> dict:
         # wins.  stream: host budget ~14 bytes/param RAM (fp32 master+m+v +
         # bf16 cache) + bf16 grad stash ⇒ ~7B fits the 125G host.
         # state-only: bf16 params+grads must fit 16G HBM ⇒ ≤ ~3B.
+        # stream candidates may pin the optimizer-state device: the 6.7B
+        # model's fp32 master+moments (~80G) beat this box's ~79G free disk
+        # but fit its 126G RAM next to the 13.4G bf16 cache — try all-RAM
+        # first, then the NVMe-state variants at descending size
         ladders = {
             "stream": [
                 dict(hidden_size=4096, intermediate_size=11008,
-                     num_hidden_layers=32, num_attention_heads=32),  # ~6.7B
+                     num_hidden_layers=32, num_attention_heads=32,
+                     _state_dev="cpu"),                              # ~6.7B
                 dict(hidden_size=4096, intermediate_size=11008,
                      num_hidden_layers=16, num_attention_heads=32),  # ~3.7B
                 dict(hidden_size=3072, intermediate_size=8192,
@@ -398,6 +403,8 @@ def run_offload_bench(on_tpu: bool) -> dict:
         candidates = ladders[mode]
         for cand in candidates:
             try:
+                cand = dict(cand)
+                state_dev = cand.pop("_state_dev", "nvme")
                 cfg = llama.LlamaConfig(
                     vocab_size=32000, num_key_value_heads=cand[
                         "num_attention_heads"],
@@ -409,7 +416,7 @@ def run_offload_bench(on_tpu: bool) -> dict:
                 zero = {"stage": 3}
                 if mode == "stream":
                     zero["offload_param"] = {"device": "cpu"}
-                    zero["offload_optimizer"] = {"device": "nvme",
+                    zero["offload_optimizer"] = {"device": state_dev,
                                                  "nvme_path": swap_dir}
                     opt = {"type": "fusedadam", "params": {"lr": 1e-4}}
                 else:
