@@ -47,6 +47,66 @@ def _resolve_tag(ckpt_dir, tag):
     return tag
 
 
+def _convert_infinity(root, output_dir):
+    """ZeRO-Infinity (``InfinityEngine``) checkpoint → universal layout.
+
+    The streamed engine's ``infinity_state.pkl`` holds per-group fp32
+    master pytrees and per-(group, kind) FLAT optimizer-state vectors
+    (``runtime/zero/infinity.BlockStore``); relayout both into the same
+    per-parameter ``fp32/exp_avg/exp_avg_sq.npy`` files the monolithic
+    engines read, so a streamed run can resume as ZeRO-0/1/2/3 and back."""
+    import pickle
+
+    from ..runtime.zero.infinity import _flatten_f32, _views
+
+    with open(os.path.join(root, "infinity_state.pkl"), "rb") as f:
+        state = pickle.load(f)
+    masters = dict(state["master"])
+    resident = masters.pop("__resident__", {})
+
+    zero_root = os.path.join(output_dir, ZERO_FILE_PREFIX)
+    os.makedirs(zero_root, exist_ok=True)
+
+    param_meta = {}
+    merged = dict(resident)
+    merged.update(masters)
+    for name, arr in _flatten(merged).items():
+        pdir = os.path.join(zero_root, name)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(arr, dtype=np.float32))
+        param_meta[name] = {"shape": list(np.shape(arr)), "dtype": "float32"}
+
+    opt = state.get("opt") or {}
+    for gkey, kinds in (opt.get("kinds") or {}).items():
+        tree = resident if gkey == "__resident__" else masters.get(gkey)
+        if tree is None:
+            continue
+        _, meta = _flatten_f32(tree)
+        prefix = "" if gkey == "__resident__" else gkey + "/"
+        for kind, vec in kinds.items():
+            uni = STATE_FIELD_TO_UNIVERSAL.get(kind)
+            if uni is None:
+                continue
+            views = _views(np.asarray(vec, np.float32), meta)
+            for name, arr in _flatten(views).items():
+                np.save(os.path.join(zero_root, prefix + name, f"{uni}.npy"),
+                        np.asarray(arr, dtype=np.float32))
+
+    meta_out = {
+        "engine_state": {k: state.get(k, 0) for k in
+                         ("global_steps", "global_samples", "micro_steps")},
+        "step": int(opt.get("step_count", state.get("global_steps", 0))),
+        "params": param_meta,
+    }
+    with open(os.path.join(output_dir, UNIVERSAL_META), "w") as f:
+        json.dump(meta_out, f, indent=2)
+    from .. import __version__
+    with open(os.path.join(output_dir, DS_VERSION), "w") as f:
+        f.write(__version__)
+    return output_dir
+
+
 def convert_to_universal(checkpoint_dir, output_dir, tag=None):
     """Convert an engine checkpoint at ``checkpoint_dir`` (optionally
     ``tag``-selected) into universal layout at ``output_dir``."""
@@ -54,6 +114,9 @@ def convert_to_universal(checkpoint_dir, output_dir, tag=None):
     root = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
     if not os.path.isdir(root):
         raise FileNotFoundError(f"no checkpoint at {root}")
+
+    if os.path.exists(os.path.join(root, "infinity_state.pkl")):
+        return _convert_infinity(root, output_dir)
 
     with open(os.path.join(root, "engine_state.json")) as f:
         engine_state = json.load(f)

@@ -25,6 +25,68 @@ def _load_param_file(zero_root, name, key):
     return np.load(path)
 
 
+def _load_into_infinity(engine, tag, meta, zero_root, load_opt, path_str):
+    """Universal checkpoint → ``InfinityEngine`` host BlockStore: per-param
+    fp32/exp_avg/exp_avg_sq files reassemble into per-group master pytrees
+    and flat state vectors, so a monolithic-engine run (any ZeRO stage) can
+    resume streamed — the inverse of ``ds_to_universal._convert_infinity``."""
+    import numpy as np
+
+    from ..runtime.zero.infinity import _views
+
+    store = engine._store
+
+    def group_tree(key, file_key, warn_missing):
+        m = store._meta[key]
+        template = _views(np.zeros(sum(m[2]), np.float32), m)
+        prefix = "" if key == "__resident__" else key + "/"
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        leaves, missing = [], []
+        for kp, leaf in flat:
+            name = prefix + path_str(kp)
+            arr = _load_param_file(zero_root, name, file_key)
+            if arr is None:
+                missing.append(name)
+                leaves.append(np.asarray(leaf, np.float32))
+            else:
+                leaves.append(np.asarray(arr, np.float32).reshape(leaf.shape))
+        if missing and warn_missing:
+            logger.warning(f"universal checkpoint missing {file_key} for "
+                           f"{missing[:3]}{'…' if len(missing) > 3 else ''}; "
+                           "keeping zeros/current")
+        return jax.tree_util.tree_unflatten(treedef, leaves), bool(missing)
+
+    trees = {}
+    for key in store.keys():
+        trees[key], _ = group_tree(key, "fp32", warn_missing=True)
+    store.import_master(trees)
+
+    if load_opt:
+        kinds_out = {}
+        for key in store.keys():
+            kinds = {}
+            for kind in store.KINDS[store.optimizer]:
+                uni = STATE_FIELD_TO_UNIVERSAL.get(kind, kind)
+                tree, _ = group_tree(key, uni, warn_missing=False)
+                kinds[kind] = np.concatenate(
+                    [np.asarray(x, np.float32).ravel()
+                     for x in jax.tree_util.tree_leaves(tree)])
+            kinds_out[key] = kinds
+        store.import_state({"step_count": int(meta.get("step", 0)),
+                            "kinds": kinds_out})
+
+    es = meta.get("engine_state", {})
+    engine.global_steps = es.get("global_steps", engine.global_steps)
+    engine.global_samples = es.get("global_samples", engine.global_samples)
+    engine.micro_steps = es.get("micro_steps", engine.micro_steps)
+    engine._dev_resident = None
+    engine._dev_blocks.clear()
+    engine._pending_fetch.clear()
+    log_dist(f"ZeRO-Infinity: loaded universal checkpoint "
+             f"(step={meta.get('step', 0)})", ranks=[0])
+    return tag, es.get("client_state", {})
+
+
 def load_universal_checkpoint(engine, load_dir, tag=None,
                               load_optimizer_states=True,
                               load_lr_scheduler_states=True,
@@ -40,6 +102,11 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
     zero_root = os.path.join(root, ZERO_FILE_PREFIX)
 
     from ..runtime.zero.partition import path_str
+
+    if hasattr(engine, "_store"):
+        # ZeRO-Infinity streamed engine: repopulate the host BlockStore
+        return _load_into_infinity(engine, tag, meta, zero_root,
+                                   load_optimizer_states, path_str)
 
     # ---- parameters (and fp32 master when the engine keeps one)
     def build(template_tree, shardings, dtype=None):

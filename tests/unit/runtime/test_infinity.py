@@ -307,3 +307,56 @@ def test_gpt2_streaming_parity():
     ref = _train(eng_ref, data, steps=5)
     got = _train(eng_inf, data, steps=5)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_universal_checkpoint_bridge(tmp_path):
+    """r4: streamed-engine checkpoints convert to the universal layout and
+    resume BOTH ways — into a monolithic ZeRO-2 engine and back into a
+    fresh streamed engine — with matching trajectories (closes the
+    'infinity_state.pkl is its own island' limitation)."""
+    from deepspeed_tpu.checkpoint.ds_to_universal import convert_to_universal
+    from deepspeed_tpu.checkpoint.universal_checkpoint import (
+        load_universal_checkpoint)
+
+    cfg = _tiny_cfg()
+    bs_probe, _ = 2, None
+    params = _host_params(cfg, bs_probe)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    data = _data(cfg, bs)
+    _train(eng, data, steps=3)
+    ck = tmp_path / "ck"
+    eng.save_checkpoint(str(ck), tag="t3")
+    uni = tmp_path / "uni"
+    convert_to_universal(str(ck), str(uni), tag="t3")
+
+    # continue streamed from the pkl (the reference trajectory)
+    eng_pkl, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config=_config("cpu"))
+    eng_pkl.load_checkpoint(str(ck), tag="t3")
+    ref = _train(eng_pkl, data, steps=2)
+
+    # (a) universal → monolithic ZeRO-2
+    mono_cfg = {"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 2}}
+    mono, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config=mono_cfg)
+    load_universal_checkpoint(mono, str(uni))
+    assert mono.global_steps == 3
+    got = _train(mono, data, steps=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-3)
+
+    # (b) universal → fresh streamed engine
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config=_config("cpu"))
+    load_universal_checkpoint(eng2, str(uni))
+    assert eng2.global_steps == 3
+    got2 = _train(eng2, data, steps=2)
+    np.testing.assert_allclose(got2, ref, rtol=1e-4)
