@@ -791,21 +791,31 @@ def run_serve_bench(on_tpu: bool) -> dict:
     tokens/s; BASELINE.md row 'FastGen serving')."""
     import jax
     import jax.numpy as jnp
-    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.models import llama, mixtral
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
+    moe = os.environ.get("DS_SERVE_MODEL") == "mixtral"
     if on_tpu:
-        cfg = llama.LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=8, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
-            dtype="bfloat16", remat=False)
+        if moe:  # sparse top-2 MoE serving leg (ragged_dot expert FFN)
+            cfg = mixtral.MixtralConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=6, num_attention_heads=16,
+                num_key_value_heads=8, max_position_embeddings=2048,
+                num_local_experts=8, num_experts_per_tok=2,
+                dtype="bfloat16", remat=False)
+        else:
+            cfg = llama.LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+                dtype="bfloat16", remat=False)
         n_seqs, prompt_len, new_tokens = 32, 256, 64
         sm = dict(max_tracked_sequences=64, max_ragged_batch_size=512,
                   max_ragged_sequence_count=64, max_context=1024,
                   block_size=128)
     else:
-        cfg = llama.llama_tiny(dtype="float32", remat=False)
+        cfg = (mixtral.mixtral_tiny(dtype="float32", remat=False) if moe
+               else llama.llama_tiny(dtype="float32", remat=False))
         n_seqs, prompt_len, new_tokens = 4, 16, 8
         sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
                   max_ragged_sequence_count=8, max_context=128,
@@ -816,7 +826,7 @@ def run_serve_bench(on_tpu: bool) -> dict:
     if os.environ.get("DS_SERVE_BURST") is not None:  # A/B fused decode
         econf["decode_burst"] = int(os.environ["DS_SERVE_BURST"])
 
-    model = llama.LlamaModel(cfg)
+    model = (mixtral.MixtralModel(cfg) if moe else llama.LlamaModel(cfg))
     rng = np.random.default_rng(0)
     ids0 = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0)["params"]
@@ -837,7 +847,7 @@ def run_serve_bench(on_tpu: bool) -> dict:
     dt = time.perf_counter() - t0
     generated = sum(len(o) for o in out)
     return {
-        "metric": "fastgen_serve_tokens_per_sec",
+        "metric": ("fastgen_serve_moe_tokens_per_sec" if moe else "fastgen_serve_tokens_per_sec"),
         "value": round(generated / dt, 1),
         "unit": (f"generated tokens/s (seqs={n_seqs} prompt={prompt_len} "
                  f"new={new_tokens} "

@@ -51,6 +51,7 @@ leg serve_atom0 env DS_SERVE_ATOM=0 python bench.py --mode serve
 leg serve_atom16 env DS_SERVE_ATOM=16 python bench.py --mode serve
 leg serve_burst0 env DS_SERVE_BURST=0 python bench.py --mode serve
 leg serve_burst32 env DS_SERVE_BURST=32 python bench.py --mode serve
+leg serve_moe env DS_SERVE_MODEL=mixtral python bench.py --mode serve
 
 # 5) MoE grouped-GEMM kernel A/B + BERT TFLOPS row
 leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
