@@ -149,6 +149,10 @@ POLICIES = {
     "phi": InjectionPolicy("phi", _phi_factory),
     "gpt2": InjectionPolicy("gpt2", _gpt2_factory),
     "distilbert": InjectionPolicy("distilbert", _distilbert_factory),
+    # llama-architecture aliases (reference ships a dedicated internlm
+    # container, module_inject/containers/internlm.py — same block layout)
+    "internlm": InjectionPolicy("internlm", _llama_factory),
+    "internlm2": InjectionPolicy("internlm2", _llama_factory),
 }
 
 
