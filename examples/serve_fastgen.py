@@ -43,6 +43,8 @@ def main():
     out = eng.generate(prompts, max_new_tokens=8)
     for i, toks in enumerate(out):
         print(f"seq {i}: +{len(toks)} tokens -> {toks}")
+    print(f"fused decode bursts used: {getattr(eng, 'burst_steps', 0)} "
+          "(decode_burst config; docs/inference.md)")
 
 
 if __name__ == "__main__":
