@@ -25,22 +25,19 @@ keep = [l for l in sys.stdin
 sys.stdout.write(keep[-1] if keep else "")' | tee "$OUT/$name.json"
 }
 
-# 1) head-dtype A/B on the headline model (bf16 default vs the old fp32)
-leg head_f32 env BENCH_HEAD_DTYPE=float32 python bench.py --mode device
-leg head_bf16 env BENCH_HEAD_DTYPE=bfloat16 python bench.py --mode device
-
-# 2) batch/remat frontier
-leg b6 env BENCH_BATCH=6 python bench.py --mode device
-leg s4096 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py --mode device
-
-# 3) grad dtype
-leg gradbf16 env BENCH_GRAD_DTYPE=bf16 python bench.py --mode device
-
-# 3b) fused chunked head+loss: frees the [B,S,V] logits HBM, may unlock
-# remat-free larger batch (the MFU frontier)
+# 1) fused chunked head+loss FIRST (highest-value: frees the [B,S,V]
+# logits HBM, may unlock remat-free larger batch — the MFU frontier)
 leg b4_fusedce env BENCH_LOSS_CHUNK=6400 python bench.py --mode device
 leg b6_fusedce env BENCH_BATCH=6 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
 leg b8_fusedce env BENCH_BATCH=8 BENCH_LOSS_CHUNK=6400 python bench.py --mode device
+
+# 2) batch/remat frontier without the fused CE
+leg b6 env BENCH_BATCH=6 python bench.py --mode device
+leg s4096 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py --mode device
+
+# 3) head/grad dtype A/Bs
+leg head_f32 env BENCH_HEAD_DTYPE=float32 python bench.py --mode device
+leg gradbf16 env BENCH_GRAD_DTYPE=bf16 python bench.py --mode device
 
 # 3c) gpt2 ladder leg: remat-off + chunked CE (the [B,S,50k] fp32 logits
 # are what force remat=True in the default leg)
