@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Summarize on-chip runs: ladder legs + sweeps, ranked, with suggested
+default folds.  Run after tools/bench_retry.sh has chained the sweeps.
+
+Usage: python tools/fold_sweeps.py
+"""
+
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read().strip().splitlines()[-1])
+        return rec if isinstance(rec, dict) and "metric" in rec else None
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def main():
+    runs = os.path.join(ROOT, ".bench_runs")
+    rows = []
+    for path in sorted(glob.glob(os.path.join(runs, "*.json")) +
+                       glob.glob(os.path.join(runs, "sweeps", "*.json"))):
+        rec = _load(path)
+        if rec is None:
+            continue
+        name = os.path.relpath(path, runs).replace(".json", "")
+        why = bench._untrustworthy(rec)
+        rows.append((name, rec, why))
+    if not rows:
+        print("no recorded runs yet (.bench_runs empty)")
+        return
+    for name, rec, why in rows:
+        flag = f"  [UNTRUSTED: {why}]" if why else ""
+        print(f"{name:18s} {rec['value']:>12} vs={rec['vs_baseline']:<7}"
+              f" {rec['unit'][:90]}{flag}")
+
+    # headline suggestion: best trustworthy device-mode MFU
+    device = [(n, r) for n, r, w in rows if w is None
+              and r["metric"].startswith("llama_train")]
+    if device:
+        best = max(device, key=lambda x: x[1]["vs_baseline"])
+        print(f"\nbest headline: {best[0]} vs_baseline="
+              f"{best[1]['vs_baseline']}")
+        if "sweeps/" in best[0]:
+            print("  → consider folding this leg's BENCH_* env into the "
+                  "bench defaults and re-warming the cache")
+
+
+if __name__ == "__main__":
+    main()
