@@ -20,3 +20,5 @@ measurement utility is the parity artifact.
 """
 
 from .overlap import DominoTransformerLayer, measure_tp_overlap
+from .transformer import (domino_ab, split_block_microstreams,
+                          split_microstreams)
