@@ -336,10 +336,51 @@ class InferenceEngineV2:
                          for s in seqs))
         if k < 2:
             return None
+        return self._run_burst(seqs, k, sample, temperature, top_k, top_p,
+                               seed)
+
+    def burst_decode(self, uids=None, max_tokens=16, do_sample=False,
+                     temperature=1.0, top_k=0, top_p=1.0, rng=None):
+        """Public fused-decode entry for reference-style serving loops
+        (``put``/``schedule_step`` callers): run up to ``max_tokens`` decode
+        iterations on device in one program for the given sequences and
+        return ``{uid: [tokens]}``.  Requires every targeted sequence to be
+        in pure decode (exactly one pending token) — raises otherwise, so a
+        scheduler can fall back to ``schedule_step``.  Sampling uses the
+        device PRNG path (seed-deterministic; pass ``rng`` as a seed)."""
+        sm = self.state_manager
+        if uids is None:
+            uids = [s.uid for s in sm.tracked_sequences.values()
+                    if not s.done]
+        seqs = []
+        for uid in uids:
+            seq = sm.get_sequence(uid)
+            if seq is None or seq.done:
+                raise ValueError(f"uid {uid!r} is not an active sequence")
+            if len(seq.tokens) - seq.seen_tokens != 1:
+                raise ValueError(
+                    f"uid {uid!r} is not in pure decode "
+                    f"({len(seq.pending())} pending tokens) — run "
+                    "schedule_step until prefill drains")
+            seqs.append(seq)
+        k = int(max_tokens)
+        cap = int(self._config.decode_burst or 0)
+        if cap > 1:   # an explicit call may exceed a DISABLED config, not
+            k = min(k, cap)   # a configured cap
+        if not seqs or k < 2:
+            return {}
+        if do_sample and isinstance(rng, np.random.Generator):
+            raise ValueError("burst_decode sampling needs a seed, not a "
+                             "numpy Generator (device PRNG stream)")
+        return self._run_burst(seqs, k, do_sample, temperature,
+                               top_k, top_p, rng)
+
+    def _run_burst(self, seqs, k, sample, temperature, top_k, top_p, seed):
         # quantize to the floor power of two: each distinct static k is its
         # own compiled program, so arbitrary k values would compile per
         # remaining-token count — pow2 bounds the variants to log2(cap)
         k = 1 << (k.bit_length() - 1)
+        sm = self.state_manager
         n = sm.max_seqs
         tok0 = np.zeros(n, np.int32)
         pos0 = np.zeros(n, np.int32)

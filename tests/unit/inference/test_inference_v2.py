@@ -397,3 +397,31 @@ def test_decode_burst_memory_flat_in_k():
             pytest.skip("backend exposes no memory_analysis")
         temp[k] = ma.temp_size_in_bytes
     assert temp[16] <= temp[4] * 1.25, temp
+
+
+def test_public_burst_decode_api():
+    """``burst_decode``: fused decode for reference-style put/schedule_step
+    loops — drains prefill via schedule_step, then bursts; rejects
+    sequences still in prefill."""
+    model, cfg, params = _model()
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, size=6).tolist()
+               for _ in range(2)]
+    ref = _v2_burst(model, params, burst=0).generate(prompts,
+                                                     max_new_tokens=9)
+
+    eng = _v2_burst(model, params, burst=8)
+    eng.put([0, 1], prompts)
+    with pytest.raises(ValueError, match="pure decode"):
+        eng.burst_decode([0], max_tokens=4)
+    got = {0: [], 1: []}
+    while not all(len(v) for v in got.values()):   # drain prefill
+        for uid, tok in eng.schedule_step().items():
+            got[uid].append(tok)
+            eng.state_manager.get_sequence(uid).tokens.append(tok)
+    while any(len(v) < 9 for v in got.values()):
+        for uid, toks in eng.burst_decode(max_tokens=4).items():
+            got[uid].extend(toks)
+    out = [got[0][:9], got[1][:9]]
+    assert out == ref
+    eng.flush([0, 1])
