@@ -1,0 +1,33 @@
+"""The bench's trust gate (``bench._untrustworthy``) decides which records
+may be cited as "last real-TPU run", folded into the README ladder, or kept
+by an A/B sweep — pin its semantics."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "..", "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def _rec(unit):
+    return {"metric": "m", "value": 1.0, "unit": unit, "vs_baseline": 1.0}
+
+
+def test_full_tpu_record_trusted():
+    assert bench._untrustworthy(_rec(
+        "tokens/s (B=4 S=2048 MFU=0.58 backend=tpu chunks_done=10/10)")) \
+        is None
+
+
+def test_provisional_and_fallback_records_rejected():
+    assert bench._untrustworthy(_rec("x backend=tpu [warmup-estimate]"))
+    assert bench._untrustworthy(_rec("x backend=tpu [partial 3/10]"))
+    assert bench._untrustworthy(_rec("x backend=tpu [timing-implausible]"))
+    assert bench._untrustworthy(_rec("x backend=cpu"))
+
+
+def test_implausible_flags_only_above_peak():
+    assert bench._implausible(198e12, 197e12)
+    assert not bench._implausible(150e12, 197e12)
