@@ -7,7 +7,7 @@ Public API mirrors the reference's ``deepspeed/__init__.py`` surface
 at ``:291``, ``add_config_arguments`` at ``:268``).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"   # keep in sync with version.txt (setup.py reads it)
 __git_branch__ = "main"
 
 from . import comm

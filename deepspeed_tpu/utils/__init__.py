@@ -1,6 +1,16 @@
 from .logging import log_dist, logger, print_json_dist, warning_once
 from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
 from . import groups
+
+
+def __getattr__(name):
+    # reference surface: ``deepspeed.utils.RepeatingLoader`` (utils/__init__
+    # re-exports it from runtime.dataloader); lazy here to avoid a
+    # utils ↔ runtime import cycle.  PrefetchLoader is the TPU extension.
+    if name in ("RepeatingLoader", "PrefetchLoader"):
+        from ..runtime import dataloader
+        return getattr(dataloader, name)
+    raise AttributeError(name)
 from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_grad,
                               safe_get_full_optimizer_state,
                               safe_get_local_fp32_param, safe_get_local_grad,
