@@ -179,3 +179,76 @@ def get_lr_scheduler(name, params, optimizer=None):
         raise ValueError(f"unknown lr schedule {name!r}; valid: "
                          f"{sorted(VALID_LR_SCHEDULES)}")
     return VALID_LR_SCHEDULES[name](optimizer=optimizer, **(params or {}))
+
+
+def add_tuning_arguments(parser):
+    """Reference ``lr_schedules.py:60``: argparse surface for LR-schedule
+    tuning from the command line (LR range test, OneCycle phases, warmup).
+    Values collected here feed :func:`get_config_from_args`."""
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    # LR range test
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_momentum", type=bool, default=False)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
+    return parser
+
+
+_SCHED_ARG_PREFIXES = {
+    "LRRangeTest": ("lr_range_test_", ),
+    "OneCycle": ("cycle_", "decay_"),
+    "WarmupLR": ("warmup_", ),
+    "WarmupDecayLR": ("warmup_", ),
+    "WarmupCosineLR": ("warmup_", ),
+}
+
+
+def get_config_from_args(args):
+    """Reference ``lr_schedules.py:208``: build the scheduler config dict
+    from parsed args; returns ``(config, None)`` or ``(None, reason)``."""
+    if not hasattr(args, "lr_schedule") or args.lr_schedule is None:
+        return None, "--lr_schedule not specified on command line"
+    if args.lr_schedule not in VALID_LR_SCHEDULES:
+        return None, (f"{args.lr_schedule!r} is not a valid LR schedule "
+                      f"(valid: {sorted(VALID_LR_SCHEDULES)})")
+    params = {}
+    prefixes = _SCHED_ARG_PREFIXES[args.lr_schedule]
+    for key, value in vars(args).items():
+        if any(key.startswith(p) for p in prefixes):
+            params[key] = value
+    return {"type": args.lr_schedule, "params": params}, None
+
+
+def get_lr_from_config(config):
+    """Reference ``lr_schedules.py:229``: the schedule's headline lr."""
+    if "type" not in config:
+        return None, "no type (LR schedule name) specified in config"
+    name, params = config["type"], config.get("params", {})
+    if name not in VALID_LR_SCHEDULES:
+        return None, f"{name!r} is not a valid LR schedule"
+    if name == "LRRangeTest":
+        return params.get("lr_range_test_min_lr", 0.001), ""
+    if name == "OneCycle":
+        return params.get("cycle_max_lr", 0.1), ""
+    return params.get("warmup_max_lr", 0.001), ""

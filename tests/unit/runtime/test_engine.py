@@ -784,3 +784,22 @@ def test_dataloader_worker_prefetch_order_and_prefetch_loader():
     bad = PrefetchLoader(DeepSpeedDataLoader(Boom(), batch_size=2))
     with pytest.raises(RuntimeError, match="boom"):
         list(bad)
+
+
+def test_lr_schedule_tuning_args_surface():
+    """Reference lr_schedules.py:60/208/229 CLI surface parity."""
+    import argparse
+    from deepspeed_tpu.runtime import lr_schedules as L
+    p = argparse.ArgumentParser()
+    L.add_tuning_arguments(p)
+    args = p.parse_args(["--lr_schedule", "OneCycle",
+                         "--cycle_min_lr", "0.02", "--decay_lr_rate", "0.1"])
+    cfg, err = L.get_config_from_args(args)
+    assert err is None
+    assert cfg["type"] == "OneCycle"
+    assert cfg["params"]["cycle_min_lr"] == 0.02
+    assert cfg["params"]["decay_lr_rate"] == 0.1
+    lr, _ = L.get_lr_from_config(cfg)
+    assert lr == cfg["params"]["cycle_max_lr"]
+    bad, err = L.get_config_from_args(p.parse_args([]))
+    assert bad is None and "not specified" in err
