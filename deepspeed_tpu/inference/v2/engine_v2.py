@@ -189,6 +189,7 @@ class InferenceEngineV2:
         slots = np.zeros(T, np.int32)  # slot 0 → garbage block
         finishing = []  # (seq, buffer index of its last scheduled token)
         placed = 0
+        deferred = 0    # sequences the KV pool could not grow this step
 
         d_cur = 0                      # decode-region cursor
         p_cur = decode_cap             # prefill-region cursor (atom-aligned)
@@ -222,6 +223,14 @@ class InferenceEngineV2:
                 if room <= 0:
                     break
             take = min(len(pending), room)
+            # KV-pool pressure: schedule only what the free blocks can hold
+            # (the reference scheduler's deferral; a dry pool must not crash
+            # the step — blocks free as other sequences flush)
+            take = min(take, sm.schedulable_tokens(
+                seq, seq.seen_tokens + take))
+            if take <= 0:
+                deferred += 1
+                continue
             sm.ensure_capacity(seq, seq.seen_tokens + take)
             toks[start:start + take] = pending[:take]
             pos[start:start + take] = np.arange(
@@ -240,6 +249,14 @@ class InferenceEngineV2:
             else:
                 d_cur += take
         if placed == 0:
+            if deferred:
+                # nothing schedulable AND nothing in flight to free blocks:
+                # deferring forever would spin — surface the exhaustion
+                raise RuntimeError(
+                    f"KV cache exhausted: {deferred} sequence(s) deferred "
+                    f"with 0 schedulable tokens and no other work in "
+                    f"flight — raise state_manager.num_blocks, lower "
+                    f"concurrency, or flush finished sequences")
             return None
         last_idx = np.zeros(sm.max_seqs, dtype=np.int32)
         for seq, idx in finishing:
@@ -372,15 +389,31 @@ class InferenceEngineV2:
         if do_sample and isinstance(rng, np.random.Generator):
             raise ValueError("burst_decode sampling needs a seed, not a "
                              "numpy Generator (device PRNG stream)")
+        # None = the KV pool can't afford a burst right now → empty result;
+        # the caller's schedule_step path defers until blocks free
         return self._run_burst(seqs, k, do_sample, temperature,
-                               top_k, top_p, rng)
+                               top_k, top_p, rng) or {}
 
     def _run_burst(self, seqs, k, sample, temperature, top_k, top_p, seed):
+        sm = self.state_manager
+        # KV-pool pressure: a burst pre-allocates k positions per sequence
+        # from the SHARED free pool — shrink k until the total new-block
+        # demand fits, falling back to the per-step scheduler (which
+        # defers) below 2
+
+        def _new_blocks(kk):
+            return sum(
+                max(0, sm.kv_cache.blocks_for(s.seen_tokens + kk)
+                    - len(s.blocks)) for s in seqs)
+
+        while k >= 2 and _new_blocks(k) > sm.free_blocks:
+            k //= 2
+        if k < 2:
+            return None
         # quantize to the floor power of two: each distinct static k is its
         # own compiled program, so arbitrary k values would compile per
         # remaining-token count — pow2 bounds the variants to log2(cap)
         k = 1 << (k.bit_length() - 1)
-        sm = self.state_manager
         n = sm.max_seqs
         tok0 = np.zeros(n, np.int32)
         pos0 = np.zeros(n, np.int32)

@@ -133,6 +133,21 @@ class DSStateManager:
             self.block_table[seq.slot, len(seq.blocks)] = blk
             seq.blocks.append(blk)
 
+    def schedulable_tokens(self, seq: DSSequenceDescriptor, want_total):
+        """How many of the tokens up to ``want_total`` can be scheduled with
+        the blocks this sequence holds plus the allocator's free pool (the
+        reference scheduler's can-schedule check — a sequence the pool
+        cannot grow defers instead of crashing the engine step).  Raises
+        only for the max_context user error."""
+        if self.kv_cache.blocks_for(want_total) > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"sequence {seq.uid} exceeds max_context "
+                f"({want_total} tokens > "
+                f"{self.max_blocks_per_seq * self.kv_cache.block_size})")
+        affordable = ((len(seq.blocks) + self.free_blocks)
+                      * self.kv_cache.block_size)
+        return max(0, min(want_total, affordable) - seq.seen_tokens)
+
     def flush_sequence(self, uid):
         """Release a sequence (reference ``flush``)."""
         seq = self._seqs.pop(uid, None)
