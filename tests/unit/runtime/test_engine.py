@@ -803,3 +803,29 @@ def test_lr_schedule_tuning_args_surface():
     assert lr == cfg["params"]["cycle_max_lr"]
     bad, err = L.get_config_from_args(p.parse_args([]))
     assert bad is None and "not specified" in err
+
+
+def test_initialize_training_data_returns_loader():
+    """``initialize(training_data=...)`` must hand back a loader sized to
+    the GLOBAL effective micro batch (reference engine.py:294 wiring)."""
+    params = make_simple_mlp_params(HIDDEN)
+
+    class DS:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return (np.zeros((HIDDEN, ), np.float32),
+                    np.zeros((HIDDEN, ), np.float32))
+
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        training_data=DS(), config=_config(mb=4))
+    assert loader is not None
+    bs = 4 * engine.dp_world_size
+    x, y = next(iter(loader))
+    assert x.shape == (bs, HIDDEN)
+    # and the engine consumes it directly
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
