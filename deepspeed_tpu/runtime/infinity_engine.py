@@ -393,23 +393,45 @@ class InfinityEngine(DeepSpeedEngine):
         tag = tag or f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag), "infinity_state.pkl")
         ensure_directory_exists(path)
-        with open(path, "wb") as f:
-            pickle.dump({
-                "master": self._store.export_master(),
-                "opt": self._store.export_state(),
-                "global_steps": self.global_steps,
-                "global_samples": self.global_samples,
-                "micro_steps": self.micro_steps,
-                "lr_scheduler": (self.lr_scheduler.state_dict()
-                                 if self.lr_scheduler is not None and
-                                 hasattr(self.lr_scheduler, "state_dict")
-                                 else None),
-                "client_state": client_state or {},
-            }, f)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+        # snapshot NOW (export_* deep-copies): the next optimizer_sweep may
+        # mutate the host store while an async writer is mid-dump
+        state = {
+            "master": self._store.export_master(),
+            "opt": self._store.export_state(),
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None and
+                             hasattr(self.lr_scheduler, "state_dict")
+                             else None),
+            "client_state": client_state or {},
+        }
+
+        def write():
+            with open(path, "wb") as f:
+                pickle.dump(state, f)
+            if save_latest:
+                # deferred 'latest': only a fully-written checkpoint may
+                # become the resume target (same contract as the async
+                # orbax path in runtime/checkpoint_engine.py)
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+
+        if async_save:
+            import threading
+            self.wait_for_checkpoint()
+            self._ckpt_thread = threading.Thread(target=write, daemon=False)
+            self._ckpt_thread.start()
+        else:
+            write()
         return path
+
+    def wait_for_checkpoint(self):
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
 
     def load_checkpoint(self, load_dir, tag=None, **kw):
         import os

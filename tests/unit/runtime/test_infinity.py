@@ -360,3 +360,33 @@ def test_universal_checkpoint_bridge(tmp_path):
     assert eng2.global_steps == 3
     got2 = _train(eng2, data, steps=2)
     np.testing.assert_allclose(got2, ref, rtol=1e-4)
+
+
+def test_async_save_snapshot_isolation(tmp_path):
+    """Async streamed-engine save: the snapshot is taken synchronously, so
+    training steps racing the writer do not corrupt the checkpoint, and
+    'latest' appears only after the write completes."""
+    cfg = _tiny_cfg(layers=2)
+    params = _host_params(cfg, 2)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config=_config("cpu"))
+    bs = 2 * eng.dp_world_size
+    data = _data(cfg, bs)
+    _train(eng, data, steps=2)
+    want = {k: {kk: vv.copy() for kk, vv in t.items()} if isinstance(t, dict)
+            else t for k, t in eng._store.export_master().items()}
+    eng.save_checkpoint(str(tmp_path), tag="a", async_save=True)
+    _train(eng, data, steps=2)          # mutates host state mid-write
+    eng.wait_for_checkpoint()
+    assert (tmp_path / "latest").read_text() == "a"
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config=_config("cpu"))
+    eng2.load_checkpoint(str(tmp_path))
+    got = eng2._store.export_master()
+    for k in want:
+        w = jax.tree_util.tree_leaves(want[k])
+        g = jax.tree_util.tree_leaves(got[k])
+        for a, b in zip(w, g):
+            np.testing.assert_array_equal(a, b)
