@@ -93,9 +93,29 @@ def _convert_infinity(root, output_dir):
                 np.save(os.path.join(zero_root, prefix + name, f"{uni}.npy"),
                         np.asarray(arr, dtype=np.float32))
 
+    engine_state = {k: state.get(k, 0) for k in
+                    ("global_steps", "global_samples", "micro_steps")}
+    # Carry lr_scheduler + client_state through (infinity_state.pkl stores
+    # both): the monolithic universal load restores es['lr_scheduler'] /
+    # es['client_state'], so dropping them here would silently restart the
+    # LR schedule on a streamed→universal→monolithic resume.  Universal
+    # meta is JSON, so anything non-serializable is dropped with a warning.
+    for key in ("lr_scheduler", "client_state"):
+        val = state.get(key)
+        if not val:
+            continue
+        try:
+            # numpy scalars (e.g. a last_batch_iteration that picked up
+            # np.int64 through arithmetic) coerce via .item() instead of
+            # dropping the whole subtree
+            engine_state[key] = json.loads(
+                json.dumps(val, default=lambda o: o.item()))
+        except (TypeError, ValueError, AttributeError):
+            from ..utils.logging import logger
+            logger.warning(f"infinity checkpoint {key} is not "
+                           "JSON-serializable; omitted from universal meta")
     meta_out = {
-        "engine_state": {k: state.get(k, 0) for k in
-                         ("global_steps", "global_samples", "micro_steps")},
+        "engine_state": engine_state,
         "step": int(opt.get("step_count", state.get("global_steps", 0))),
         "params": param_meta,
     }

@@ -25,7 +25,8 @@ def _load_param_file(zero_root, name, key):
     return np.load(path)
 
 
-def _load_into_infinity(engine, tag, meta, zero_root, load_opt, path_str):
+def _load_into_infinity(engine, tag, meta, zero_root, load_opt, load_sched,
+                        path_str):
     """Universal checkpoint → ``InfinityEngine`` host BlockStore: per-param
     fp32/exp_avg/exp_avg_sq files reassemble into per-group master pytrees
     and flat state vectors, so a monolithic-engine run (any ZeRO stage) can
@@ -79,6 +80,13 @@ def _load_into_infinity(engine, tag, meta, zero_root, load_opt, path_str):
     engine.global_steps = es.get("global_steps", engine.global_steps)
     engine.global_samples = es.get("global_samples", engine.global_samples)
     engine.micro_steps = es.get("micro_steps", engine.micro_steps)
+    if load_sched and engine.lr_scheduler is not None and \
+            es.get("lr_scheduler") is not None and \
+            hasattr(engine.lr_scheduler, "load_state_dict"):
+        # mirror the monolithic branch (and the native infinity load):
+        # universal checkpoints converted from monolithic engines carry
+        # engine_state['lr_scheduler'] — without this the schedule restarts.
+        engine.lr_scheduler.load_state_dict(es["lr_scheduler"])
     engine._dev_resident = None
     engine._dev_blocks.clear()
     engine._pending_fetch.clear()
@@ -106,7 +114,8 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
     if hasattr(engine, "_store"):
         # ZeRO-Infinity streamed engine: repopulate the host BlockStore
         return _load_into_infinity(engine, tag, meta, zero_root,
-                                   load_optimizer_states, path_str)
+                                   load_optimizer_states,
+                                   load_lr_scheduler_states, path_str)
 
     # ---- parameters (and fp32 master when the engine keeps one)
     def build(template_tree, shardings, dtype=None):
@@ -174,9 +183,12 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
     engine.micro_steps = es.get("micro_steps", engine.micro_steps)
     engine.skipped_steps = es.get("skipped_steps", engine.skipped_steps)
     if engine.scale_state is not None and "loss_scale" in es:
-        engine.scale_state = engine.scale_state._replace(
-            scale=jnp.asarray(es["loss_scale"],
-                              dtype=engine.scale_state.scale.dtype))
+        from ..runtime.loss_scaler import commit_scale_state
+        engine.scale_state = commit_scale_state(
+            engine.mesh,
+            engine.scale_state._replace(
+                scale=jnp.asarray(es["loss_scale"],
+                                  dtype=engine.scale_state.scale.dtype)))
     if load_lr_scheduler_states and engine.lr_scheduler is not None and \
             "lr_scheduler" in es and \
             hasattr(engine.lr_scheduler, "load_state_dict"):

@@ -230,7 +230,10 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
     engine.micro_steps = state["micro_steps"]
     engine.skipped_steps = state["skipped_steps"]
     import jax.numpy as jnp
-    engine.scale_state = engine.scale_state._replace(
-        scale=jnp.asarray(state["loss_scale"], jnp.float32))
+    from .loss_scaler import commit_scale_state
+    engine.scale_state = commit_scale_state(
+        engine.mesh,
+        engine.scale_state._replace(
+            scale=jnp.asarray(state["loss_scale"], jnp.float32)))
     log_dist(f"loaded checkpoint {root}", ranks=[0])
     return root, state.get("client_state", {})

@@ -129,23 +129,50 @@ class PrefetchLoader:
         import threading
         q = queue.Queue(maxsize=self.depth)
         END = object()
+        stop = threading.Event()
+
+        def put(item):
+            # Bounded-queue put that gives up once the consumer is gone:
+            # a plain q.put blocks forever if iteration is abandoned
+            # (break / exception / GC), pinning `depth` batches per epoch.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def fill():
             try:
                 for item in self.loader:
-                    q.put(item)
-                q.put(END)
+                    if not put(item):
+                        return
+                put(END)
             except BaseException as e:       # noqa: BLE001 — re-raised below
-                q.put(e)
+                put(e)
 
-        threading.Thread(target=fill, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is END:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Runs on StopIteration AND on GeneratorExit/break: release the
+            # filler (it checks `stop` between bounded puts) and drain so it
+            # is never parked on a full queue.
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
 
 
 class RepeatingLoader:

@@ -384,14 +384,12 @@ class DeepSpeedEngine:
         # stashed grads (already cast + sharded by the micro-step) become the
         # accumulator, so gas=1 never materializes a second grad buffer.
         self.grad_acc = None
-        # Commit the scale state to the mesh (replicated) at init: freshly
-        # created jnp scalars carry UnspecifiedValue sharding, while the
-        # boundary-step outputs that replace them after step 1 carry
-        # NamedSharding(P()) — jit treats that as a new signature and
-        # recompiles the ENTIRE micro step on the second call (observed as
-        # two 33MB jit_micro executables / 2× tunnel compile time, r4).
-        self.scale_state = jax.device_put(
-            self.loss_scaler.init(), NamedSharding(self.mesh, P()))
+        # Replicated commit avoids the 2nd-call full micro-step recompile
+        # (observed as two 33MB jit_micro executables / 2× tunnel compile
+        # time, r4) — see commit_scale_state.
+        from .loss_scaler import commit_scale_state
+        self.scale_state = commit_scale_state(self.mesh,
+                                              self.loss_scaler.init())
 
     def initialize_parameters(self, rng_or_seed, *sample_inputs, **kw):
         """Flax path: init params on the engine's mesh (zero.Init analog —

@@ -19,6 +19,18 @@ class LossScaleState(NamedTuple):
     hysteresis: jnp.ndarray      # i32: remaining tolerated overflows before shrink
 
 
+def commit_scale_state(mesh, state):
+    """Device-put a ``LossScaleState`` replicated onto ``mesh``.
+
+    Freshly created / host-loaded jnp scalars carry UnspecifiedValue
+    sharding, while the jitted step's outputs carry ``NamedSharding(P())``
+    — jit treats that as a new signature and recompiles the ENTIRE micro
+    step on the next call.  Every path that (re)creates the scale state
+    (engine init, checkpoint load, universal load) must go through here."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.device_put(state, NamedSharding(mesh, P()))
+
+
 class StaticLossScaler:
     """Reference ``LossScaler`` — fixed scale, never updates."""
 

@@ -786,6 +786,33 @@ def test_dataloader_worker_prefetch_order_and_prefetch_loader():
         list(bad)
 
 
+def test_prefetch_loader_abandoned_iteration_releases_filler():
+    """r5 (ADVICE r4): breaking out of a PrefetchLoader epoch must terminate
+    the filler thread — a blocked q.put would otherwise leak one thread plus
+    `depth` pinned batches per abandoned epoch."""
+    import threading
+    import time
+
+    from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+
+    before = set(threading.enumerate())
+    src = [np.full((2, ), i, np.int32) for i in range(64)]
+    pf = PrefetchLoader(src, depth=2)
+    for _ in range(8):          # many abandoned epochs
+        for i, b in enumerate(pf):
+            if i == 1:
+                break
+    leaked = [t for t in threading.enumerate() if t not in before]
+    deadline = time.monotonic() + 10
+    while any(t.is_alive() for t in leaked) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    alive = [t for t in leaked if t.is_alive()]
+    assert not alive, f"{len(alive)} filler threads leaked"
+    # a completed epoch still yields everything, in order
+    got = [int(b[0]) for b in pf]
+    assert got == list(range(64))
+
+
 def test_lr_schedule_tuning_args_surface():
     """Reference lr_schedules.py:60/208/229 CLI surface parity."""
     import argparse

@@ -362,6 +362,72 @@ def test_universal_checkpoint_bridge(tmp_path):
     np.testing.assert_allclose(got2, ref, rtol=1e-4)
 
 
+def test_universal_bridge_lr_scheduler_and_client_state(tmp_path):
+    """r5 (ADVICE r4): the streamed→universal converter must carry
+    lr_scheduler + client_state so a streamed→universal→monolithic resume
+    keeps the LR schedule, and the universal→streamed load must honor the
+    scheduler the monolithic converter recorded (both directions)."""
+    import json as _json
+
+    from deepspeed_tpu.checkpoint.constants import UNIVERSAL_META
+    from deepspeed_tpu.checkpoint.ds_to_universal import convert_to_universal
+    from deepspeed_tpu.checkpoint.universal_checkpoint import (
+        load_universal_checkpoint)
+
+    sched = {"scheduler": {"type": "WarmupLR",
+                           "params": {"warmup_min_lr": 0.0,
+                                      "warmup_max_lr": 0.01,
+                                      "warmup_num_steps": 10}}}
+    cfg = _tiny_cfg(layers=2)
+    params = _host_params(cfg, 2)
+
+    # --- streamed → universal → monolithic
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config={**_config("cpu"), **sched})
+    bs = 2 * eng.dp_world_size
+    data = _data(cfg, bs)
+    _train(eng, data, steps=3)
+    it_saved = eng.lr_scheduler.last_batch_iteration
+    ck = tmp_path / "ck"
+    eng.save_checkpoint(str(ck), tag="t",
+                        client_state={"note": "r5-bridge"})
+    uni = tmp_path / "uni"
+    convert_to_universal(str(ck), str(uni), tag="t")
+    meta = _json.load(open(uni / UNIVERSAL_META))
+    assert meta["engine_state"]["lr_scheduler"] == \
+        {"last_batch_iteration": it_saved}
+    assert meta["engine_state"]["client_state"] == {"note": "r5-bridge"}
+
+    mono, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config={**_config(None), **sched})
+    _, client = load_universal_checkpoint(mono, str(uni))
+    assert mono.lr_scheduler.last_batch_iteration == it_saved
+    assert client == {"note": "r5-bridge"}
+
+    # --- monolithic → universal → streamed (fix: _load_into_infinity
+    # previously never restored the scheduler)
+    _train(mono, data, steps=1)
+    it2 = mono.lr_scheduler.last_batch_iteration
+    ck2 = tmp_path / "ck2"
+    mono.save_checkpoint(str(ck2), tag="t2")
+    uni2 = tmp_path / "uni2"
+    convert_to_universal(str(ck2), str(uni2), tag="t2")
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config={**_config("cpu"), **sched})
+    load_universal_checkpoint(eng2, str(uni2))
+    assert eng2.lr_scheduler.last_batch_iteration == it2
+    # disabling the flag must leave the fresh scheduler untouched
+    eng3, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=_host_params(cfg, bs),
+        config={**_config("cpu"), **sched})
+    load_universal_checkpoint(eng3, str(uni2),
+                              load_lr_scheduler_states=False)
+    assert eng3.lr_scheduler.last_batch_iteration == -1
+
+
 def test_async_save_snapshot_isolation(tmp_path):
     """Async streamed-engine save: the snapshot is taken synchronously, so
     training steps racing the writer do not corrupt the checkpoint, and
