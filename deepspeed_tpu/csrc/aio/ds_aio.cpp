@@ -151,7 +151,15 @@ class AioHandle {
     void run_chunk(Chunk& chunk) {
         int flags = chunk.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
 #ifdef O_DIRECT
-        if (o_direct_) flags |= O_DIRECT;
+        // same per-request contract as the io_uring engine: O_DIRECT only
+        // when (buffer, offset, length) are 4KiB-aligned, silent buffered
+        // fallback otherwise — an unaligned request must not EINVAL just
+        // because this engine was selected
+        constexpr int64_t kAlign = 4096;
+        if (o_direct_ && chunk.count > 0 &&
+            reinterpret_cast<uintptr_t>(chunk.buf) % kAlign == 0 &&
+            chunk.offset % kAlign == 0 && chunk.count % kAlign == 0)
+            flags |= O_DIRECT;
 #endif
         bool failed = false;
         int fd = ::open(chunk.path.c_str(), flags, 0644);

@@ -2,6 +2,17 @@
 
 Reference: ``csrc/aio/py_lib/deepspeed_py_io_handle.cpp`` (``aio_handle``
 with pread/pwrite/async variants) + ``op_builder/async_io.py``.
+
+Two native engines behind one handle API:
+
+* ``uring`` — io_uring queue-depth engine (``csrc/aio/ds_aio_uring.cpp``),
+  the analog of the reference's libaio ``deepspeed_aio_thread.cpp``: one
+  driver thread keeps ``queue_depth`` block-sized ops in flight in the
+  kernel's async submission path.  Default when the kernel allows it.
+* ``threads`` — portable thread-pool fallback (``csrc/aio/ds_aio.cpp``).
+
+``engine="auto"`` probes io_uring once per process and falls back cleanly
+(containers often disable io_uring via seccomp/sysctl).
 """
 
 import ctypes
@@ -10,11 +21,13 @@ import numpy as np
 
 from .op_builder import NativeOpBuilder, register_op_builder
 
+_URING_ALIGN = 4096
+
 
 @register_op_builder
 class AsyncIOBuilder(NativeOpBuilder):
     NAME = "async_io"
-    SOURCES = ("csrc/aio/ds_aio.cpp", )
+    SOURCES = ("csrc/aio/ds_aio.cpp", "csrc/aio/ds_aio_uring.cpp")
     EXTRA_CFLAGS = ("-pthread", )
     EXTRA_LDFLAGS = ("-pthread", )
 
@@ -24,38 +37,110 @@ class AsyncIOBuilder(NativeOpBuilder):
         lib.ds_aio_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int,
                                           ctypes.c_int, ctypes.c_int]
         lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
-        for fn in (lib.ds_aio_submit_read, lib.ds_aio_submit_write):
-            fn.restype = ctypes.c_int64
-            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
-                           ctypes.c_int64, ctypes.c_int64]
-        lib.ds_aio_wait.restype = ctypes.c_int
-        lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.ds_aio_pending.restype = ctypes.c_int64
-        lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
-        for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
-            fn.restype = ctypes.c_int
-            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
-                           ctypes.c_int64, ctypes.c_int64]
+        lib.ds_uring_available.restype = ctypes.c_int
+        lib.ds_uring_handle_new.restype = ctypes.c_void_p
+        lib.ds_uring_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                            ctypes.c_int]
+        lib.ds_uring_handle_free.argtypes = [ctypes.c_void_p]
+        for prefix in ("ds_aio", "ds_uring"):
+            for op in ("submit_read", "submit_write"):
+                fn = getattr(lib, f"{prefix}_{op}")
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int64]
+            wait = getattr(lib, f"{prefix}_wait")
+            wait.restype = ctypes.c_int
+            wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            pend = getattr(lib, f"{prefix}_pending")
+            pend.restype = ctypes.c_int64
+            pend.argtypes = [ctypes.c_void_p]
+            for op in ("pread", "pwrite"):
+                fn = getattr(lib, f"{prefix}_{op}")
+                fn.restype = ctypes.c_int
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int64]
         return lib
+
+
+def uring_available():
+    """True when the kernel accepts io_uring_setup (it may be compiled in
+    but disabled by sysctl/seccomp, common in containers)."""
+    try:
+        return bool(AsyncIOBuilder().load().ds_uring_available())
+    except Exception:
+        return False
+
+
+def aio_aligned_empty(shape, dtype, align=_URING_ALIGN):
+    """Like ``np.empty`` but with the buffer start aligned to ``align``
+    bytes, qualifying it for O_DIRECT transfers (reference: the pinned
+    aligned buffers of ``deepspeed_py_aio_handle``)."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    raw = np.empty(nbytes + align, dtype=np.uint8)
+    start = (-raw.ctypes.data) % align
+    return raw[start:start + nbytes].view(dtype).reshape(shape)
 
 
 class AIOHandle:
     """The reference's ``aio_handle`` (queue_depth × block_size parallel
-    submission, single/submit/wait API) over the native thread pool."""
+    submission, single/submit/wait API) over a native engine.
+
+    ``engine``: "auto" (io_uring if the kernel allows, else thread pool),
+    "uring", or "threads".  ``o_direct`` applies per-request when buffer,
+    offset and length are all 4 KiB-aligned (see ``aio_aligned_empty``)."""
 
     def __init__(self, block_size=1 << 20, queue_depth=32, thread_count=4,
-                 single_submit=False, overlap_events=True):
+                 single_submit=False, overlap_events=True, engine="auto",
+                 o_direct=False):
         self._lib = AsyncIOBuilder().load()
-        self._h = self._lib.ds_aio_handle_new(block_size, queue_depth,
-                                              thread_count, 0)
+        self._h = None
+        if engine not in ("auto", "uring", "threads"):
+            raise ValueError(f"unknown aio engine {engine!r}")
+        use_uring = engine in ("auto", "uring") and \
+            bool(self._lib.ds_uring_available())
+        if engine == "uring" and not use_uring:
+            raise RuntimeError("io_uring unavailable on this kernel "
+                               "(disabled by sysctl/seccomp?)")
+        if use_uring:
+            h = self._lib.ds_uring_handle_new(block_size, queue_depth,
+                                              1 if o_direct else 0)
+            if not h and engine == "uring":
+                raise RuntimeError("io_uring ring setup failed")
+            use_uring = bool(h)
+        if use_uring:
+            self.engine = "uring"
+            self._h = h
+            self._free = self._lib.ds_uring_handle_free
+            self._sread = self._lib.ds_uring_submit_read
+            self._swrite = self._lib.ds_uring_submit_write
+            self._wait = self._lib.ds_uring_wait
+            self._pending = self._lib.ds_uring_pending
+            self._read = self._lib.ds_uring_pread
+            self._write = self._lib.ds_uring_pwrite
+        else:
+            self.engine = "threads"
+            self._h = self._lib.ds_aio_handle_new(block_size, queue_depth,
+                                                  thread_count,
+                                                  1 if o_direct else 0)
+            self._free = self._lib.ds_aio_handle_free
+            self._sread = self._lib.ds_aio_submit_read
+            self._swrite = self._lib.ds_aio_submit_write
+            self._wait = self._lib.ds_aio_wait
+            self._pending = self._lib.ds_aio_pending
+            self._read = self._lib.ds_aio_pread
+            self._write = self._lib.ds_aio_pwrite
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.thread_count = thread_count
+        self._live = {}  # request id → buffer (pin across async I/O)
 
     def __del__(self):
         try:
             if getattr(self, "_h", None):
-                self._lib.ds_aio_handle_free(self._h)
+                self._free(self._h)
                 self._h = None
         except Exception:
             pass
@@ -69,33 +154,36 @@ class AIOHandle:
     # --- synchronous
     def read(self, arr: np.ndarray, path, offset=0):
         ptr, nbytes = self._buf(arr)
-        rc = self._lib.ds_aio_pread(self._h, str(path).encode(), ptr, nbytes,
-                                    offset)
+        rc = self._read(self._h, str(path).encode(), ptr, nbytes, offset)
         if rc != 0:
             raise IOError(f"aio read failed: {path}")
 
     def write(self, arr: np.ndarray, path, offset=0):
         ptr, nbytes = self._buf(arr)
-        rc = self._lib.ds_aio_pwrite(self._h, str(path).encode(), ptr, nbytes,
-                                     offset)
+        rc = self._write(self._h, str(path).encode(), ptr, nbytes, offset)
         if rc != 0:
             raise IOError(f"aio write failed: {path}")
 
-    # --- asynchronous
+    # --- asynchronous.  The handle pins the buffer until wait() — dropping
+    # the caller's reference mid-flight must not free memory the kernel is
+    # still DMA-ing into (the reference pins via its aligned bounce buffers).
     def async_read(self, arr: np.ndarray, path, offset=0):
         ptr, nbytes = self._buf(arr)
-        return self._lib.ds_aio_submit_read(self._h, str(path).encode(), ptr,
-                                            nbytes, offset)
+        rid = self._sread(self._h, str(path).encode(), ptr, nbytes, offset)
+        self._live[rid] = arr
+        return rid
 
     def async_write(self, arr: np.ndarray, path, offset=0):
         ptr, nbytes = self._buf(arr)
-        return self._lib.ds_aio_submit_write(self._h, str(path).encode(),
-                                             ptr, nbytes, offset)
+        rid = self._swrite(self._h, str(path).encode(), ptr, nbytes, offset)
+        self._live[rid] = arr
+        return rid
 
     def wait(self, request_id):
-        rc = self._lib.ds_aio_wait(self._h, request_id)
+        rc = self._wait(self._h, request_id)
+        self._live.pop(request_id, None)
         if rc != 0:
             raise IOError(f"aio request {request_id} failed (rc={rc})")
 
     def pending(self):
-        return self._lib.ds_aio_pending(self._h)
+        return self._pending(self._h)

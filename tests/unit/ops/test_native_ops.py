@@ -6,7 +6,8 @@ import os
 import numpy as np
 import pytest
 
-from deepspeed_tpu.ops.aio import AIOHandle, AsyncIOBuilder
+from deepspeed_tpu.ops.aio import (AIOHandle, AsyncIOBuilder,
+                                   aio_aligned_empty, uring_available)
 from deepspeed_tpu.ops.cpu_optimizers import (CPUAdamBuilder,
                                               DeepSpeedCPUAdagrad,
                                               DeepSpeedCPUAdam,
@@ -19,8 +20,13 @@ pytestmark = pytest.mark.skipif(
 
 
 # ------------------------------------------------------------------ aio
-def test_aio_roundtrip(tmp_path):
-    h = AIOHandle(block_size=4096, thread_count=4)
+ENGINES = ["threads"] + (["uring"] if uring_available() else [])
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_aio_roundtrip(tmp_path, engine):
+    h = AIOHandle(block_size=4096, thread_count=4, engine=engine)
+    assert h.engine == engine
     data = np.random.default_rng(0).standard_normal(100000).astype(np.float32)
     path = tmp_path / "t.bin"
     h.write(data, path)
@@ -29,8 +35,9 @@ def test_aio_roundtrip(tmp_path):
     np.testing.assert_array_equal(out, data)
 
 
-def test_aio_async_overlap(tmp_path):
-    h = AIOHandle(block_size=1 << 16, thread_count=4)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_aio_async_overlap(tmp_path, engine):
+    h = AIOHandle(block_size=1 << 16, thread_count=4, engine=engine)
     arrays = [np.full(50000, i, np.float32) for i in range(8)]
     reqs = [h.async_write(a, tmp_path / f"{i}.bin")
             for i, a in enumerate(arrays)]
@@ -45,8 +52,9 @@ def test_aio_async_overlap(tmp_path):
         np.testing.assert_array_equal(b, arrays[i])
 
 
-def test_aio_offset_io(tmp_path):
-    h = AIOHandle()
+@pytest.mark.parametrize("engine", ENGINES)
+def test_aio_offset_io(tmp_path, engine):
+    h = AIOHandle(engine=engine)
     path = tmp_path / "o.bin"
     base = np.arange(1000, dtype=np.float32)
     h.write(base, path)
@@ -55,10 +63,54 @@ def test_aio_offset_io(tmp_path):
     np.testing.assert_array_equal(chunk, base[100:200])
 
 
-def test_aio_read_missing_file_raises(tmp_path):
-    h = AIOHandle()
+@pytest.mark.parametrize("engine", ENGINES)
+def test_aio_read_missing_file_raises(tmp_path, engine):
+    h = AIOHandle(engine=engine)
     with pytest.raises(IOError):
         h.read(np.empty(10, np.float32), tmp_path / "missing.bin")
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_aio_o_direct_aligned(tmp_path, engine):
+    """r5 (VERDICT #3): O_DIRECT path — 4 KiB-aligned buffer/offset/length
+    round-trips through BOTH engines; a misaligned request on the same
+    handle silently falls back to buffered I/O (no error) — the contract
+    must not depend on which engine 'auto' resolved to."""
+    h = AIOHandle(engine=engine, queue_depth=16, o_direct=True)
+    a = aio_aligned_empty((1 << 20, ), np.uint8)
+    assert a.ctypes.data % 4096 == 0
+    a[:] = np.random.default_rng(1).integers(0, 255, 1 << 20, dtype=np.uint8)
+    path = tmp_path / "d.bin"
+    h.write(a, path)
+    b = aio_aligned_empty((1 << 20, ), np.uint8)
+    h.read(b, path)
+    np.testing.assert_array_equal(a, b)
+    # misaligned length → buffered fallback, still correct
+    odd = np.arange(1003, dtype=np.uint8)
+    h.write(odd, tmp_path / "odd.bin")
+    back = np.empty_like(odd)
+    h.read(back, tmp_path / "odd.bin")
+    np.testing.assert_array_equal(odd, back)
+
+
+@pytest.mark.skipif(not uring_available(), reason="io_uring unavailable")
+def test_aio_uring_buffer_pinned_across_async(tmp_path):
+    """The handle must keep async buffers alive until wait(): dropping the
+    caller's only reference mid-flight previously let the kernel DMA into
+    freed heap pages (observed as glibc heap corruption)."""
+    import gc
+    h = AIOHandle(engine="uring", block_size=1 << 16)
+    data = np.random.default_rng(2).integers(0, 255, 1 << 20, dtype=np.uint8)
+    h.write(data, tmp_path / "p.bin")
+    reqs = [h.async_read(np.empty(1 << 18, np.uint8), tmp_path / "p.bin",
+                         i << 18) for i in range(4)]   # no refs kept!
+    gc.collect()
+    for i, r in enumerate(reqs):
+        buf = h._live[r]
+        h.wait(r)
+        np.testing.assert_array_equal(
+            buf, data[i << 18:(i + 1) << 18])
+    assert not h._live
 
 
 # ------------------------------------------------------- cpu optimizers
