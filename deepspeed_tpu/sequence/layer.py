@@ -11,9 +11,15 @@ all-to-all restores sequence sharding.  On TPU both all-to-alls are
 on ICI; gradients are handled by autodiff (all_to_all is its own transpose),
 so no custom autograd.Function is needed.
 
-GQA/uneven heads: the reference has ``uneven_heads_all2all`` (``:72``); here
-heads must divide sp (asserted), and KV heads with n_kv < sp are *replicated*
-gather-style — see ``_kv_reshard``.
+GQA/uneven heads (reference ``uneven_heads_all2all`` ``:72-196``): when
+``n_heads % sp != 0`` the q heads are zero-padded up to the next multiple of
+sp — static shapes, so XLA still tiles the a2a + attention onto the MXU —
+and sliced back after the inverse a2a.  KV heads are routed, not
+replicated: each rank assembles (from its local sequence chunk) the kv head
+every destination rank's q block needs, and ONE all-to-all delivers exactly
+those — post-reshard kv memory is [B, S, H_local, D] like q, never
+[B, S, n_kv, D] as a sequence all-gather would give.  All head-routing
+indices are computed in Python at trace time.
 """
 
 from functools import partial
@@ -63,41 +69,54 @@ class DistributedAttention:
         if sp == 1:
             return self.local_attn(q, k, v, **kwargs)
         H = q.shape[self.scatter_idx]
-        n_kv = k.shape[self.scatter_idx]
-        # seq-sharded [B, S/sp, H, D] → head-sharded [B, S, H/sp, D]
+        hpad = (-H) % sp  # uneven heads: zero-pad to the next sp multiple
+        if hpad:
+            widths = [(0, 0)] * q.ndim
+            widths[self.scatter_idx] = (0, hpad)
+            q = jnp.pad(q, widths)
+        # seq-sharded [B, S/sp, Hp, D] → head-sharded [B, S, Hp/sp, D]
         q = single_all_to_all(q, self.scatter_idx, self.gather_idx, a)
         k = self._kv_reshard(k, sp, H)
         v = self._kv_reshard(v, sp, H)
         out = self.local_attn(q, k, v, **kwargs)
-        # back: head-sharded → seq-sharded
-        return single_all_to_all(out, self.gather_idx, self.scatter_idx, a)
+        # back: head-sharded → seq-sharded (+ drop the padding heads)
+        out = single_all_to_all(out, self.gather_idx, self.scatter_idx, a)
+        if hpad:
+            out = jax.lax.slice_in_dim(out, 0, H, axis=self.scatter_idx)
+        return out
 
     def _kv_reshard(self, t, sp, n_q_heads):
-        """KV reshard with GQA alignment (reference uneven-heads analog,
-        ``sequence/layer.py:72``).  Returns kv with exactly the head count the
-        local q block has (n_q_heads / sp), so ``local_attn`` always sees
+        """KV reshard with GQA alignment (reference ``uneven_heads_all2all``,
+        ``sequence/layer.py:72``).  Returns kv with exactly the head count
+        the local (padded) q block has, so ``local_attn`` always sees
         matched heads:
 
-        * n_kv divisible by sp → all-to-all like Q, then local group-repeat
-          (contiguous head blocks keep q↔kv group alignment);
-        * else → all-gather the sequence (kv stays whole) and gather-select
-          the kv heads serving this rank's q-head block."""
+        * both head counts divisible by sp → all-to-all like Q, then local
+          group-repeat (contiguous head blocks keep q↔kv group alignment);
+        * else → duplicate-then-route: build, from the local seq chunk, the
+          [sp × qh_local] slot layout where slot (r, j) holds the kv head
+          rank r's j-th q head attends to, and ONE all-to-all scatters the
+          slot axis / gathers the sequence.  No rank ever materializes the
+          full [B, S, n_kv, D] kv (the sequence-all-gather fallback this
+          replaces); wire+memory cost equals the q path's."""
         n_kv = t.shape[self.scatter_idx]
         group = max(1, n_q_heads // n_kv)  # q heads per kv head
-        qh_local = n_q_heads // sp
-        if n_kv % sp == 0:
+        if n_kv % sp == 0 and n_q_heads % sp == 0:
             t = single_all_to_all(t, self.scatter_idx, self.gather_idx,
                                   self.sp_axis)
             if n_kv != n_q_heads:
                 t = jnp.repeat(t, group, axis=self.scatter_idx)
             return t
-        # small-kv path: full kv heads on every rank
-        t = jax.lax.all_gather(t, self.sp_axis, axis=self.gather_idx,
-                               tiled=True)
-        r = jax.lax.axis_index(self.sp_axis)
-        local_q_global = r * qh_local + jnp.arange(qh_local)
-        kv_idx = local_q_global // group
-        return jnp.take(t, kv_idx, axis=self.scatter_idx)
+        qh_local = -(-n_q_heads // sp)  # padded q heads per rank
+        # slot (r, j) ← kv head of global (padded) q head r*qh_local + j;
+        # padding q heads clamp to the last real head (their output is
+        # sliced away).  Pure-Python index table → static gather.
+        import numpy as np
+        g = np.arange(sp * qh_local)
+        kv_idx = np.minimum(g, n_q_heads - 1) // group
+        t = jnp.take(t, jnp.asarray(kv_idx), axis=self.scatter_idx)
+        return single_all_to_all(t, self.scatter_idx, self.gather_idx,
+                                 self.sp_axis)
 
     # ---- eager/GSPMD form: global arrays, seq dim sp-sharded ---------------
     def __call__(self, query, key, value, mesh=None, **kwargs):

@@ -67,6 +67,51 @@ def test_ulysses_gqa_divisible_kv():
                                atol=2e-5, rtol=1e-4)
 
 
+def test_ulysses_uneven_heads():
+    """r5 (VERDICT #4, reference ``uneven_heads_all2all`` layer.py:72):
+    n_heads % sp != 0 with GQA n_kv < sp — padded-head a2a + routed kv,
+    no full-KV replication.  Parity vs local attention at sp=4, heads=6,
+    kv=2 (the VERDICT's done-criterion config)."""
+    groups.initialize_mesh(dp=2, sp=4)
+    q, k, v = _qkv(H=6, kv_heads=2, seed=4)
+    out_dist = DistributedAttention()(q, k, v)
+    out_ref = _gqa_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_dist), np.asarray(out_ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("H,kv,sp", [(6, 6, 4), (6, 3, 4), (10, 5, 8),
+                                     (6, 2, 8), (8, 8, 8)])
+def test_ulysses_uneven_heads_sweep(H, kv, sp):
+    """Head/kv/sp combinations with every divisibility violation: H % sp,
+    kv % sp, kv < sp, and the even baseline — all must match local GQA."""
+    groups.initialize_mesh(dp=8 // min(sp, 8), sp=sp)
+    q, k, v = _qkv(H=H, kv_heads=kv, seed=H * 31 + kv)
+    out_dist = DistributedAttention()(q, k, v)
+    out_ref = _gqa_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_dist), np.asarray(out_ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ulysses_uneven_heads_grads():
+    """Gradients flow through the pad/route path and match local GQA."""
+    groups.initialize_mesh(dp=2, sp=4)
+    q, k, v = _qkv(H=6, kv_heads=2, seed=5)
+    attn = DistributedAttention()
+
+    def loss(q, k, v):
+        return jnp.sum(attn(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_gqa_ref(q, k, v) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3,
+                                   rtol=1e-3)
+
+
 def test_sp1_passthrough():
     groups.initialize_mesh(dp=8, sp=1)
     q, k, v = _qkv()
