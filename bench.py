@@ -669,11 +669,18 @@ def run_fpdt_bench(on_tpu: bool) -> dict:
     attn.attend(blk, k_new=blk, v_new=blk)
     attn.reset()
     _logt("fpdt: compile done; streaming…")
-    t0 = time.perf_counter()
-    for _ in range(TOTAL // CHUNK):
-        out = attn.attend(blk, k_new=blk, v_new=blk)
-    _host_sync(out)
-    dt = time.perf_counter() - t0
+
+    def stream(double_buffer):
+        attn.reset()
+        attn.double_buffer = double_buffer
+        t0 = time.perf_counter()
+        for _ in range(TOTAL // CHUNK):
+            out = attn.attend(blk, k_new=blk, v_new=blk)
+        _host_sync(out)
+        return time.perf_counter() - t0
+
+    dt_sync = stream(False)   # sync-fetch reference
+    dt = stream(True)         # prefetch-ahead pipeline (the shipped default)
     resident = "n/a"
     if _host_sharding() is not None:
         resident = all(c.k.sharding.memory_kind == "pinned_host"
@@ -684,6 +691,7 @@ def run_fpdt_bench(on_tpu: bool) -> dict:
         "value": round(TOTAL / dt, 1),
         "unit": (f"tokens/s (context={TOTAL} chunk={CHUNK} H={H} D={D} "
                  f"host_resident={resident} "
+                 f"db_speedup={dt_sync / dt:.3f}x "
                  f"hbm_peak={stats.get('peak_bytes_in_use', 0)/2**30:.2f}G "
                  f"backend={jax.default_backend()})"),
         "vs_baseline": 0.0,  # no in-repo reference number (BASELINE.md)

@@ -170,12 +170,22 @@ class SequenceChunk:
 class FPDTHostOffloadAttention:
     """Streaming attention over host-resident KV chunks (reference
     _FPDTGPUOffloadingAttentionImpl_ :510).  Append-only KV (decode/eval):
-    HBM holds one chunk at a time; context length is bounded by host RAM."""
+    HBM holds ≤ 2 chunks at a time (current + prefetch); context length is
+    bounded by host RAM.
 
-    def __init__(self, chunk_size=4096, softmax_scale=None, offload=True):
+    ``double_buffer`` (default on) software-pipelines the stream the way
+    the reference's ``general_offloading`` double-buffers cudaMemcpyAsync
+    (fpdt_layer.py:462-560): chunk i+1's H2D transfer is ISSUED before
+    chunk i's merge is dispatched, so the transfer rides the DMA engine
+    while the MXU runs the merge — without it, dispatch order makes the
+    transfer eligible only after the merge is enqueued."""
+
+    def __init__(self, chunk_size=4096, softmax_scale=None, offload=True,
+                 double_buffer=True):
         self.chunk_size = chunk_size
         self.softmax_scale = softmax_scale
         self.offload = offload
+        self.double_buffer = double_buffer
         self.chunks = []
 
         # ONE compiled merge serves both the streamed chunks (causal=False:
@@ -210,9 +220,19 @@ class FPDTHostOffloadAttention:
         out = jnp.zeros((B, Sq, H, D), jnp.float32)
         lse = jnp.full((B, Sq, H), NEG_INF, jnp.float32)
         scale = self.softmax_scale if self.softmax_scale is not None else D**-0.5
-        for chunk in self.chunks:
-            k, v = chunk.fetch()
-            out, lse = self._merge(q, k, v, out, lse, scale, False)
+        if self.double_buffer and self.chunks:
+            # prefetch-ahead pipeline: kick chunk i+1's H2D before merging
+            # chunk i, keeping ≤ 2 chunks device-resident
+            fetched = self.chunks[0].fetch()
+            for i in range(len(self.chunks)):
+                nxt = (self.chunks[i + 1].fetch()
+                       if i + 1 < len(self.chunks) else None)
+                out, lse = self._merge(q, *fetched, out, lse, scale, False)
+                fetched = nxt
+        else:
+            for chunk in self.chunks:
+                k, v = chunk.fetch()
+                out, lse = self._merge(q, k, v, out, lse, scale, False)
         if k_new is not None:
             # current block attends (causally) to itself — jitted, mask
             # built in-program
