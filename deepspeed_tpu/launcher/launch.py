@@ -43,6 +43,12 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--one_proc_per_device", action="store_true")
+    parser.add_argument("--bind_cores_to_rank", action="store_true",
+                        help="numactl-bind each local process to its core "
+                        "slice (reference utils/numa.py get_numactl_cmd).")
+    parser.add_argument("--bind_core_list", type=str, default=None,
+                        help="Restrict binding to these cores, e.g. "
+                        "'0-27,32-59'.")
     parser.add_argument("--no_python", action="store_true")
     parser.add_argument("--module", action="store_true")
     parser.add_argument("--enable_elastic_training", action="store_true")
@@ -133,6 +139,13 @@ def main(args=None):
         env = build_child_env(args, world_info, node_rank, local_rank,
                               procs_per_node)
         cmd = child_cmd()
+        if args.bind_cores_to_rank:
+            # keep the host-optimizer/aio threads NUMA-local per process
+            from ..utils.numa import get_numactl_cmd
+            prefix, per_rank = get_numactl_cmd(args.bind_core_list,
+                                               procs_per_node, local_rank)
+            env.setdefault("OMP_NUM_THREADS", str(per_rank))
+            cmd = prefix + cmd
         logger.info("launching rank %s: %s", env["RANK"], " ".join(cmd))
         processes.append(subprocess.Popen(cmd, env=env))
 

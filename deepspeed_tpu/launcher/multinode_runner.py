@@ -48,6 +48,12 @@ class MultiNodeRunner(ABC):
             cmd.append("--module")
         if getattr(self.args, "elastic_training", False):
             cmd.append("--enable_elastic_training")
+        if getattr(self.args, "one_proc_per_device", False):
+            cmd.append("--one_proc_per_device")
+        if getattr(self.args, "bind_cores_to_rank", False):
+            cmd.append("--bind_cores_to_rank")
+            if getattr(self.args, "bind_core_list", None):
+                cmd.append(f"--bind_core_list={self.args.bind_core_list}")
         cmd.append(self.user_script)
         cmd.extend(self.user_arguments)
         return cmd

@@ -62,6 +62,10 @@ def parse_args(args=None):
                         help="Run the autotuner to discover config.")
     parser.add_argument("--elastic_training", action="store_true",
                         help="Enable elastic batch/worker scheduling.")
+    parser.add_argument("--one_proc_per_device", action="store_true",
+                        help="Reference process-per-device layout instead "
+                        "of the JAX one-process-per-host default "
+                        "(forwarded to launch.py).")
     parser.add_argument("--no_python", action="store_true",
                         help="Run user_script directly (not via python).")
     parser.add_argument("--module", action="store_true",
@@ -70,6 +74,9 @@ def parse_args(args=None):
                         help="Activation script sourced before launch.")
     parser.add_argument("--bind_cores_to_rank", action="store_true",
                         help="numactl-bind each local process.")
+    parser.add_argument("--bind_core_list", type=str, default=None,
+                        help="Restrict binding to these cores, e.g. "
+                        "'0-27,32-59'.")
     parser.add_argument("user_script", type=str,
                         help="User training script.")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -188,6 +195,12 @@ def build_launch_command(args, active_resources):
             f"--master_addr={args.master_addr or 'localhost'}",
             f"--master_port={args.master_port}",
         ]
+        if args.one_proc_per_device:
+            cmd.append("--one_proc_per_device")
+        if args.bind_cores_to_rank:
+            cmd.append("--bind_cores_to_rank")
+            if args.bind_core_list:
+                cmd.append(f"--bind_core_list={args.bind_core_list}")
         if args.no_python:
             cmd.append("--no_python")
         if args.module:
@@ -211,6 +224,10 @@ def build_launch_command(args, active_resources):
 
 def main(args=None):
     args = parse_args(args)
+
+    if args.bind_core_list and not args.bind_cores_to_rank:
+        logger.warning("--bind_core_list has no effect without "
+                       "--bind_cores_to_rank; processes run unbound")
 
     if args.autotuning:
         from ..autotuning.autotuner import run_autotuning

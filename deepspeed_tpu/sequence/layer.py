@@ -24,6 +24,8 @@ indices are computed in Python at trace time.
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -111,7 +113,6 @@ class DistributedAttention:
         # slot (r, j) ← kv head of global (padded) q head r*qh_local + j;
         # padding q heads clamp to the last real head (their output is
         # sliced away).  Pure-Python index table → static gather.
-        import numpy as np
         g = np.arange(sp * qh_local)
         kv_idx = np.minimum(g, n_q_heads - 1) // group
         t = jnp.take(t, jnp.asarray(kv_idx), axis=self.scatter_idx)

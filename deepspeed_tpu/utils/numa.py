@@ -1,0 +1,91 @@
+"""NUMA/core binding for host-side workers — analog of reference
+``deepspeed/utils/numa.py`` (``get_numactl_cmd``).
+
+On a TPU host the heavy host-side consumers are the C++ optimizer sweep
+(OpenMP) and the aio engines; binding each launched process to its own core
+slice (and, when the slice sits inside one NUMA node, membinding there)
+keeps the host optimizer's memory traffic NUMA-local.  Used by
+``launcher/launch.py`` when ``--bind_cores_to_rank`` is set.
+"""
+
+import os
+import shutil
+import subprocess
+
+from .logging import logger
+
+
+def parse_range_list(spec):
+    """'0-3,8,10-11' → [0, 1, 2, 3, 8, 10, 11] (sorted, deduped)."""
+    cores = set()
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-")
+            lo, hi = int(lo), int(hi)
+            if hi < lo:
+                raise ValueError(f"invalid core range {part!r}")
+            cores.update(range(lo, hi + 1))
+        else:
+            cores.add(int(part))
+    return sorted(cores)
+
+
+def get_numa_cores():
+    """[[cores of node 0], [cores of node 1], ...] via ``numactl
+    --hardware``; [] when numactl is unavailable."""
+    if shutil.which("numactl") is None:
+        return []
+    try:
+        out = subprocess.check_output(["numactl", "--hardware"],
+                                      text=True, stderr=subprocess.DEVNULL)
+    except (OSError, subprocess.CalledProcessError):
+        return []
+    nodes = []
+    for line in out.splitlines():
+        # 'node 0 cpus: 0 1 2 3 ...'
+        parts = line.split()
+        if len(parts) >= 3 and parts[0] == "node" and parts[2] == "cpus:":
+            nodes.append([int(c) for c in parts[3:]])
+    return nodes
+
+
+def _cpu_count():
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def get_numactl_cmd(bind_core_list, num_local_procs, local_rank):
+    """numactl prefix binding ``local_rank`` (of ``num_local_procs``) to its
+    core slice; membind to the covering NUMA node(s) when determinable.
+
+    Returns (cmd_prefix: list[str], cores_per_rank: int) — the caller
+    should also set OMP_NUM_THREADS=cores_per_rank for the child."""
+    if "KMP_AFFINITY" in os.environ:
+        raise ValueError(
+            "KMP_AFFINITY conflicts with numactl core binding; unset it "
+            "before launching with --bind_cores_to_rank")
+    if bind_core_list:
+        cores = parse_range_list(bind_core_list)
+    else:
+        cores = list(range(_cpu_count()))
+    per_rank = len(cores) // num_local_procs
+    if per_rank < 1:
+        raise ValueError(
+            f"{len(cores)} cores cannot bind {num_local_procs} local "
+            "processes (need ≥1 core per rank)")
+    mine = cores[per_rank * local_rank:per_rank * (local_rank + 1)]
+    if shutil.which("numactl") is None:
+        logger.warning("numactl not installed — skipping core binding")
+        return [], per_rank
+    cmd = ["numactl", "-C", ",".join(map(str, mine))]
+    # membind when the slice is covered by identifiable NUMA node(s)
+    nodes = [i for i, nc in enumerate(get_numa_cores())
+             if nc and set(nc) & set(mine)]
+    if nodes:
+        cmd += ["-m", ",".join(map(str, nodes))]
+    return cmd, per_rank

@@ -1,0 +1,83 @@
+"""Tiny training script driven by the REAL launcher chain in
+``test_runner.py::test_end_to_end_launch`` (reference
+``tests/unit/launcher``): bin/deepspeed → runner.py → launch.py → this
+script → ``deepspeed_tpu.initialize``.
+
+It consumes ONLY what launch.py exported (COORDINATOR_ADDRESS,
+JAX_PROCESS_COUNT/ID and the MASTER_*/RANK/WORLD_SIZE spellings) — any
+env-spelling regression in the launcher breaks the rendezvous here.
+Each process contributes 4 virtual CPU devices; rank 0 prints the losses.
+"""
+
+import os
+import sys
+
+# CPU mesh setup must precede the jax import
+flags = " ".join(f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count"))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+import deepspeed_tpu.comm as dist  # noqa: E402
+from deepspeed_tpu.utils import groups  # noqa: E402
+
+D = 8
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, y):
+        h = jnp.tanh(nn.Dense(32)(x))
+        out = nn.Dense(D)(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def main():
+    # the launcher exported these; initialize() consumes them through
+    # dist.init_distributed → ensure_runtime_initialized
+    nproc = int(os.environ["WORLD_SIZE"])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Net(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.05}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"dp": 8}})
+    assert jax.process_count() == nproc, (jax.process_count(), nproc)
+    assert jax.device_count() == 8, jax.device_count()
+    assert dist.get_world_size() == 8  # mesh world = devices, not processes
+
+    dp_rank = groups._get_data_parallel_rank()
+    local_rows = 8 // nproc
+    rng = np.random.default_rng(0)
+    W = (rng.standard_normal((D, D)) * 0.4).astype(np.float32)
+    sample = rng.standard_normal((8, D)).astype(np.float32)
+    engine.initialize_parameters(0, sample, sample @ W)
+
+    losses = []
+    for _ in range(3):
+        x = rng.standard_normal((8, D)).astype(np.float32)
+        y = x @ W
+        sl = slice(dp_rank, dp_rank + local_rows)
+        loss = engine(x[sl], y[sl])
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    if dist.get_rank() == 0:
+        print("E2E-LOSSES " + " ".join(f"{v:.8f}" for v in losses),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
