@@ -3,7 +3,7 @@ curriculum learning, efficient sampling, offline data analysis, mmap indexed
 datasets, and random-LTD token dropping."""
 
 from .curriculum_scheduler import CurriculumScheduler
-from .data_analyzer import DataAnalyzer
+from .data_analyzer import DataAnalyzer, DistributedDataAnalyzer
 from .data_sampler import DeepSpeedDataSampler, DistributedSampler
 from .data_routing import (RandomLTDScheduler, random_ltd_gather,
                            random_ltd_scatter, random_ltd_select)

@@ -2,4 +2,4 @@
 data_sampling/data_analyzer.py:22``)."""
 
 from ..data_analyzer import *  # noqa: F401,F403
-from ..data_analyzer import DataAnalyzer  # noqa: F401
+from ..data_analyzer import DataAnalyzer, DistributedDataAnalyzer  # noqa: F401

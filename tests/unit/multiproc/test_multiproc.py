@@ -260,3 +260,60 @@ def test_infinity_streaming_two_process():
     groups.reset_mesh()
     dist.destroy_process_group()
     np.testing.assert_allclose(two_proc, ref, rtol=1e-5)
+
+
+@pytest.mark.parametrize("transport", ["fs", "obj"])
+def test_distributed_data_analyzer_two_process(transport, tmp_path):
+    """r5 (VERDICT #8, reference data_analyzer.py:455): map per-rank across
+    2 real processes, reduce via shared-fs files or the object-gather
+    channel; artifacts must be byte-identical to a single-process run on
+    the same seeded dataset."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__),
+                          "worker_data_analyzer.py")
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                             "..", "..", ".."))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out_dir = tmp_path / f"dist_{transport}"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port), str(out_dir),
+         transport], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in range(2)]
+    outs = []
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"rank{pid} rc={p.returncode}\n{err[-3000:]}"
+        outs.append(out)
+    assert any("ANALYZER-TOTAL" in o for o in outs)
+
+    # single-process oracle over the identical seeded dataset
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+    rng = np.random.default_rng(7)
+    data = [rng.integers(0, 100, size=rng.integers(4, 32))
+            for _ in range(37)]
+    ref_dir = tmp_path / "single"
+    ref = DataAnalyzer(
+        data, str(ref_dir), metric_names=["seqlen", "total_tokens"],
+        metric_functions=[lambda s: len(s),
+                          lambda acc, s: (acc or 0) + len(s)],
+        metric_types=["single_value_per_sample",
+                      "accumulate_value_over_samples"]).run_map_reduce()
+
+    got_vals = np.load(out_dir / "seqlen_values.npy")
+    np.testing.assert_array_equal(got_vals, ref["seqlen"])
+    import json as _json
+    got_total = _json.load(open(out_dir / "total_tokens_total.json"))
+    assert got_total == ref["total_tokens"]
+    # index artifacts byte-identical (same values → same files)
+    for suffix in ("seqlen_index_to_sample.npy", ):
+        a = (out_dir / suffix).read_bytes()
+        b = (ref_dir / suffix).read_bytes()
+        assert a == b, f"{suffix} differs"
+    for suffix in ("seqlen_sample_to_metric.bin", "seqlen_sample_to_metric.idx",
+                   "seqlen_index_to_metric.bin",
+                   "seqlen_index_to_sample_percentile_merged.bin"):
+        if (ref_dir / suffix).exists():
+            assert (out_dir / suffix).read_bytes() == \
+                (ref_dir / suffix).read_bytes(), f"{suffix} differs"

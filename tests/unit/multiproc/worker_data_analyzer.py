@@ -1,0 +1,58 @@
+"""2-process worker for DistributedDataAnalyzer (reference
+``data_analyzer.py:455``): each process maps its shard of a seeded
+dataset; artifacts must be identical to a single-process run.
+
+Usage: worker_data_analyzer.py <pid> <nproc> <port> <out_dir> <transport>
+``transport``: 'fs' (shared-filesystem reduce) or 'obj' (object gather).
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    out_dir, transport = sys.argv[4], sys.argv[5]
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_COUNT"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    rng = np.random.default_rng(7)
+    data = [rng.integers(0, 100, size=rng.integers(4, 32)) for _ in range(37)]
+
+    an = DistributedDataAnalyzer(
+        data, out_dir,
+        metric_names=["seqlen", "total_tokens"],
+        metric_functions=[lambda s: len(s),
+                          lambda acc, s: (acc or 0) + len(s)],
+        metric_types=["single_value_per_sample",
+                      "accumulate_value_over_samples"],
+        shared_fs=(transport == "fs"))
+    assert an.num_workers == nproc, an.num_workers
+    out = an.run_map_reduce()
+    if pid == 0:
+        assert out is not None
+        print("ANALYZER-TOTAL", out["total_tokens"], flush=True)
+        print("ANALYZER-N", len(out["seqlen"]), flush=True)
+    else:
+        assert out is None
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
