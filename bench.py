@@ -87,14 +87,11 @@ def _implausible(achieved_flops_per_sec: float, peak_flops: float) -> bool:
 
 def _untrustworthy(rec: dict):
     """Why a recorded bench line must not be cited/folded, or None if it is
-    a full, plausible measurement.  Single source of truth for main()'s
-    ladder fold + last-device record and tools/bench_retry.sh's gate."""
-    u = rec.get("unit", "")
-    for marker in ("partial", "warmup-estimate", "timing-implausible",
-                   "backend=cpu"):
-        if marker in u:
-            return marker
-    return None
+    a full, plausible measurement.  Delegates to the package's shared trust
+    gate (autotuning/priors.py) so the bench fold, bench_retry.sh, and the
+    tuner-priors loader can never diverge on what counts as trustworthy."""
+    from deepspeed_tpu.autotuning.priors import untrustworthy
+    return untrustworthy(rec)
 
 
 def _host_sync(x):

@@ -172,7 +172,13 @@ class Autotuner:
         self.profile_model_info()
         exps = self.build_tuning_space()
         tuner_cls = TUNERS.get(self.cfg.tuner_type, GridSearchTuner)
-        tuner = tuner_cls(exps, self._run_experiment, metric=self.cfg.metric)
+        kw = {}
+        if tuner_cls is ModelBasedTuner and self.cfg.priors_path and \
+                os.path.isdir(self.cfg.priors_path):
+            from .priors import load_measured_priors
+            kw["priors"] = load_measured_priors(self.cfg.priors_path)
+        tuner = tuner_cls(exps, self._run_experiment, metric=self.cfg.metric,
+                          **kw)
         best = tuner.tune(sample_size=1,
                           n_trials=self.cfg.tuner_num_trials,
                           early_stopping=self.cfg.tuner_early_stopping)

@@ -33,3 +33,7 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # None + tune_mesh=True → derived from the device count.
     tune_mesh: bool = False
     mesh_candidates: Optional[List[Dict]] = None
+    # TPU addition: seed ModelBasedTuner with measured on-chip records from
+    # this directory (tools/bench_retry.sh artifacts).  Opt-in ("" = off):
+    # stale artifacts in a launch cwd must not silently bias a search.
+    priors_path: str = ""

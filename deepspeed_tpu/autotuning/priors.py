@@ -1,0 +1,83 @@
+"""Measured-ground-truth priors for the model-based tuner.
+
+Reference ``autotuning/tuner/model_based_tuner.py:19`` starts its cost
+model cold — every tuning session re-measures points a previous on-chip
+sweep already paid for.  Here trustworthy records from ``.bench_runs/``
+(the ladder/sweep artifacts ``tools/bench_retry.sh`` +
+``tools/onchip_sweeps.sh`` write, summarized by ``tools/fold_sweeps.py``)
+seed ``ModelBasedTuner``'s regression, so TPU tuning starts from measured
+ground truth and its FIRST proposal is the best measured config.
+"""
+
+import glob
+import json
+import os
+import re
+
+from ..utils.logging import logger
+
+# Trust gate for recorded bench lines — the single source of truth shared
+# with bench.py's _untrustworthy: a partial or fallback measurement must
+# never be cited, folded, or become a tuning prior.
+UNTRUSTED_MARKERS = ("partial", "warmup-estimate", "timing-implausible",
+                     "backend=cpu", "cpu-fallback")
+
+
+def untrustworthy(rec):
+    """Why a recorded bench line must not be trusted, or None if it is a
+    full, plausible measurement."""
+    u = rec.get("unit", "")
+    for m in UNTRUSTED_MARKERS:
+        if m in u:
+            return m
+    return None
+
+
+def _trusted(rec):
+    return untrustworthy(rec) is None
+
+
+def record_to_prior(rec):
+    """One bench JSON record → {"ds_config": ..., "throughput": ...} or
+    None.  The device bench encodes its config in the unit string
+    (``B=<mbs> S=<seq> …``); stage/gas follow the bench's fixed config."""
+    if not isinstance(rec, dict) or "metric" in rec and \
+            not str(rec.get("metric", "")).startswith("llama_train"):
+        return None
+    if not _trusted(rec):
+        return None
+    m = re.search(r"\bB=(\d+)\b", rec.get("unit", ""))
+    if m is None or not rec.get("value"):
+        return None
+    return {
+        "ds_config": {
+            "train_micro_batch_size_per_gpu": int(m.group(1)),
+            "gradient_accumulation_steps": 1,
+            "zero_optimization": {"stage": 0},
+        },
+        "throughput": float(rec["value"]),
+    }
+
+
+def load_measured_priors(runs_dir=".bench_runs"):
+    """Collect priors from every trustworthy record under ``runs_dir``
+    (top-level ``*.json`` ladder legs + ``sweeps/*.json``)."""
+    priors = []
+    for path in sorted(glob.glob(os.path.join(runs_dir, "*.json")) +
+                       glob.glob(os.path.join(runs_dir, "sweeps",
+                                              "*.json"))):
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+            if not text:
+                continue
+            rec = json.loads(text.splitlines()[-1])
+        except (OSError, ValueError):
+            continue
+        p = record_to_prior(rec)
+        if p is not None:
+            priors.append(p)
+    if priors:
+        logger.info(f"autotuning: loaded {len(priors)} measured priors "
+                    f"from {runs_dir}")
+    return priors

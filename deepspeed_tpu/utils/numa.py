@@ -65,10 +65,6 @@ def get_numactl_cmd(bind_core_list, num_local_procs, local_rank):
 
     Returns (cmd_prefix: list[str], cores_per_rank: int) — the caller
     should also set OMP_NUM_THREADS=cores_per_rank for the child."""
-    if "KMP_AFFINITY" in os.environ:
-        raise ValueError(
-            "KMP_AFFINITY conflicts with numactl core binding; unset it "
-            "before launching with --bind_cores_to_rank")
     if bind_core_list:
         cores = parse_range_list(bind_core_list)
     else:
@@ -80,8 +76,13 @@ def get_numactl_cmd(bind_core_list, num_local_procs, local_rank):
             "processes (need ≥1 core per rank)")
     mine = cores[per_rank * local_rank:per_rank * (local_rank + 1)]
     if shutil.which("numactl") is None:
+        # no numactl → no numactl/KMP conflict either; degrade, don't abort
         logger.warning("numactl not installed — skipping core binding")
         return [], per_rank
+    if "KMP_AFFINITY" in os.environ:
+        raise ValueError(
+            "KMP_AFFINITY conflicts with numactl core binding; unset it "
+            "before launching with --bind_cores_to_rank")
     cmd = ["numactl", "-C", ",".join(map(str, mine))]
     # membind when the slice is covered by identifiable NUMA node(s)
     nodes = [i for i, nc in enumerate(get_numa_cores())
