@@ -175,8 +175,16 @@ class Autotuner:
         kw = {}
         if tuner_cls is ModelBasedTuner and self.cfg.priors_path and \
                 os.path.isdir(self.cfg.priors_path):
-            from .priors import load_measured_priors
-            kw["priors"] = load_measured_priors(self.cfg.priors_path)
+            if self.cfg.metric != "throughput":
+                # bench records are tokens/s (a throughput); seeding a
+                # latency/flops search with them would silently run cold
+                logger.warning(
+                    f"measured priors only exist for metric='throughput' "
+                    f"(configured: {self.cfg.metric!r}); tuning starts "
+                    "cold")
+            else:
+                from .priors import load_measured_priors
+                kw["priors"] = load_measured_priors(self.cfg.priors_path)
         tuner = tuner_cls(exps, self._run_experiment, metric=self.cfg.metric,
                           **kw)
         best = tuner.tune(sample_size=1,

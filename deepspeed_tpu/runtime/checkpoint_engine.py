@@ -127,6 +127,16 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     if engine.lr_scheduler is not None and hasattr(engine.lr_scheduler,
                                                    "state_dict"):
         state["lr_scheduler"] = engine.lr_scheduler.state_dict()
+    # data sampler + legacy curriculum state (reference engine.py:3329 /
+    # :3401 persist the sampler; resume must not restart the curriculum or
+    # re-consume samples)
+    sampler = getattr(getattr(engine, "training_dataloader", None),
+                      "data_sampler", None)
+    if sampler is not None and hasattr(sampler, "state_dict"):
+        state["data_sampler"] = sampler.state_dict()
+    if engine.curriculum_scheduler is not None:
+        state["curriculum_scheduler"] = \
+            engine.curriculum_scheduler.state_dict()
 
     with open(os.path.join(root, "engine_state.json"), "w") as f:
         json.dump(state, f, indent=2)
@@ -224,6 +234,19 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
                 "lr_scheduler" in state and hasattr(engine.lr_scheduler,
                                                     "load_state_dict"):
             engine.lr_scheduler.load_state_dict(state["lr_scheduler"])
+
+    # sampler + legacy curriculum resume (reference engine.py:2968): the
+    # curriculum must not restart easy and consumed samples must not be
+    # re-drawn
+    sampler = getattr(getattr(engine, "training_dataloader", None),
+                      "data_sampler", None)
+    if sampler is not None and "data_sampler" in state and \
+            hasattr(sampler, "load_state_dict"):
+        sampler.load_state_dict(state["data_sampler"])
+    if engine.curriculum_scheduler is not None and \
+            "curriculum_scheduler" in state:
+        engine.curriculum_scheduler.load_state_dict(
+            state["curriculum_scheduler"])
 
     engine.global_steps = state["global_steps"]
     engine.global_samples = state["global_samples"]

@@ -92,23 +92,62 @@ class DeepSpeedDataSampler:
         self.drop_last = drop_last
         self.dp_rank = data_parallel_rank
         self.dp_size = data_parallel_size
-        self.batch_step = 0
+        # gas: the curriculum advances once per GLOBAL batch (optimizer
+        # step), which is then yielded as gas micro index-lists — the
+        # reference paces difficulty by global step the same way
+        self.gas = max(1, int(gradient_accumulation_steps))
+        self.batch_step = 0         # lifetime GLOBAL batches drawn
+        self.epoch_batch_step = 0   # global batches drawn in current epoch
         self.consumed_samples = 0
 
     def __len__(self):
-        return self.total_samples // self.global_batch_size
+        # micro batches per epoch (what the dataloader counts)
+        return (self.total_samples // self.global_batch_size) * self.gas
 
     def state_dict(self):
         return {"batch_step": self.batch_step,
+                "epoch_batch_step": self.epoch_batch_step,
                 "consumed_samples": self.consumed_samples,
                 "curriculum": (self.curriculum_scheduler.state_dict()
                                if self.curriculum_scheduler else None)}
 
     def load_state_dict(self, sd):
         self.batch_step = sd["batch_step"]
+        self.epoch_batch_step = sd.get("epoch_batch_step",
+                                       sd["batch_step"] % max(
+                                           1, self.total_samples //
+                                           self.global_batch_size))
         self.consumed_samples = sd["consumed_samples"]
         if self.curriculum_scheduler and sd.get("curriculum"):
             self.curriculum_scheduler.load_state_dict(sd["curriculum"])
+
+    def _draw(self, remaining, step):
+        """The global batch at lifetime ``step`` given the consumed mask —
+        PURE in (remaining, step), so a resumed sampler can replay the
+        current epoch's draws and rebuild consumption exactly."""
+        difficulty = None
+        if self.curriculum_scheduler is not None:
+            difficulty = self.curriculum_scheduler.update_difficulty(step)
+        if self.metric_values is not None and difficulty is not None:
+            pool = np.nonzero(remaining &
+                              (self.metric_values <= difficulty))[0]
+        else:
+            pool = np.nonzero(remaining)[0]
+        if len(pool) < self.global_batch_size:
+            # curriculum floor thinner than a batch: top up with the
+            # easiest unconsumed samples
+            rest = np.nonzero(remaining)[0]
+            rest = rest[np.argsort(self.metric_values[rest],
+                                   kind="stable")] \
+                if self.metric_values is not None else rest
+            extra = rest[~np.isin(rest, pool)]
+            pool = np.concatenate(
+                [pool, extra[:self.global_batch_size - len(pool)]])
+        rng = np.random.default_rng(self.seed + step)
+        if self.shuffle:
+            return rng.choice(pool, size=self.global_batch_size,
+                              replace=False)
+        return pool[:self.global_batch_size]
 
     def __iter__(self):
         """One epoch: every sample drawn at most once (no replacement across
@@ -116,46 +155,33 @@ class DeepSpeedDataSampler:
         curriculum filter applied to the not-yet-consumed pool.  Every rank
         derives the same stream (seeded by batch_step), so the global batch
         is consistent without communication."""
-        remaining = np.ones(self.total_samples, dtype=bool)
         if self.total_samples < self.global_batch_size:
             return  # not even one full batch (drop_last semantics)
-        # self.batch_step is the *lifetime* counter (curriculum difficulty and
-        # seeds advance across epochs; checkpoint-resumable); the epoch bound
-        # uses its own counter so a second epoch isn't empty.
-        epoch_batches = 0
+        # self.batch_step is the *lifetime* counter (curriculum difficulty
+        # and seeds advance across epochs; checkpoint-resumable).  A fresh
+        # iterator mid-epoch (resume, or re-iter) REPLAYS the epoch's prior
+        # draws — _draw is deterministic in step — so already-consumed
+        # samples are never re-drawn.
+        remaining = np.ones(self.total_samples, dtype=bool)
+        for k in range(self.epoch_batch_step):
+            step = self.batch_step - self.epoch_batch_step + k
+            remaining[self._draw(remaining, step)] = False
+        epoch_len = self.total_samples // self.global_batch_size
         while remaining.sum() >= self.global_batch_size and \
-                epoch_batches < len(self):
-            difficulty = None
-            if self.curriculum_scheduler is not None:
-                difficulty = self.curriculum_scheduler.update_difficulty(
-                    self.batch_step)
-            if self.metric_values is not None and difficulty is not None:
-                pool = np.nonzero(remaining &
-                                  (self.metric_values <= difficulty))[0]
-            else:
-                pool = np.nonzero(remaining)[0]
-            if len(pool) < self.global_batch_size:
-                # curriculum floor thinner than a batch: top up with the
-                # easiest unconsumed samples
-                rest = np.nonzero(remaining)[0]
-                rest = rest[np.argsort(self.metric_values[rest],
-                                       kind="stable")] \
-                    if self.metric_values is not None else rest
-                extra = rest[~np.isin(rest, pool)]
-                pool = np.concatenate(
-                    [pool, extra[:self.global_batch_size - len(pool)]])
-            rng = np.random.default_rng(self.seed + self.batch_step)
-            if self.shuffle:
-                batch = rng.choice(pool, size=self.global_batch_size,
-                                   replace=False)
-            else:
-                batch = pool[:self.global_batch_size]
+                self.epoch_batch_step < epoch_len:
+            batch = self._draw(remaining, self.batch_step)
             remaining[batch] = False
             self.batch_step += 1
-            epoch_batches += 1
+            self.epoch_batch_step += 1
             self.consumed_samples += self.global_batch_size
             # per-dp-rank slice (engine path passes dp_size=1 and shards
-            # the assembled batch itself)
+            # the assembled batch itself), then gas micro slices
             per_rank = self.global_batch_size // self.dp_size
             lo = self.dp_rank * per_rank
-            yield batch[lo:lo + per_rank].tolist()
+            mine = batch[lo:lo + per_rank]
+            micro = per_rank // self.gas
+            for g in range(self.gas):
+                yield mine[g * micro:(g + 1) * micro].tolist()
+        if self.epoch_batch_step >= epoch_len or \
+                remaining.sum() < self.global_batch_size:
+            self.epoch_batch_step = 0  # epoch complete; next iter is fresh

@@ -54,7 +54,8 @@ class DeepSpeedDataLoader:
     """
 
     def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
-                 seed=0, drop_last=True, num_local_io_workers=None):
+                 seed=0, drop_last=True, num_local_io_workers=None,
+                 data_sampler=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate
@@ -62,17 +63,37 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.workers = int(num_local_io_workers or 0)
+        # curriculum/efficiency sampler (e.g. DeepSpeedDataSampler): yields
+        # index batches and carries checkpointable state — the engine
+        # persists loader.data_sampler.state_dict() (reference
+        # engine.py:3329 saves the sampler the same way)
+        self.data_sampler = data_sampler
         self.epoch = 0
         n = len(dataset)
-        self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
+        self.len = (len(data_sampler) if data_sampler is not None
+                    else n // batch_size if drop_last
+                    else math.ceil(n / batch_size))
 
     def __len__(self):
         return self.len
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+        if hasattr(self.data_sampler, "set_epoch"):
+            self.data_sampler.set_epoch(epoch)
 
     def _batch_indices(self):
+        if self.data_sampler is not None:
+            for idx in self.data_sampler:
+                idx = np.asarray(idx)
+                if idx.ndim == 0:
+                    raise TypeError(
+                        "data_sampler must yield BATCHES of indices "
+                        "(lists/arrays), got a scalar — per-sample "
+                        "samplers like DistributedSampler belong inside "
+                        "a batch sampler, not here")
+                yield idx
+            return
         order = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)

@@ -871,9 +871,50 @@ class DeepSpeedEngine:
         if batch_size is None:
             batch_size = (self.train_micro_batch_size_per_gpu() *
                           self.dp_world_size)
+        if data_sampler is None:
+            data_sampler = self._config_curriculum_sampler(dataset,
+                                                           batch_size)
         return DeepSpeedDataLoader(dataset, batch_size=batch_size,
                                    collate_fn=collate_fn,
-                                   num_local_io_workers=num_local_io_workers)
+                                   num_local_io_workers=num_local_io_workers,
+                                   data_sampler=data_sampler)
+
+    def _config_curriculum_sampler(self, dataset, batch_size):
+        """Config-driven curriculum sampler (reference ``deepspeed_io``
+        builds a ``DeepSpeedDataSampler`` when
+        ``data_efficiency.data_sampling.curriculum_learning`` is enabled,
+        engine.py:1753): metric values come from a ``DataAnalyzer`` output
+        directory (``{metric}_values.npy``) or inline ``metric_values``."""
+        cl = (self._config.train_data_config.get("data_sampling", {})
+              .get("curriculum_learning", {}))
+        if not cl.get("enabled"):
+            return None
+        metrics = cl.get("curriculum_metrics", {})
+        if not metrics:
+            return None
+        if len(metrics) > 1:
+            logger.warning("multiple curriculum metrics configured; using "
+                           "the first (difficulty composition not "
+                           "implemented)")
+        name, mcfg = next(iter(metrics.items()))
+        if "metric_values" in mcfg:
+            values = np.asarray(mcfg["metric_values"])
+        else:
+            from .data_pipeline.data_analyzer import DataAnalyzer
+            values = DataAnalyzer.load_metric(mcfg["output_path"], name)
+        sched_keys = ("min_difficulty", "max_difficulty", "schedule_type",
+                      "schedule_config")
+        from .data_pipeline.data_sampler import DeepSpeedDataSampler
+        # global batch = micro × gas: the curriculum advances once per
+        # OPTIMIZER step and the sampler yields gas micro index-lists
+        gas = self.gradient_accumulation_steps()
+        return DeepSpeedDataSampler(
+            total_samples=len(dataset),
+            global_batch_size=batch_size * gas,
+            metric_values=values,
+            curriculum_config={k: mcfg[k] for k in sched_keys
+                               if k in mcfg},
+            gradient_accumulation_steps=gas)
 
     def _batch_sharding(self, x):
         """Shard batch dim 0 over dp (and sequence dim 1 over sp if enabled)."""

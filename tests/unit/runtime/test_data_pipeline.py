@@ -242,3 +242,154 @@ def test_analyzer_to_curriculum_schedule_e2e(tmp_path):
     batches_seen = list(iter(sampler))
     assert metric[batches_seen[0]].max() <= 20      # step-0 floor
     assert metric[np.concatenate(batches_seen)].max() > 20  # curriculum grew
+
+
+def test_curriculum_engine_checkpoint_resume(tmp_path):
+    """r5 (VERDICT weak #7): config-driven curriculum sampling through the
+    ENGINE — analyzer artifacts feed deepspeed_io's sampler, train_batch
+    consumes the curriculum stream, and checkpoint resume continues the
+    exact stream (difficulty + consumed samples) instead of restarting
+    easy (reference engine.py:1753 deepspeed_io + :3329/:2968 sampler
+    state persistence)."""
+    import flax.linen as nn
+    from deepspeed_tpu.utils import groups
+
+    n, D = 64, 8
+    rng = np.random.default_rng(3)
+    xs = rng.standard_normal((n, D)).astype(np.float32)
+    # difficulty = sample norm; easy samples are small-norm
+    scale = np.linspace(0.1, 2.0, n).astype(np.float32)
+    xs = xs * scale[:, None]
+    data = [(xs[i], 0.5 * xs[i]) for i in range(n)]
+
+    # offline analysis → metric artifacts (the curriculum's input)
+    an_dir = tmp_path / "analysis"
+    # integer difficulty (the schedule's difficulty_step quantizes to
+    # whole units, mirroring the reference's Tensor-Core-size steps)
+    DataAnalyzer(data, str(an_dir), metric_names=["norm"],
+                 metric_functions=[
+                     lambda s: float(round(np.abs(s[0]).max() * 32))],
+                 metric_types=["single_value_per_sample"]).run_map_reduce()
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            return jnp.mean((nn.Dense(D)(x) - y) ** 2)
+
+    def config():
+        return {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+            "data_efficiency": {"enabled": True, "data_sampling": {
+                "enabled": True, "curriculum_learning": {
+                    "enabled": True, "curriculum_metrics": {"norm": {
+                        "output_path": str(an_dir),
+                        "min_difficulty": 8, "max_difficulty": 64,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 8,
+                                            "difficulty_step": 1}}}}}},
+        }
+
+    def build():
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=Net(), model_parameters=Net().init(
+                jax.random.PRNGKey(0), xs[:1], xs[:1])["params"],
+            config=config(), training_data=data)
+        return eng
+
+    eng = build()
+    sampler = eng.training_dataloader.data_sampler
+    assert isinstance(sampler, DeepSpeedDataSampler)
+    it = iter(eng.training_dataloader)
+    for _ in range(3):
+        eng.train_batch(it)
+    assert sampler.batch_step == 3
+    assert sampler.consumed_samples == 3 * eng.train_batch_size()
+    d3 = sampler.curriculum_scheduler.get_current_difficulty()
+    assert d3 > 8  # difficulty advanced past the floor
+    ck = tmp_path / "ck"
+    eng.save_checkpoint(str(ck), tag="t")
+
+    # uninterrupted continuation (the oracle stream)
+    for _ in range(2):
+        eng.train_batch(it)
+    oracle_step = sampler.batch_step
+    oracle_consumed = sampler.consumed_samples
+    oracle_diff = sampler.curriculum_scheduler.get_current_difficulty()
+
+    # resume into a fresh engine — sampler state must continue, not restart
+    eng2 = build()
+    eng2.load_checkpoint(str(ck), tag="t")
+    s2 = eng2.training_dataloader.data_sampler
+    assert s2.batch_step == 3
+    assert s2.consumed_samples == 3 * eng2.train_batch_size()
+    assert s2.curriculum_scheduler.get_current_difficulty() == d3
+    it2 = iter(eng2.training_dataloader)
+    for _ in range(2):
+        eng2.train_batch(it2)
+    assert s2.batch_step == oracle_step
+    assert s2.consumed_samples == oracle_consumed
+    assert s2.curriculum_scheduler.get_current_difficulty() == oracle_diff
+    groups.reset_mesh()
+
+
+def test_curriculum_sampler_resume_exact_stream():
+    """r5: a resumed sampler must continue the EXACT index stream — samples
+    consumed before the checkpoint are never re-drawn (fresh iterators
+    replay the epoch's draws; _draw is deterministic in step)."""
+    n = 64
+    metric = np.arange(n)
+    mk = lambda: DeepSpeedDataSampler(
+        total_samples=n, global_batch_size=4, metric_values=metric,
+        curriculum_config={"min_difficulty": 16, "max_difficulty": 64,
+                           "schedule_type": "fixed_linear",
+                           "schedule_config": {"total_curriculum_step": 10,
+                                               "difficulty_step": 1}})
+    s = mk()
+    it = iter(s)
+    drawn = [next(it) for _ in range(3)]
+    state = s.state_dict()
+    oracle = [next(it) for _ in range(2)]
+
+    s2 = mk()
+    s2.load_state_dict(state)
+    it2 = iter(s2)
+    resumed = [next(it2) for _ in range(2)]
+    assert resumed == oracle, (resumed, oracle)
+    # and nothing consumed pre-checkpoint reappears
+    pre = {i for b in drawn for i in b}
+    post = {i for b in resumed for i in b}
+    assert not pre & post
+
+    # a mid-epoch re-iter (no checkpoint) also continues, not restarts
+    s3 = mk()
+    it3 = iter(s3)
+    first3 = [next(it3) for _ in range(3)]
+    assert first3 == drawn
+    cont = [next(iter(s3)) for _ in range(1)]
+    assert cont[0] == oracle[0]
+
+
+def test_curriculum_sampler_gas_pacing():
+    """r5: with gradient_accumulation_steps=G the curriculum advances once
+    per GLOBAL batch while the sampler yields G micro index-lists."""
+    n = 64
+    metric = np.arange(n)
+    s = DeepSpeedDataSampler(
+        total_samples=n, global_batch_size=8, metric_values=metric,
+        gradient_accumulation_steps=4,
+        curriculum_config={"min_difficulty": 16, "max_difficulty": 64,
+                           "schedule_type": "fixed_linear",
+                           "schedule_config": {"total_curriculum_step": 10,
+                                               "difficulty_step": 1}})
+    it = iter(s)
+    micros = [next(it) for _ in range(4)]       # one optimizer step's worth
+    assert all(len(m) == 2 for m in micros)     # 8 // 4
+    assert s.batch_step == 1                    # ONE global draw
+    assert s.consumed_samples == 8
+    d_after_1 = s.curriculum_scheduler.get_current_difficulty()
+    [next(it) for _ in range(4)]
+    assert s.batch_step == 2
+    assert s.curriculum_scheduler.get_current_difficulty() >= d_after_1
+    assert len(s) == (n // 8) * 4               # micro batches per epoch

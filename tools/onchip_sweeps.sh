@@ -54,5 +54,10 @@ leg serve_moe env DS_SERVE_MODEL=mixtral python bench.py --mode serve
 leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
 leg bert python bench.py --mode bert
 
+# 6) Domino TP-overlap evidence from TPU-compiled HLO (VERDICT r4 item 7):
+# compile-only tp=2 program; result → .bench_runs/domino_overlap.json
+echo "=== domino overlap $(date) ==="
+timeout 900 python tools/domino_overlap_tpu.py || true
+
 echo "=== sweeps done $(date) ==="
 grep -H . "$OUT"/*.json 2>/dev/null
