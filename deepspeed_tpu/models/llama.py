@@ -266,20 +266,23 @@ class LlamaAttention(nn.Module):
                 # every ppermute hop's bytes by H/Hkv)
                 from ..sequence.ring_attention import RingAttention
                 out = RingAttention()(q, k, v, causal=True)
+            elif cfg.use_ulysses:
+                # kv at NATIVE width: DistributedAttention aligns GQA
+                # inside its reshard (a2a + local group-repeat, or routed
+                # a2a) — repeating to H first would multiply the kv a2a's
+                # wire bytes by H/Hkv
+                from ..sequence.layer import DistributedAttention
+                out = DistributedAttention()(q, k, v, causal=True,
+                                             window=cfg.sliding_window)
             else:
-                # GQA: repeat kv heads up to H
+                # GQA: repeat kv heads up to H for the local core
                 if Hkv != H:
                     rep = H // Hkv
                     k = jnp.repeat(k, rep, axis=2)
                     v = jnp.repeat(v, rep, axis=2)
-                if cfg.use_ulysses:
-                    from ..sequence.layer import DistributedAttention
-                    out = DistributedAttention()(q, k, v, causal=True,
-                                                 window=cfg.sliding_window)
-                else:
-                    from ..ops.attention import attention_core
-                    out = attention_core(q, k, v, causal=True,
-                                         window=cfg.sliding_window)
+                from ..ops.attention import attention_core
+                out = attention_core(q, k, v, causal=True,
+                                     window=cfg.sliding_window)
 
         out = out.reshape(B, S, H * Dh)
         return dense(features=D, axis=-1, name="o_proj")(out)

@@ -168,10 +168,41 @@ class DeepSpeedEngine:
         # federated device view (reference order: init_distributed :143
         # before mesh :153)
         dist.ensure_runtime_initialized()
+        rebuild = None
+        if groups.mesh_is_initialized():
+            # An earlier model.init / eager op may have auto-built the
+            # default dp-only mesh.  If the config EXPLICITLY requests a
+            # different factorization, silently keeping the stale mesh
+            # would train with sp/tp/pp = 1 while the user asked otherwise
+            # — rebuild instead (arrays re-placed by the engine's own
+            # device_puts).  Config dims left at their defaults MERGE from
+            # the current mesh (a deliberately pre-built tp=2 survives a
+            # config that only names sp), and dims the config and mesh
+            # agree on never force a rebuild.
+            want = {"pp": mc.pp, "sp": mc.sp, "tp": mc.tp, "ep": mc.ep}
+            if mc.dp not in (-1, None):
+                want["dp"] = mc.dp
+            cur = dict(groups.get_global_mesh().shape)
+            mismatch = {k: v for k, v in want.items()
+                        if v and v > 1 and cur.get(k, 1) != v}
+            if mismatch:
+                rebuild = {k: (want[k] if want.get(k, 1) and
+                               want.get(k, 1) > 1 else cur.get(k, 1))
+                           for k in ("pp", "sp", "tp", "ep")}
+                rebuild["dp"] = want.get("dp")  # None → re-derive remaining
+                logger.warning(
+                    f"mesh already initialized as {cur} but the config "
+                    f"explicitly requests {mismatch}; rebuilding as "
+                    f"{ {k: v for k, v in rebuild.items() if v} } "
+                    "(config dims merged over the existing mesh)")
+                groups.reset_mesh()
+                dist.destroy_process_group()
         if not groups.mesh_is_initialized():
+            m = rebuild or {
+                "pp": mc.pp, "sp": mc.sp, "tp": mc.tp, "ep": mc.ep,
+                "dp": None if mc.dp in (-1, None) else mc.dp}
             groups.initialize_mesh(
-                pp=mc.pp, dp=None if mc.dp in (-1, None) else mc.dp,
-                sp=mc.sp, tp=mc.tp, ep=mc.ep,
+                pp=m["pp"], dp=m["dp"], sp=m["sp"], tp=m["tp"], ep=m["ep"],
                 zero_partition_size=zp_size)
         elif zp_size and zp_size > 1 and \
                 groups.get_mesh_state().zero_partition_size != zp_size:

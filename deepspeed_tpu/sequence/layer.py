@@ -64,11 +64,23 @@ class DistributedAttention:
         self.scatter_idx = scatter_idx  # head dim of [B,S,H,D]
         self.gather_idx = gather_idx    # sequence dim
 
+    def _align_gqa_local(self, q, k, v):
+        """sp=1 / passthrough: the local core expects matched head counts,
+        so native-width GQA kv repeats here (callers pass kv UN-repeated —
+        the sp>1 reshard aligns on the wire instead)."""
+        n_kv, H = k.shape[self.scatter_idx], q.shape[self.scatter_idx]
+        if n_kv != H:
+            rep = H // n_kv
+            k = jnp.repeat(k, rep, axis=self.scatter_idx)
+            v = jnp.repeat(v, rep, axis=self.scatter_idx)
+        return k, v
+
     # ---- traced form: call inside shard_map; x are local blocks ------------
     def attend_local(self, q, k, v, **kwargs):
         a = self.sp_axis
         sp = jax.lax.axis_size(a)
         if sp == 1:
+            k, v = self._align_gqa_local(q, k, v)
             return self.local_attn(q, k, v, **kwargs)
         H = q.shape[self.scatter_idx]
         hpad = (-H) % sp  # uneven heads: zero-pad to the next sp multiple
@@ -131,6 +143,7 @@ class DistributedAttention:
                     else groups.get_global_mesh())
         a = self.sp_axis
         if mesh.shape.get(a, 1) == 1:
+            key, value = self._align_gqa_local(query, key, value)
             return self.local_attn(query, key, value, **kwargs)
         key_ = (mesh, tuple(sorted(kwargs.items())))
         cache = getattr(self, "_jit_cache", None)

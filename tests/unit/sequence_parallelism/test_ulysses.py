@@ -155,3 +155,68 @@ def test_ulysses_region_manual_over_sp_only():
     assert found, "no shard_map in the Ulysses program"
     assert all(ax == frozenset({"sp"}) for ax in found), found
     groups.reset_mesh()
+
+
+def test_engine_trains_gqa_uneven_heads_under_sp():
+    """r5: a GQA model whose head counts violate every divisibility rule
+    (h=6, kv=2, sp=4) trains through the full engine path — initialize()
+    builds the sp mesh, the model hands NATIVE-width kv to
+    DistributedAttention (no pre-repeat; the routed a2a aligns GQA on the
+    wire), and loss decreases.  The jaxpr check pins that the q pad path
+    and the kv routing path are actually in the program."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import llama
+
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=6, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32", remat=False,
+        tie_word_embeddings=False, use_ulysses=True)
+    model = llama.LlamaModel(cfg)
+    ids = np.zeros((2, 32), np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, ids)["params"]
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg), model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 1},
+                "sequence_parallel_size": 4})
+    # the kv-routing path sees NATIVE kv width: the model's attention must
+    # not repeat kv to H before the a2a (that replication is what the
+    # routed reshard exists to avoid)
+    from deepspeed_tpu.sequence.layer import DistributedAttention
+    seen = {}
+    orig = DistributedAttention.__call__
+
+    def spy(self, query, key, value, **kw):
+        seen["kv_heads"] = key.shape[self.scatter_idx]
+        seen["q_heads"] = query.shape[self.scatter_idx]
+        return orig(self, query, key, value, **kw)
+
+    DistributedAttention.__call__ = spy
+    try:
+        jax.make_jaxpr(lambda p, x: eng._effective_apply_fn()(p, x, x))(
+            params, ids)
+    finally:
+        DistributedAttention.__call__ = orig
+    assert seen["kv_heads"] == 2, seen   # native width reached the a2a
+    assert seen["q_heads"] == 6, seen
+    assert eng.seq_parallel_world_size == 4
+    rng = np.random.default_rng(0)
+    bs = 2 * eng.dp_world_size
+    losses = []
+    for _ in range(4):
+        x = rng.integers(0, 64, (bs, 32)).astype(np.int32)
+        loss = eng(x, x)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # clean up ONLY after training — resetting mid-test would let the next
+    # forward auto-build a default sp=1 mesh and silently bypass Ulysses
+    groups.reset_mesh()
+    dist.destroy_process_group()
