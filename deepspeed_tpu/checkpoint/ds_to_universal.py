@@ -95,12 +95,13 @@ def _convert_infinity(root, output_dir):
 
     engine_state = {k: state.get(k, 0) for k in
                     ("global_steps", "global_samples", "micro_steps")}
-    # Carry lr_scheduler + client_state through (infinity_state.pkl stores
-    # both): the monolithic universal load restores es['lr_scheduler'] /
-    # es['client_state'], so dropping them here would silently restart the
-    # LR schedule on a streamed→universal→monolithic resume.  Universal
+    # Carry lr_scheduler/client_state/sampler/curriculum through
+    # (infinity_state.pkl stores them): the universal load restores each,
+    # so dropping them here would silently restart the LR schedule or the
+    # curriculum on a streamed→universal→monolithic resume.  Universal
     # meta is JSON, so anything non-serializable is dropped with a warning.
-    for key in ("lr_scheduler", "client_state"):
+    for key in ("lr_scheduler", "client_state", "data_sampler",
+                "curriculum_scheduler"):
         val = state.get(key)
         if not val:
             continue

@@ -87,6 +87,8 @@ def _load_into_infinity(engine, tag, meta, zero_root, load_opt, load_sched,
         # universal checkpoints converted from monolithic engines carry
         # engine_state['lr_scheduler'] — without this the schedule restarts.
         engine.lr_scheduler.load_state_dict(es["lr_scheduler"])
+    from ..runtime.checkpoint_engine import restore_data_state
+    restore_data_state(engine, es)
     engine._dev_resident = None
     engine._dev_blocks.clear()
     engine._pending_fetch.clear()
@@ -193,6 +195,11 @@ def load_universal_checkpoint(engine, load_dir, tag=None,
             "lr_scheduler" in es and \
             hasattr(engine.lr_scheduler, "load_state_dict"):
         engine.lr_scheduler.load_state_dict(es["lr_scheduler"])
+    # curriculum/sampler state rides engine_state through the converter —
+    # restore it like the native load path so a universal resume doesn't
+    # restart the curriculum
+    from ..runtime.checkpoint_engine import restore_data_state
+    restore_data_state(engine, es)
     log_dist(f"loaded universal checkpoint from {root} "
              f"(step {engine.global_steps})", ranks=[0])
     return tag, es.get("client_state", {})

@@ -107,6 +107,35 @@ def _pytree_restore(path, template=None, shardings=None):
     return ckptr.restore(path)
 
 
+def collect_data_state(engine):
+    """Sampler + legacy curriculum state to persist (reference
+    engine.py:3329/:3401).  Shared by the monolithic and streamed save
+    paths."""
+    out = {}
+    sampler = getattr(getattr(engine, "training_dataloader", None),
+                      "data_sampler", None)
+    if sampler is not None and hasattr(sampler, "state_dict"):
+        out["data_sampler"] = sampler.state_dict()
+    if getattr(engine, "curriculum_scheduler", None) is not None:
+        out["curriculum_scheduler"] = engine.curriculum_scheduler.state_dict()
+    return out
+
+
+def restore_data_state(engine, state):
+    """Inverse of collect_data_state (reference engine.py:2968): the
+    curriculum must not restart easy and consumed samples must not be
+    re-drawn.  Shared by the native, streamed, and universal load paths."""
+    sampler = getattr(getattr(engine, "training_dataloader", None),
+                      "data_sampler", None)
+    if sampler is not None and "data_sampler" in state and \
+            hasattr(sampler, "load_state_dict"):
+        sampler.load_state_dict(state["data_sampler"])
+    if getattr(engine, "curriculum_scheduler", None) is not None and \
+            "curriculum_scheduler" in state:
+        engine.curriculum_scheduler.load_state_dict(
+            state["curriculum_scheduler"])
+
+
 def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
                            save_latest=True, async_save=False):
     if tag is None:
@@ -127,16 +156,7 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     if engine.lr_scheduler is not None and hasattr(engine.lr_scheduler,
                                                    "state_dict"):
         state["lr_scheduler"] = engine.lr_scheduler.state_dict()
-    # data sampler + legacy curriculum state (reference engine.py:3329 /
-    # :3401 persist the sampler; resume must not restart the curriculum or
-    # re-consume samples)
-    sampler = getattr(getattr(engine, "training_dataloader", None),
-                      "data_sampler", None)
-    if sampler is not None and hasattr(sampler, "state_dict"):
-        state["data_sampler"] = sampler.state_dict()
-    if engine.curriculum_scheduler is not None:
-        state["curriculum_scheduler"] = \
-            engine.curriculum_scheduler.state_dict()
+    state.update(collect_data_state(engine))
 
     with open(os.path.join(root, "engine_state.json"), "w") as f:
         json.dump(state, f, indent=2)
@@ -235,18 +255,7 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
                                                     "load_state_dict"):
             engine.lr_scheduler.load_state_dict(state["lr_scheduler"])
 
-    # sampler + legacy curriculum resume (reference engine.py:2968): the
-    # curriculum must not restart easy and consumed samples must not be
-    # re-drawn
-    sampler = getattr(getattr(engine, "training_dataloader", None),
-                      "data_sampler", None)
-    if sampler is not None and "data_sampler" in state and \
-            hasattr(sampler, "load_state_dict"):
-        sampler.load_state_dict(state["data_sampler"])
-    if engine.curriculum_scheduler is not None and \
-            "curriculum_scheduler" in state:
-        engine.curriculum_scheduler.load_state_dict(
-            state["curriculum_scheduler"])
+    restore_data_state(engine, state)
 
     engine.global_steps = state["global_steps"]
     engine.global_samples = state["global_samples"]

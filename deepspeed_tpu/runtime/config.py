@@ -278,6 +278,16 @@ class DeepSpeedConfig:
         self.prescale_gradients = pd.get("prescale_gradients", False)
         self.gradient_predivide_factor = pd.get("gradient_predivide_factor", 1.0)
         self.sparse_gradients_enabled = pd.get("sparse_gradients", False)
+        if self.sparse_gradients_enabled:
+            # reference runtime/sparse_tensor.py compresses torch sparse
+            # embedding grads for the allreduce; XLA keeps embedding grads
+            # dense (scatter-add fused into the backward) and there is no
+            # sparse collective to route them through — reject rather than
+            # silently ignore the knob
+            raise ValueError(
+                "sparse_gradients is a torch sparse-embedding optimization "
+                "with no XLA analog (embedding grads are dense and the "
+                "scatter-add fuses into the backward); remove the key")
 
         self.zero_config = DeepSpeedZeroConfig(**pd.get("zero_optimization", {}) or {})
         self.zero_optimization_stage = self.zero_config.stage

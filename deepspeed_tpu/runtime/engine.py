@@ -182,7 +182,12 @@ class DeepSpeedEngine:
             want = {"pp": mc.pp, "sp": mc.sp, "tp": mc.tp, "ep": mc.ep}
             if mc.dp not in (-1, None):
                 want["dp"] = mc.dp
-            cur = dict(groups.get_global_mesh().shape)
+            # compare against MeshState TOTALS, not Mesh.shape — the grid's
+            # dp axis is dp_total/ep, so shape-based comparison would flag
+            # a spurious dp mismatch on every ep>1 mesh
+            ms = groups.get_mesh_state()
+            cur = {"pp": ms.pp, "dp": ms.dp, "sp": ms.sp, "tp": ms.tp,
+                   "ep": ms.ep}
             mismatch = {k: v for k, v in want.items()
                         if v and v > 1 and cur.get(k, 1) != v}
             if mismatch:

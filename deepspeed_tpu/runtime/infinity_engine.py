@@ -395,6 +395,7 @@ class InfinityEngine(DeepSpeedEngine):
         ensure_directory_exists(path)
         # snapshot NOW (export_* deep-copies): the next optimizer_sweep may
         # mutate the host store while an async writer is mid-dump
+        from .checkpoint_engine import collect_data_state
         state = {
             "master": self._store.export_master(),
             "opt": self._store.export_state(),
@@ -406,6 +407,7 @@ class InfinityEngine(DeepSpeedEngine):
                              hasattr(self.lr_scheduler, "state_dict")
                              else None),
             "client_state": client_state or {},
+            **collect_data_state(self),
         }
 
         def write():
@@ -451,6 +453,8 @@ class InfinityEngine(DeepSpeedEngine):
                 self.lr_scheduler is not None and \
                 hasattr(self.lr_scheduler, "load_state_dict"):
             self.lr_scheduler.load_state_dict(state["lr_scheduler"])
+        from .checkpoint_engine import restore_data_state
+        restore_data_state(self, state)
         self._dev_resident = None
         self._dev_blocks.clear()
         self.scale_state = self.loss_scaler.init()

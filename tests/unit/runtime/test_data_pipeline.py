@@ -393,3 +393,62 @@ def test_curriculum_sampler_gas_pacing():
     assert s.batch_step == 2
     assert s.curriculum_scheduler.get_current_difficulty() >= d_after_1
     assert len(s) == (n // 8) * 4               # micro batches per epoch
+
+
+def test_curriculum_survives_universal_checkpoint(tmp_path):
+    """r5: sampler/curriculum state rides the universal checkpoint too —
+    a monolithic→universal→monolithic round-trip continues the stream."""
+    import flax.linen as nn
+    from deepspeed_tpu.checkpoint.ds_to_universal import convert_to_universal
+    from deepspeed_tpu.checkpoint.universal_checkpoint import (
+        load_universal_checkpoint)
+    from deepspeed_tpu.utils import groups
+
+    n, D = 48, 8
+    rng = np.random.default_rng(5)
+    xs = rng.standard_normal((n, D)).astype(np.float32)
+    data = [(xs[i], 0.1 * xs[i]) for i in range(n)]
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            return jnp.mean((nn.Dense(D)(x) - y) ** 2)
+
+    def config():
+        return {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+            "data_efficiency": {"enabled": True, "data_sampling": {
+                "enabled": True, "curriculum_learning": {
+                    "enabled": True, "curriculum_metrics": {"idx": {
+                        "metric_values": list(range(n)),
+                        "min_difficulty": 12, "max_difficulty": n,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 6,
+                                            "difficulty_step": 1}}}}}},
+        }
+
+    def build():
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=Net(), model_parameters=Net().init(
+                jax.random.PRNGKey(0), xs[:1], xs[:1])["params"],
+            config=config(), training_data=data)
+        return eng
+
+    eng = build()
+    it = iter(eng.training_dataloader)
+    for _ in range(3):
+        eng.train_batch(it)
+    s = eng.training_dataloader.data_sampler
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    convert_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"),
+                         tag="t")
+    eng2 = build()
+    load_universal_checkpoint(eng2, str(tmp_path / "uni"))
+    s2 = eng2.training_dataloader.data_sampler
+    assert s2.batch_step == s.batch_step == 3
+    assert s2.consumed_samples == s.consumed_samples
+    assert s2.curriculum_scheduler.get_current_difficulty() == \
+        s.curriculum_scheduler.get_current_difficulty()
+    groups.reset_mesh()

@@ -120,3 +120,14 @@ def test_unknown_config_keys_tolerated():
         "zero_optimization": {"stage": 1, "round_robin_gradients": True},
         "aio": {"block_size": 1048576, "queue_depth": 8},
     }, steps=1)
+
+
+def test_sparse_gradients_rejected_loudly():
+    """r5: the torch-sparse-embedding knob has no XLA analog — parsing it
+    silently would let users believe the optimization is active."""
+    import pytest
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError, match="sparse_gradients"):
+        DeepSpeedConfig({"train_batch_size": 8, "sparse_gradients": True})
+    DeepSpeedConfig({"train_batch_size": 8, "sparse_gradients": False})
