@@ -1,18 +1,24 @@
 """TP overlap measurement (Domino parity artifact — see package docstring).
 
 ``measure_tp_overlap`` compiles a function and inspects the optimized HLO
-schedule: on TPU, XLA's latency-hiding scheduler splits each collective into
-``<op>-start`` / ``<op>-done`` and moves independent compute between them —
-exactly the overlap Domino hand-codes with µ-streams.  The report counts
+schedule.  On GPU/CPU backends XLA's latency-hiding scheduler splits each
+collective into ``<op>-start`` / ``<op>-done`` and moves independent
+compute between them — exactly the overlap Domino hand-codes with
+µ-streams.  The report counts
 
 * ``collectives``      — collective ops in the optimized module,
 * ``async_pairs``      — start/done-split (overlappable) collectives,
 * ``overlapped_pairs`` — async collectives with ≥1 real compute op
-                         scheduled inside the start→done window,
+                         scheduled inside the start→done window.
 
-so a TP config can assert its all-reduces are hidden (reference blog claims
-up to 1.3×; here the compiler provides the schedule and this tool the
-evidence).
+CAVEAT (measured 2026-07-31, v5e:2x2 AOT — tools/domino_overlap_tpu.py):
+TPU optimized HLO does NOT express overlap as async pairs at all — each
+collective stays one scheduled op whose ``collective_algorithm_config``
+(ring emitters + scoped-memory barriers) pipelines the ICI transfer
+in-op.  ``async_pairs == 0`` on TPU text therefore means "criterion
+inapplicable", not "no overlap" — adjudicate with ``domino_ab``'s
+wall-clock A/B on ≥2 chips (reference blog claims up to 1.3×; here the
+compiler provides the schedule and this tool the evidence).
 """
 
 import re

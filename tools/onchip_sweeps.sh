@@ -55,9 +55,11 @@ leg gmm python -m deepspeed_tpu.profiling.kernel_bench --gmm
 leg bert python bench.py --mode bert
 
 # 6) Domino TP-overlap evidence from TPU-compiled HLO (VERDICT r4 item 7):
-# compile-only tp=2 program; result → .bench_runs/domino_overlap.json
+# tp=2 program; result → .bench_runs/domino_overlap.json.  DS_DOMINO_REAL
+# prefers the live device set (falls back to compile-only AOT topology when
+# fewer than 2 chips are reachable — the tunnel serves one).
 echo "=== domino overlap $(date) ==="
-timeout 900 python tools/domino_overlap_tpu.py || true
+timeout 900 env DS_DOMINO_REAL=1 python tools/domino_overlap_tpu.py || true
 
 echo "=== sweeps done $(date) ==="
 grep -H . "$OUT"/*.json 2>/dev/null
