@@ -1,6 +1,7 @@
 """Shared Pallas helpers."""
 
 import functools
+import os
 
 import jax
 
@@ -13,7 +14,15 @@ def interpret_mode() -> bool:
     plugins (e.g. the remote-TPU 'axon' platform) may expose a platform
     string that isn't literally "tpu" while still being a real TPU — running
     Mosaic kernels interpreted there would silently destroy performance.
+
+    ``DS_TPU_PALLAS_INTERPRET=0|1`` overrides the probe entirely — needed
+    by AOT compile-checks (tools/aot_kernel_check.py), which target a TPU
+    topology while the DEFAULT backend is CPU (and the probe's
+    jax.devices() can block on a dark device tunnel).
     """
+    forced = os.environ.get("DS_TPU_PALLAS_INTERPRET")
+    if forced is not None:
+        return forced not in ("0", "false", "False")
     try:
         dev = jax.devices()[0]
     except Exception:

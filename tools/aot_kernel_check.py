@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""AOT compile-check the Pallas kernel suite against a REAL TPU target.
+
+The CPU test suite runs Pallas kernels in interpreter mode, so a
+Mosaic-only lowering error (bad block shape, unsupported op, layout
+mismatch) only surfaces on real hardware.  This tool compiles each kernel
+ahead-of-time against a v5e topology description — needs the TPU COMPILE
+service but no allocated chips (observed 2026-07-31: topology compiles
+succeeded in windows where device allocation attempts failed; when the
+tunnel is fully dark even get_topology_desc parks on an epoll wait, so
+run under `timeout`).  Reports per-kernel PASS/FAIL; chained into
+tools/onchip_sweeps.sh.
+
+Writes .bench_runs/aot_kernel_check.json.
+"""
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# The accelerator autodetect would call jax.devices(), which blocks on the
+# axon tunnel when it is down — this tool never executes anything, so pin
+# the host accelerator before any deepspeed_tpu import.
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+# Force compiled (Mosaic) kernels: the DEFAULT backend here is CPU but the
+# AOT target is a TPU — without the override every kernel would compile in
+# interpreter mode and the check would be vacuous.  This must be an env
+# var (not a monkeypatch): the pallas package's __init__ imports the
+# kernel modules, which bind the interpret flag at import time.
+os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
+
+OUT_PATH = os.path.join(ROOT, ".bench_runs", "aot_kernel_check.json")
+
+# ORDER MATTERS: fetch the topology BEFORE any deepspeed_tpu import —
+# package import paths can initialize the backend set, after which
+# get_topology_desc("tpu") parks behind the (possibly tunnel-blocked)
+# plugin discovery lock.
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+
+
+def _fetch_topology():
+    last = None
+    for name, kw in (("v5e:1x1", {"chips_per_host_bounds": [1, 1, 1]}),
+                     ("v5e:2x2", {}), ("v6e:2x2", {}), ("v4:2x2x1", {})):
+        try:
+            return name, topologies.get_topology_desc(
+                name, platform="tpu", **kw)
+        except Exception as e:
+            last = e
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    json.dump({"ok": False,
+               "error": f"no TPU topology reachable: {last}"},
+              open(OUT_PATH, "w"))
+    print(f"FAILED: no TPU topology reachable: {last}")
+    sys.exit(1)
+
+
+_TOPO_NAME, _TOPO = _fetch_topology()
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def _topo_mesh(n=1):
+    return Mesh(np.array(_TOPO.devices[:n]), ("dp",))
+
+
+def _sds(shape, dtype, mesh):
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=NamedSharding(mesh, P()))
+
+
+def check(name, fn, *args):
+    try:
+        jax.jit(fn).lower(*args).compile()
+        return name, "PASS", ""
+    except Exception as e:
+        return name, "FAIL", f"{type(e).__name__}: {str(e)[:300]}"
+
+
+def main():
+    mesh = _topo_mesh(1)
+    bf16 = jnp.bfloat16
+    B, S, H, D = 2, 1024, 8, 128
+    results = []
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    q = _sds((B, S, H, D), bf16, mesh)
+    kv = _sds((B, S, 2, D), bf16, mesh)
+    results.append(check("flash_attention(MHA causal)",
+                         lambda q, k, v: flash_attention(q, k, v,
+                                                         causal=True),
+                         q, q, q))
+    results.append(check("flash_attention(GQA window)",
+                         lambda q, k, v: flash_attention(
+                             q, k, v, causal=True, window=256), q, kv, kv))
+
+    from deepspeed_tpu.ops.pallas.flash_bias import flash_attention_bias
+    bias = _sds((B, H, S, S), bf16, mesh)
+    results.append(check(
+        "flash_bias(evoformer)",
+        lambda q, k, v, b: flash_attention_bias(q, k, v, bias=b),
+        q, q, q, bias))
+
+    from deepspeed_tpu.ops.pallas.optimizers import (fused_adam_step,
+                                                     fused_lamb_step,
+                                                     fused_lion_step)
+    n = 1 << 16
+    p = _sds((n, ), jnp.float32, mesh)
+    results.append(check(
+        "fused_adam_step",
+        lambda g, mst, m, v: fused_adam_step(
+            g, mst, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+            weight_decay=0.0, count=1), p, p, p, p))
+    results.append(check(
+        "fused_lamb_step",
+        lambda g, mst, m, v: fused_lamb_step(
+            g, mst, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+            weight_decay=0.01, count=1), p, p, p, p))
+    results.append(check(
+        "fused_lion_step",
+        lambda g, mst, m: fused_lion_step(g, mst, m, lr=1e-4, beta1=0.9,
+                                          beta2=0.99, weight_decay=0.0),
+        p, p, p))
+
+    from deepspeed_tpu.ops.pallas.quantizer import (quantize_blockwise,
+                                                    dequantize_blockwise)
+    x = _sds((4096, 512), jnp.float32, mesh)
+
+    def qdq(x):
+        qv, scales, meta = quantize_blockwise(x, num_bits=8)
+        return dequantize_blockwise(qv, scales, meta)
+
+    results.append(check("quantizer(int8 block)", qdq, x))
+
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
+    n_blocks, block_sz = 64, 16
+    pq = _sds((8, H, D), bf16, mesh)               # one token per seq
+    kc = _sds((n_blocks, block_sz, H, D), bf16, mesh)
+    bt = _sds((8, 8), jnp.int32, mesh)             # block table
+    ln = _sds((8, ), jnp.int32, mesh)
+    results.append(check(
+        "paged_attention(decode)",
+        lambda q, k, v, t, l: paged_attention(q, k, v, t, l), pq, kc, kc,
+        bt, ln))
+
+    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
+    lhs = _sds((512, 256), bf16, mesh)
+    rhs = _sds((4, 256, 128), bf16, mesh)
+    sizes = _sds((4, ), jnp.int32, mesh)
+    results.append(check("gmm(moe grouped matmul)",
+                         lambda a, b, s: gmm(a, b, s), lhs, rhs, sizes))
+
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_flash_attention)
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    blk = 64
+    layout = np.asarray(FixedSparsityConfig(num_heads=H,
+                                            block=blk).make_layout(S))
+    results.append(check(
+        "block_sparse_flash_attention(fixed)",
+        lambda q, k, v: block_sparse_flash_attention(
+            q, k, v, layout=jnp.asarray(layout), block=blk), q, q, q))
+
+    ok = all(r[1] == "PASS" for r in results)
+    for name, status, err in results:
+        print(f"{status:4s} {name}" + (f"  {err}" if err else ""))
+    out = {"target": f"{_TOPO_NAME} (AOT topology)", "ok": ok,
+           "results": [{"kernel": n, "status": s, "error": e}
+                       for n, s, e in results]}
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    json.dump(out, open(OUT_PATH, "w"), indent=2)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

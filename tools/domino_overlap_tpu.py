@@ -72,9 +72,14 @@ def main():
     import numpy as np
     mesh = None
     if os.environ.get("DS_DOMINO_REAL") == "1":
-        # opt-in: a live multi-chip backend (jax.devices() blocks on the
-        # tunnel when it is down, so this is not the default)
-        devs = jax.devices()
+        # opt-in: a live multi-chip backend.  jax.devices() can BLOCK on a
+        # dark tunnel (run under `timeout`, as the sweep does) and can
+        # raise — either way fall through to the AOT topology path.
+        try:
+            devs = jax.devices()
+        except Exception as e:
+            print(f"real-device probe failed ({e}); falling back to AOT")
+            devs = []
         if len(devs) >= 2 and devs[0].platform == "tpu":
             n = 4 if len(devs) >= 4 else 2
             mesh = Mesh(np.array(devs[:n]).reshape(n // 2, 2),
