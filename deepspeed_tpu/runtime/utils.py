@@ -76,6 +76,16 @@ def partition_balanced(weights, num_parts):
     # pad to exactly num_parts+1 boundaries
     while len(best) < num_parts + 1:
         best.append(n)
+    if n >= num_parts:
+        # The greedy packer may use fewer parts than requested, leaving
+        # empty trailing parts (repeated boundaries) — an empty PIPELINE
+        # STAGE downstream.  Borrow one item from the left neighbor for
+        # each empty part, back to front: the shrunken neighbor can only
+        # get lighter and the new 1-item part weighs ≤ max(weights) ≤ the
+        # found bottleneck, so optimality is preserved.
+        for i in range(num_parts - 1, 0, -1):
+            if best[i] >= best[i + 1]:
+                best[i] = best[i + 1] - 1
     return best
 
 
