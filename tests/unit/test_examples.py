@@ -38,3 +38,9 @@ def test_example_serve_fastgen():
 def test_example_infinity_offload():
     out = _run("infinity_offload.py")
     assert "hbm_param_bytes=0" in out
+
+
+def test_example_data_efficiency():
+    out = _run("data_efficiency.py")
+    assert "difficulty<=" in out
+    assert "resumed mid-schedule" in out
