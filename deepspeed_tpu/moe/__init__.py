@@ -3,3 +3,7 @@ from .checkpoint import (is_expert_path, load_moe_expert_files,
 from .experts import ExpertFFN, Experts, expert_sharding_rules
 from .layer import MoE
 from .sharded_moe import TopKGate, top1gating, top2gating, topkgating
+from .utils import (configure_moe_param_groups, has_moe_layers,
+                    is_moe_param, is_moe_param_group, moe_param_mask,
+                    split_params_grads_into_shared_and_expert_params,
+                    split_params_into_shared_and_expert_params)

@@ -1,0 +1,95 @@
+"""MoE parameter-group utilities — analog of reference
+``deepspeed/moe/utils.py`` (``is_moe_param`` :27,
+``split_params_into_shared_and_expert_params`` :33,
+``split_params_into_different_moe_groups_for_optimizer`` :72,
+``configure_moe_param_groups`` :155, ``has_moe_layers`` :15).
+
+The reference tags torch Parameters with ``.allreduce=False`` and splits
+optimizer param groups so expert grads reduce over expert-DP groups and
+experts can carry their own lr/weight-decay.  Under SPMD the grad
+reduction is already correct by sharding (expert leaves live on the "ep"
+axis), so what remains user-facing is the GROUPING itself: identifying
+expert leaves by pytree path and deriving masks/splits that plug into
+optax (``adamw(mask=...)``, ``multi_transform``) or the engine's
+optimizer config.
+"""
+
+import jax
+
+from .checkpoint import is_expert_path
+from ..runtime.zero.partition import path_str
+
+
+def is_moe_param(path_or_keypath) -> bool:
+    """True if the pytree path addresses a stacked-expert leaf.
+
+    Accepts a ``"a/b/c"`` string or a jax key-path tuple (reference
+    ``is_moe_param`` reads a ``.allreduce`` tag off the tensor; JAX params
+    carry identity in their tree path instead)."""
+    if not isinstance(path_or_keypath, str):
+        path_or_keypath = path_str(path_or_keypath)
+    return is_expert_path(path_or_keypath)
+
+
+def has_moe_layers(params):
+    """(bool, num_expert_leaves) — reference ``has_moe_layers`` :15."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    n = sum(1 for kp, _ in flat if is_moe_param(kp))
+    return n > 0, n
+
+
+def moe_param_mask(params, experts=True):
+    """Boolean pytree matching ``params``: True on expert leaves (or the
+    complement with ``experts=False``).  Plugs directly into
+    ``optax.adamw(..., mask=moe_param_mask(params, experts=False))`` —
+    the reference tutorial's 'no weight decay on experts' recipe."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, _: is_moe_param(kp) == experts, params)
+
+
+def split_params_into_shared_and_expert_params(params):
+    """(shared, expert): two pytrees shaped like ``params`` where the
+    other split's leaves are ``None`` (the functional analog of the
+    reference's two python lists).  NOTE: jax treats ``None`` as an empty
+    subtree, so ``tree_map`` against the FULL ``params`` tree needs
+    ``is_leaf=lambda x: x is None`` — for per-leaf selection prefer
+    :func:`moe_param_mask` (a boolean tree with identical treedef)."""
+    shared = jax.tree_util.tree_map_with_path(
+        lambda kp, v: None if is_moe_param(kp) else v, params)
+    expert = jax.tree_util.tree_map_with_path(
+        lambda kp, v: v if is_moe_param(kp) else None, params)
+    return shared, expert
+
+
+def split_params_grads_into_shared_and_expert_params(grads):
+    """Reference :46 — identical split applied to a grad tree."""
+    return split_params_into_shared_and_expert_params(grads)
+
+
+def configure_moe_param_groups(params, expert_lr=None,
+                               expert_weight_decay=None):
+    """Torch-parity param groups (reference :72/:155): a list of dicts —
+    one shared group and one expert group, the expert group carrying its
+    optional lr/weight_decay overrides.  Each group's ``"params"`` holds
+    its None-holed split of the param tree; the optax-style LABEL tree
+    (``"shared"``/``"expert"`` per leaf, treedef identical to ``params``)
+    lives under the FIRST group's ``"param_labels"`` key — that tree is
+    what ``optax.multi_transform`` takes."""
+    labels = jax.tree_util.tree_map_with_path(
+        lambda kp, _: "expert" if is_moe_param(kp) else "shared", params)
+    shared, expert = split_params_into_shared_and_expert_params(params)
+    groups = [
+        {"name": "shared", "params": shared, "moe": False,
+         "param_labels": labels},
+        {"name": "expert", "params": expert, "moe": True},
+    ]
+    if expert_lr is not None:
+        groups[1]["lr"] = expert_lr
+    if expert_weight_decay is not None:
+        groups[1]["weight_decay"] = expert_weight_decay
+    return groups
+
+
+def is_moe_param_group(param_group) -> bool:
+    """Reference :151."""
+    return bool(param_group.get("moe", False))

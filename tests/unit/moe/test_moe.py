@@ -158,3 +158,67 @@ def test_topk_no_drop_routes_every_token():
                                          drop_tokens=False)
     per_tok = jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2))
     assert int(per_tok.min()) == K, "tokens dropped despite drop_tokens=False"
+
+
+def test_moe_param_group_utils():
+    """r5 (reference moe/utils.py :15-:155): expert/shared identification,
+    structure-preserving splits, optax-ready masks and param groups on a
+    real MoE model's params."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.models import mixtral
+    from deepspeed_tpu.moe import (configure_moe_param_groups,
+                                   has_moe_layers, is_moe_param,
+                                   is_moe_param_group, moe_param_mask,
+                                   split_params_into_shared_and_expert_params)
+
+    cfg = mixtral.mixtral_tiny(dtype="float32")
+    model = mixtral.MixtralModel(cfg)
+    ids = np.zeros((2, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, ids)["params"]
+
+    present, n = has_moe_layers(params)
+    assert present and n > 0
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    expert_paths = [kp for kp, _ in flat if is_moe_param(kp)]
+    shared_paths = [kp for kp, _ in flat if not is_moe_param(kp)]
+    assert expert_paths and shared_paths
+
+    shared, expert = split_params_into_shared_and_expert_params(params)
+    # same treedef; complementary None holes
+    assert jax.tree_util.tree_structure(shared, is_leaf=lambda x: x is None) \
+        == jax.tree_util.tree_structure(expert, is_leaf=lambda x: x is None)
+    sh_leaves = [v for _, v in
+                 jax.tree_util.tree_flatten_with_path(
+                     shared, is_leaf=lambda x: x is None)[0]]
+    ex_leaves = [v for _, v in
+                 jax.tree_util.tree_flatten_with_path(
+                     expert, is_leaf=lambda x: x is None)[0]]
+    assert sum(v is not None for v in ex_leaves) == n
+    assert sum(v is None for v in sh_leaves) == n
+
+    mask = moe_param_mask(params)                 # True on experts
+    assert sum(jax.tree_util.tree_leaves(mask)) == n
+    inv = moe_param_mask(params, experts=False)
+    assert sum(jax.tree_util.tree_leaves(inv)) == len(flat) - n
+
+    groups = configure_moe_param_groups(params, expert_lr=1e-4,
+                                        expert_weight_decay=0.0)
+    assert [g["name"] for g in groups] == ["shared", "expert"]
+    assert not is_moe_param_group(groups[0])
+    assert is_moe_param_group(groups[1])
+    assert groups[1]["lr"] == 1e-4
+    labels = groups[0]["param_labels"]
+    assert sum(l == "expert"
+               for l in jax.tree_util.tree_leaves(labels)) == n
+
+    # the labels drive a real optax.multi_transform step
+    import optax
+    tx = optax.multi_transform(
+        {"shared": optax.adamw(1e-3), "expert": optax.adamw(1e-4)}, labels)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jax.numpy.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    assert jax.tree_util.tree_structure(updates) == \
+        jax.tree_util.tree_structure(params)
