@@ -46,6 +46,22 @@ def debug_report():
         ("python version", sys.version.split()[0]),
         ("python platform", sys.platform),
     ]
+    # aio engine probe (reference async_io report role).  Report-only: a
+    # cold cache must NOT trigger the g++ JIT build mid-report (this tool
+    # must never hang), and a setup probe is reported as such — the real
+    # resolution happens at AIOHandle construction.
+    try:
+        from .ops.aio import AsyncIOBuilder, uring_available
+        if not os.path.exists(AsyncIOBuilder().lib_path()):
+            rows.append(("aio engine (auto)",
+                         "not built yet (first AIOHandle builds it)"))
+        elif uring_available():
+            rows.append(("aio engine (auto)", "io_uring (setup probe ok)"))
+        else:
+            rows.append(("aio engine (auto)",
+                         "thread-pool (io_uring setup refused)"))
+    except Exception as e:
+        rows.append(("aio engine (auto)", f"unavailable: {e}"))
     for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
         v = _version(mod)
         rows.append((f"{mod} version", v if v else "not installed"))
