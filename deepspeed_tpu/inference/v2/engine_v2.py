@@ -61,11 +61,20 @@ class InferenceEngineV2:
         if tp > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
             devs = jax.devices()
-            if len(devs) % tp or cfg.num_key_value_heads % tp:
+            n_kv = cfg.num_key_value_heads
+            n_q = getattr(cfg, "num_attention_heads", n_kv)
+            # GQA with fewer kv heads than tp ranks: REPLICATE kv (cache +
+            # k/v projections — the reference's kernel injection replicates
+            # kv heads the same way for tp > n_kv); q/o still shard.
+            kv_replicated = (n_kv % tp != 0 and tp % n_kv == 0
+                             and n_q % tp == 0)
+            if len(devs) % tp or (n_kv % tp and not kv_replicated):
                 raise ValueError(
-                    f"tp_size={tp} must divide both the device count "
-                    f"({len(devs)}) and num_key_value_heads "
-                    f"({cfg.num_key_value_heads})")
+                    f"tp_size={tp} must divide the device count "
+                    f"({len(devs)}) and either num_key_value_heads "
+                    f"({n_kv}) or — for replicated-kv GQA — be a "
+                    f"multiple of it with num_attention_heads ({n_q}) "
+                    "divisible by tp")
             self._tp_mesh = Mesh(np.array(devs[:tp]), ("tp", ))
             from ...module_inject import shard_params_for_tp
             rules = None
@@ -75,10 +84,23 @@ class InferenceEngineV2:
                 # shard_params_for_tp restricts specs to the mesh's axes
                 # (drops 'zero'/'ep' etc. training pseudo-axes)
                 rules = mod.tp_rules(cfg)
+            if kv_replicated and rules is not None:
+                # replication is the INTENDED layout here — override the
+                # k/v rules explicitly rather than riding the divisibility
+                # fallback (which warns per layer as if misconfigured)
+                rules = dict(rules)
+                for key in list(rules):
+                    if "k_proj" in key or "v_proj" in key:
+                        rules[key] = P()
             self.params = shard_params_for_tp(self.params, self._tp_mesh,
                                               rules=rules)
+            # kv cache: shard over kv heads when they divide tp, else the
+            # replicated-kv GQA mode (k/v proj leaves auto-replicate in
+            # shard_params_for_tp via the divisibility fallback)
             self._kv_sharding = NamedSharding(
-                self._tp_mesh, P(None, None, None, None, "tp", None))
+                self._tp_mesh,
+                P() if kv_replicated
+                else P(None, None, None, None, "tp", None))
         else:
             self._kv_sharding = None
 

@@ -181,12 +181,15 @@ def test_v2_tensor_parallel_matches_single():
 
 
 def test_v2_tp_rejects_indivisible():
+    """kv=1 (MQA) with tp=2 is now VALID (replicated-kv mode, r5); a truly
+    indivisible config — kv neither divisible by nor a divisor of tp —
+    still rejects with config vocabulary."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.models import llama
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
     cfg = llama.llama_tiny(dtype="float32", remat=False,
-                           num_key_value_heads=1)
+                           num_attention_heads=6, num_key_value_heads=3)
     model = llama.LlamaModel(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
@@ -488,3 +491,43 @@ def test_burst_shrinks_to_block_budget():
     # fresh engine w/ roomy pool for the reference
     expected = ref.generate(prompts, max_new_tokens=8)
     assert out == expected
+
+
+def test_v2_tp_gqa_replicated_kv_matches_single():
+    """r5: GQA serving with MORE tp ranks than kv heads (tp=4, kv=2) — kv
+    cache and k/v projections replicate while q/o shard (the reference's
+    kernel-injection kv replication); greedy output equals tp=1."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+    cfg = llama.llama_tiny(dtype="float32", remat=False,
+                           num_key_value_heads=2)
+    assert cfg.num_attention_heads % 4 == 0
+    model = llama.LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+              max_ragged_sequence_count=8, max_context=128,
+              block_size=16, num_blocks=40)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 96, size=n).tolist() for n in (19, 9)]
+    outs = {}
+    for tp in (1, 4):
+        eng = InferenceEngineV2(
+            model, params=params,
+            config=dict(dtype="float32", state_manager=dict(sm),
+                        tensor_parallel=dict(tp_size=tp)))
+        if tp > 1:
+            # kv cache replicated; q_proj sharded over 4 ranks
+            assert len(eng._kv.sharding.device_set) == 4
+            from jax.sharding import PartitionSpec as P
+            assert eng._kv.sharding.spec == P()
+            qk = eng.params["layers_0"]["self_attn"]["q_proj"]["kernel"]
+            assert "tp" in str(qk.sharding.spec)
+            kk = eng.params["layers_0"]["self_attn"]["k_proj"]["kernel"]
+            assert kk.sharding.spec == P()   # auto-replicated (2 % 4)
+        outs[tp] = eng.generate(prompts, max_new_tokens=5)
+        eng.flush(range(len(prompts)))
+    assert outs[1] == outs[4]
