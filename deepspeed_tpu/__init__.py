@@ -8,7 +8,9 @@ at ``:291``, ``add_config_arguments`` at ``:268``).
 """
 
 __version__ = "0.4.0"   # keep in sync with version.txt (setup.py reads it)
-__git_branch__ = "main"
+# __git_branch__/git_hash/git_branch resolve lazily from the checkout (see
+# __getattr__); "unknown" outside a git checkout
+__git_branch__ = "unknown"
 
 from . import comm
 from . import utils
@@ -196,4 +198,44 @@ def __getattr__(name):
     if name == "zero":
         from .runtime import zero
         return zero
+    if name == "init_distributed":
+        # reference deepspeed.init_distributed (deepspeed/__init__.py)
+        return comm.init_distributed
+    if name in ("add_tuning_arguments", "get_config_from_args"):
+        from .runtime import lr_schedules
+        return getattr(lr_schedules, name)
+    if name == "checkpointing":
+        # reference deepspeed.checkpointing module alias
+        from .runtime.activation_checkpointing import checkpointing
+        return checkpointing
+    if name == "ops":
+        # NOT `from . import ops`: inside the package's own __getattr__
+        # that spelling re-enters this function before sys.modules is
+        # populated and recurses
+        import importlib
+        return importlib.import_module(".ops", __name__)
+    if name in ("git_hash", "git_branch"):
+        # reference bakes these at build; derive lazily from the checkout
+        # and memoize (PEP 562: the globals() write makes later accesses
+        # bypass __getattr__ — no subprocess per read)
+        import os as _os
+        import subprocess
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        out = {"git_hash": "unknown", "git_branch": "unknown"}
+        if _os.path.isdir(_os.path.join(root, ".git")):
+            # only trust git when THIS checkout is the repo — a
+            # pip-installed copy inside someone else's repository must not
+            # report their HEAD
+            for key, arg in (("git_hash", ("rev-parse", "--short", "HEAD")),
+                             ("git_branch",
+                              ("rev-parse", "--abbrev-ref", "HEAD"))):
+                try:
+                    out[key] = subprocess.check_output(
+                        ("git", "-C", root) + arg, text=True,
+                        stderr=subprocess.DEVNULL).strip()
+                except Exception:
+                    pass
+        globals().update(out)
+        globals()["__git_branch__"] = out["git_branch"]
+        return out[name]
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

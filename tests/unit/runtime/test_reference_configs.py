@@ -131,3 +131,19 @@ def test_sparse_gradients_rejected_loudly():
     with pytest.raises(ValueError, match="sparse_gradients"):
         DeepSpeedConfig({"train_batch_size": 8, "sparse_gradients": True})
     DeepSpeedConfig({"train_batch_size": 8, "sparse_gradients": False})
+
+
+def test_top_level_api_surface():
+    """r5: reference deepspeed top-level names users import (beyond
+    initialize/init_inference, covered elsewhere) resolve here too."""
+    import types
+    import deepspeed_tpu as ds
+
+    assert callable(ds.init_distributed)
+    assert callable(ds.add_tuning_arguments)
+    assert callable(ds.replace_transformer_layer)
+    assert isinstance(ds.ops, types.ModuleType)
+    assert hasattr(ds.checkpointing, "checkpoint") or \
+        hasattr(ds.checkpointing, "configure")
+    assert isinstance(ds.git_hash, str) and isinstance(ds.git_branch, str)
+    assert ds.OnDevice is not None and ds.zero is not None
