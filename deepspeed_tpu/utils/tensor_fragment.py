@@ -95,14 +95,19 @@ def safe_get_full_fp32_param(engine, name):
     return np.asarray(leaf, dtype=np.float32)
 
 
+def _live_scale(engine):
+    return (float(engine.scale_state.scale)
+            if engine.scale_state is not None else 1.0)
+
+
 def safe_get_full_grad(engine, name):
     """Full accumulated gradient, unscaled (reference :158)."""
+    _resident(engine, "grad_acc")
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
     g = np.asarray(leaf, dtype=np.float32)
-    scale = float(engine.scale_state.scale) if engine.scale_state is not None else 1.0
-    return g / scale
+    return g / _live_scale(engine)
 
 
 def safe_get_full_optimizer_state(engine, name, state_key):
@@ -154,6 +159,25 @@ def safe_set_full_optimizer_state(engine, name, state_key, value):
 
 
 # ------------------------------------------------------- local (shard) view
+def _shard_block_slices(leaf, shards):
+    """(block_shape, [(shard, slice-within-block)]) for this host's shards'
+    union bounding box — the ONE place get/set shard geometry lives."""
+    nd = leaf.ndim
+    starts = [min((s.index[d].start or 0) for s in shards)
+              for d in range(nd)]
+    stops = [max((s.index[d].stop if s.index[d].stop is not None
+                  else leaf.shape[d]) for s in shards) for d in range(nd)]
+    out_shape = [hi - lo for lo, hi in zip(starts, stops)]
+    pairs = []
+    for s in shards:
+        sl = tuple(
+            slice((ix.start or 0) - lo,
+                  (ix.stop if ix.stop is not None else dim) - lo)
+            for ix, lo, dim in zip(s.index, starts, leaf.shape))
+        pairs.append((s, sl))
+    return out_shape, pairs
+
+
 def _local_block(leaf, dtype=np.float32):
     """Stitch this host's addressable shards into one array covering their
     union bounding box (a host driving several chips owns several shards)."""
@@ -162,7 +186,6 @@ def _local_block(leaf, dtype=np.float32):
         return None
     if len(shards) == 1:
         return np.asarray(shards[0].data, dtype=dtype)
-    nd = leaf.ndim
     # Dedup replicated shards (several local devices may hold the same slice).
     by_index = {}
     for s in shards:
@@ -170,16 +193,10 @@ def _local_block(leaf, dtype=np.float32):
                     for ix, dim in zip(s.index, leaf.shape))
         by_index.setdefault(key, s)
     shards = list(by_index.values())
-    starts = [min((s.index[d].start or 0) for s in shards) for d in range(nd)]
-    stops = [max((s.index[d].stop if s.index[d].stop is not None
-                  else leaf.shape[d]) for s in shards) for d in range(nd)]
-    out = np.zeros([hi - lo for lo, hi in zip(starts, stops)], dtype=dtype)
+    out_shape, pairs = _shard_block_slices(leaf, shards)
+    out = np.zeros(out_shape, dtype=dtype)
     covered = 0
-    for s in shards:
-        sl = tuple(
-            slice((ix.start or 0) - lo,
-                  (ix.stop if ix.stop is not None else dim) - lo)
-            for ix, lo, dim in zip(s.index, starts, leaf.shape))
+    for s, sl in pairs:
         out[sl] = np.asarray(s.data, dtype=dtype)
         covered += int(np.prod([x.stop - x.start for x in sl]))
     if covered != out.size:
@@ -191,6 +208,91 @@ def _local_block(leaf, dtype=np.float32):
             f"(covered {covered} of {out.size} elements); read the full "
             "tensor via safe_get_full_fp32_param instead")
     return out
+
+
+def _set_local_block(leaf, value):
+    """Inverse of :func:`_local_block`: scatter ``value`` (this host's
+    contiguous block) back into the host's addressable shards, returning a
+    new global array with every other host's data untouched."""
+    value = np.asarray(value)
+    shards = list(leaf.addressable_shards)
+    if not shards:
+        raise ValueError(
+            "no addressable shards of this array on this host — local "
+            "set/get only touch locally-owned data")
+    _, pairs = _shard_block_slices(leaf, shards)
+    arrays = []
+    for s, sl in pairs:
+        blk = np.ascontiguousarray(value[sl]).astype(leaf.dtype)
+        if blk.shape != tuple(x.stop - x.start for x in sl):
+            raise ValueError(
+                f"local value shape {value.shape} does not cover this "
+                f"host's shard block")
+        arrays.append(jax.device_put(blk, s.device))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, arrays)
+
+
+def safe_set_full_grad(engine, name, value):
+    """Overwrite the full accumulated gradient (reference :171).  ``value``
+    is UNSCALED; it is stored re-multiplied by the live loss scale so
+    :func:`safe_get_full_grad` round-trips."""
+    _resident(engine, "grad_acc")
+    leaf = _lookup(engine.grad_acc, name)
+    if leaf is None:
+        raise KeyError(f"no accumulated grad for {name!r} (call backward "
+                       "before setting grads)")
+    new = jax.device_put(
+        jnp.asarray(value, dtype=leaf.dtype) * _live_scale(engine),
+        leaf.sharding)
+    engine.grad_acc = _set_leaf(engine.grad_acc, name, new)
+
+
+def safe_set_local_fp32_param(engine, name, value):
+    """Overwrite THIS host's shard of the fp32 master (reference ZeRO-3
+    local API :300).  The compute-dtype copy refreshes at the next
+    boundary apply (master is the source of truth there); with no master
+    (pure fp32 stage-0) the params leaf IS the master and is written
+    directly.  NOTE the master and compute copies may be sharded
+    differently, so only the master's local geometry is meaningful here —
+    use :func:`safe_set_full_fp32_param` to update both views at once."""
+    _resident(engine, "master", "params")
+    if engine.master is not None:
+        old = _lookup(engine.master, name)
+        engine.master = _set_leaf(engine.master, name,
+                                  _set_local_block(old, value))
+    else:
+        oldp = _lookup(engine.params, name)
+        engine.params = _set_leaf(engine.params, name,
+                                  _set_local_block(oldp, value))
+
+
+def safe_set_local_grad(engine, name, value):
+    """Overwrite this host's shard of the accumulated grad (unscaled in,
+    scaled storage — reference :190)."""
+    _resident(engine, "grad_acc")
+    leaf = _lookup(engine.grad_acc, name)
+    if leaf is None:
+        raise KeyError(f"no accumulated grad for {name!r}")
+    engine.grad_acc = _set_leaf(
+        engine.grad_acc, name,
+        _set_local_block(leaf, np.asarray(value) * _live_scale(engine)))
+
+
+def safe_set_local_optimizer_state(engine, name, state_key, value):
+    """Overwrite this host's shard of one optimizer-state tensor
+    (reference :320)."""
+    _resident(engine, "opt_state")
+    from ..checkpoint.constants import UNIVERSAL_TO_STATE_FIELD
+    field = UNIVERSAL_TO_STATE_FIELD.get(state_key, state_key)
+    sub = getattr(engine.opt_state, field, None)
+    if sub is None:
+        raise KeyError(state_key)
+    leaf = _lookup(sub, name)
+    if leaf is None:
+        raise KeyError(name)
+    new_sub = _set_leaf(sub, name, _set_local_block(leaf, value))
+    engine.opt_state = engine.opt_state._replace(**{field: new_sub})
 
 
 def safe_get_local_fp32_param(engine, name):
@@ -206,14 +308,14 @@ def safe_get_local_fp32_param(engine, name):
 
 
 def safe_get_local_grad(engine, name):
+    _resident(engine, "grad_acc")
     leaf = _lookup(engine.grad_acc, name)
     if leaf is None:
         return None
     blk = _local_block(leaf)
     if blk is None:
         return None
-    scale = float(engine.scale_state.scale) if engine.scale_state is not None else 1.0
-    return blk / scale
+    return blk / _live_scale(engine)
 
 
 def safe_get_local_optimizer_state(engine, name, state_key):
