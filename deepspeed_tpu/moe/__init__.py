@@ -6,4 +6,5 @@ from .sharded_moe import TopKGate, top1gating, top2gating, topkgating
 from .utils import (configure_moe_param_groups, has_moe_layers,
                     is_moe_param, is_moe_param_group, moe_param_mask,
                     split_params_grads_into_shared_and_expert_params,
+                    split_params_into_different_moe_groups_for_optimizer,
                     split_params_into_shared_and_expert_params)

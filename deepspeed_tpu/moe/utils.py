@@ -93,3 +93,32 @@ def configure_moe_param_groups(params, expert_lr=None,
 def is_moe_param_group(param_group) -> bool:
     """Reference :151."""
     return bool(param_group.get("moe", False))
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        param_groups, max_group_size=None):
+    """Reference :72 — the tutorial-facing name.  Accepts either a params
+    pytree or torch-style ``{"params": tree, ...}`` group dict(s) and
+    returns the shared + expert group list (``configure_moe_param_groups``
+    does the work; per-expert sub-grouping via ``max_group_size`` is a
+    CUDA-allreduce-bucketing concern with no SPMD analog and is
+    ignored)."""
+    if isinstance(param_groups, dict) and "params" in param_groups:
+        base = dict(param_groups)
+        tree = base.pop("params")
+        groups = configure_moe_param_groups(tree)
+        for g in groups:
+            for k, v in base.items():
+                g.setdefault(k, v)
+        return groups
+    if isinstance(param_groups, (list, tuple)) and param_groups and \
+            all(isinstance(pg, dict) and "params" in pg
+                for pg in param_groups):
+        # torch-style LIST of groups — a list-topped params pytree (e.g.
+        # per-layer list of dicts) must fall through to the pytree branch
+        out = []
+        for pg in param_groups:
+            out.extend(
+                split_params_into_different_moe_groups_for_optimizer(pg))
+        return out
+    return configure_moe_param_groups(param_groups)
