@@ -125,14 +125,21 @@ class InferenceEngine:
         }
         name = str(config.dtype).lower()
         dtype = jnp.dtype(_DTYPE_ALIASES.get(name, name))
-        if dtype == jnp.int8:
-            logger.warning("dtype=int8 serving is the weight-quantization "
-                           "path (inference/quantization); serving bf16")
+        int8_requested = dtype == jnp.int8
+        if int8_requested:
+            # int8 dtype = the weight-quantization path (compute in bf16)
             dtype = jnp.dtype("bfloat16")
         self.dtype = dtype
-        if config.quant.enabled:
-            logger.warning("quantized serving (config.quant) is not yet "
-                           "applied by the v1 engine — serving unquantized")
+        # weight-only quantized serving (reference inference/quantization):
+        # 2-D+ float weights are stored as int8/int4 wire format + scales
+        # (HBM at ~1 byte/weight); each jitted impl dequantizes at entry,
+        # so XLA materializes fp weights transiently per step while the
+        # resident copy stays quantized.
+        self._quant_bits = None
+        self._quant_meta = {}
+        if config.quant.enabled or int8_requested:
+            self._quant_bits = int(getattr(config.quant.weight, "num_bits",
+                                           8) or 8)
         if config.replace_with_kernel_inject or config.use_triton:
             log_dist("kernel injection/use_triton: XLA fusion + the "
                      "Pallas-backed attention core already cover this path",
@@ -151,6 +158,14 @@ class InferenceEngine:
             rules = (policy or _model_tp_rules(model)
                      or AutoTP.derive_rules(params))
             log_dist(f"AutoTP: {len(rules)} sharding rules", ranks=[0])
+        if self._quant_bits is not None and self._tp_enabled:
+            raise NotImplementedError(
+                "weight-only quantized serving does not compose with "
+                "tensor parallelism yet (quant grouping is laid out "
+                "pre-shard); drop tensor_parallel or quant")
+        if self._quant_bits is not None:
+            params = self._quantize_weights(params,
+                                            config.quant.weight.group_size)
         with self.mesh:
             if rules is not None:
                 self.params = shard_params_for_tp(params, self.mesh, rules)
@@ -173,9 +188,63 @@ class InferenceEngine:
                                                     "eos_token_id"))
         self._cache_struct = {}
 
+    # ---------------------------------------------------- weight-only quant
+    def _quantize_weights(self, params, group_size):
+        """Replace 2-D+ float leaves with ``{"__q__", "__s__"}`` wire-format
+        dicts (int8 storage + f32 per-group scales); meta (static
+        shape/dtype/groups) lives out-of-band keyed by path."""
+        from ..ops.pallas.quantizer import quantize_blockwise
+        from ..runtime.zero.partition import path_str
+        n_q = 0
+
+        if group_size and int(group_size) < 128:
+            logger.warning(
+                "quant group_size=%s below the TPU lane width; the "
+                "blockwise quantizer runs at group 128", group_size)
+
+        def maybe_q(kp, x):
+            nonlocal n_q
+            if (hasattr(x, "ndim") and x.ndim >= 2
+                    and jnp.issubdtype(x.dtype, jnp.floating)):
+                q, s, meta = quantize_blockwise(
+                    x, num_bits=self._quant_bits,
+                    group_size=max(128, int(group_size or 128)))
+                self._quant_meta[path_str(kp)] = meta
+                n_q += 1
+                return {"__q__": q, "__s__": s}
+            return x
+
+        out = jax.tree_util.tree_map_with_path(maybe_q, params)
+        log_dist(f"weight-only quant: {n_q} weight tensors stored as "
+                 f"int{self._quant_bits} wire format", ranks=[0])
+        return out
+
+    def _dequantize(self, params):
+        """Inverse of :meth:`_quantize_weights`, traced inside each jitted
+        impl — the resident params stay quantized, fp copies exist only
+        transiently inside the step."""
+        if self._quant_bits is None:
+            return params
+        from ..ops.pallas.quantizer import dequantize_blockwise
+        from ..runtime.zero.partition import path_str
+
+        def is_q(x):
+            return isinstance(x, dict) and "__q__" in x
+
+        def dq(kp, x):
+            if not is_q(x):
+                return x
+            # the wrapper dict adds no path segment beyond the leaf name
+            meta = self._quant_meta[path_str(kp)]
+            return dequantize_blockwise(x["__q__"], x["__s__"],
+                                        meta).astype(self.dtype)
+
+        return jax.tree_util.tree_map_with_path(dq, params, is_leaf=is_q)
+
     # ------------------------------------------------------------- forward
     def _forward_impl(self, params, input_ids):
-        return self.module.apply({"params": params}, input_ids)
+        return self.module.apply({"params": self._dequantize(params)},
+                                 input_ids)
 
     def forward(self, input_ids, **kwargs):
         """Full (non-cached) forward → logits.  Reference engine forward
@@ -206,6 +275,7 @@ class InferenceEngine:
                             self._cache_struct[key])
 
     def _prefill_impl(self, params, cache, input_ids):
+        params = self._dequantize(params)
         kw = {"positions": jnp.arange(input_ids.shape[1])[None, :]
               } if self._accepts_positions else {}
         logits, mut = self.module.apply({"params": params, "cache": cache},
@@ -216,6 +286,7 @@ class InferenceEngine:
     def _decode_impl(self, params, cache, first_logits, rng, pos0, *, steps,
                      do_sample, top_k, eos_token_id, temperature, top_p):
         """ONE compiled XLA program for the whole decode loop."""
+        params = self._dequantize(params)
 
         def sample(logits, key):
             if not do_sample:
@@ -318,6 +389,25 @@ class InferenceEngine:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()
         restored = _pytree_restore(os.path.join(load_dir, str(tag), "model"))
+        if self._quant_bits is not None:
+            # quantized engine: re-quantize the restored float weights (the
+            # resident tree holds wire-format dicts, not arrays)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._quant_meta.clear()
+
+            def cast(x):
+                x = jnp.asarray(x)
+                return (x.astype(self.dtype)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+            restored = jax.tree.map(cast, restored)
+            quantized = self._quantize_weights(
+                restored, self._config.quant.weight.group_size)
+            with self.mesh:
+                self.params = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(self.mesh, P())), quantized)
+            return self
         # preserve dtype AND the TP sharding applied in __init__
         self.params = jax.tree.map(
             lambda new, old: jax.device_put(
