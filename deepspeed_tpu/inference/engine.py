@@ -20,6 +20,9 @@ TPU flow:
 """
 
 import inspect
+import os
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -159,20 +162,38 @@ class InferenceEngine:
                      or AutoTP.derive_rules(params))
             log_dist(f"AutoTP: {len(rules)} sharding rules", ranks=[0])
         if self._quant_bits is not None and self._tp_enabled:
+            if int8_requested and not config.quant.enabled:
+                # dtype=int8 alias + TP previously served bf16 unquantized
+                # with a warning — keep that compat behavior; only an
+                # EXPLICIT quant config hard-errors
+                logger.warning(
+                    "dtype=int8 with tensor parallelism: weight-only "
+                    "quant does not compose with TP yet — serving "
+                    "unquantized bf16")
+                self._quant_bits = None
+            else:
+                raise NotImplementedError(
+                    "weight-only quantized serving does not compose with "
+                    "tensor parallelism yet (quant grouping is laid out "
+                    "pre-shard); drop tensor_parallel or quant")
+        ckpt = config.checkpoint or config.checkpoint_config.checkpoint_dir
+        if ckpt is not None and not isinstance(ckpt, (str, os.PathLike)):
             raise NotImplementedError(
-                "weight-only quantized serving does not compose with "
-                "tensor parallelism yet (quant grouping is laid out "
-                "pre-shard); drop tensor_parallel or quant")
-        if self._quant_bits is not None:
+                "checkpoint= takes a directory path here (training-engine "
+                "layout or a save_mp_checkpoint_path snapshot); the "
+                "reference's dict/JSON load-policy descriptors are not "
+                "supported")
+        if self._quant_bits is not None and not ckpt:
+            # when a checkpoint will overwrite the weights, skip quantizing
+            # the constructor params — load_checkpoint (re)quantizes what it
+            # restores
             params = self._quantize_weights(params,
                                             config.quant.weight.group_size)
         with self.mesh:
             if rules is not None:
                 self.params = shard_params_for_tp(params, self.mesh, rules)
             else:
-                self.params = jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, NamedSharding(self.mesh, P())), params)
+                self.params = self._replicate(params)
         self._tp_rules = rules
 
         self._accepts_positions = "positions" in inspect.signature(
@@ -187,6 +208,24 @@ class InferenceEngine:
                                                     "top_k", "top_p",
                                                     "eos_token_id"))
         self._cache_struct = {}
+
+        # reference init_inference checkpoint flow: `checkpoint=` loads
+        # weights at construction (training-engine layout OR an inference
+        # snapshot written by save_mp_checkpoint_path), and
+        # `save_mp_checkpoint_path=` snapshots the served tree (post-cast,
+        # post-quant) for fast reload of large models.
+        if ckpt:
+            self.load_checkpoint(str(ckpt))
+        save_path = (config.save_mp_checkpoint_path
+                     or config.checkpoint_config.save_mp_checkpoint_path)
+        if save_path:
+            self.save_serving_checkpoint(str(save_path))
+
+    def _replicate(self, tree):
+        """device_put every leaf replicated on the serving mesh."""
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x),
+                                     NamedSharding(self.mesh, P())), tree)
 
     # ---------------------------------------------------- weight-only quant
     def _quantize_weights(self, params, group_size):
@@ -379,12 +418,65 @@ class InferenceEngine:
         return jnp.concatenate([input_ids, new], axis=1)
 
     # --------------------------------------------------------- checkpoints
+    def save_serving_checkpoint(self, save_dir):
+        """Snapshot the SERVED params tree (post-cast/quant/shard) for fast
+        reload — the reference's ``save_mp_checkpoint_path`` role.  Layout:
+        ``{dir}/params/`` (orbax) + ``serving_meta.json`` (quant meta)."""
+        import json
+        import os
+        from ..runtime.checkpoint_engine import _pytree_save
+        os.makedirs(save_dir, exist_ok=True)
+        _pytree_save(os.path.join(save_dir, "params"), self.params)
+        meta = {"quant_bits": self._quant_bits,
+                "dtype": str(self.dtype),
+                "quant_meta": {k: [list(m[0]), str(np.dtype(m[1])), int(m[2])]
+                               for k, m in self._quant_meta.items()}}
+        with open(os.path.join(save_dir, "serving_meta.json"), "w") as f:
+            json.dump(meta, f)
+        log_dist(f"serving checkpoint saved to {save_dir}", ranks=[0])
+        return save_dir
+
+    def _load_serving_checkpoint(self, load_dir):
+        import json
+        import os
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..runtime.checkpoint_engine import _pytree_restore
+        with open(os.path.join(load_dir, "serving_meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("dtype") and meta["dtype"] != str(self.dtype):
+            raise ValueError(
+                f"serving checkpoint dtype={meta['dtype']} does not match "
+                f"this engine's serving dtype {self.dtype}; build the "
+                "engine with the matching dtype")
+        if (meta.get("quant_bits") or None) != self._quant_bits:
+            raise ValueError(
+                f"serving checkpoint quant_bits={meta.get('quant_bits')} "
+                f"does not match this engine ({self._quant_bits}); build "
+                "the engine with the matching quant config")
+        restored = _pytree_restore(os.path.join(load_dir, "params"))
+        with self.mesh:
+            if self._tp_rules is not None:
+                # TP engine: re-apply the sharding rules to the restored
+                # tree (the snapshot stores global arrays)
+                self.params = shard_params_for_tp(restored, self.mesh,
+                                                  self._tp_rules)
+            else:
+                self.params = self._replicate(restored)
+        self._quant_meta = {
+            k: (tuple(s), np.dtype(d), int(g))
+            for k, (s, d, g) in meta.get("quant_meta", {}).items()}
+        log_dist(f"serving checkpoint loaded from {load_dir}", ranks=[0])
+        return self
+
     def load_checkpoint(self, load_dir, tag=None):
-        """Load the ``model/`` tree from a training-engine checkpoint
-        (layout: ``runtime/checkpoint_engine.py``)."""
+        """Load weights: a serving snapshot (``save_serving_checkpoint``)
+        or the ``model/`` tree of a training-engine checkpoint (layout:
+        ``runtime/checkpoint_engine.py``)."""
         import os
         from ..runtime.checkpoint_engine import _pytree_restore
         load_dir = os.path.abspath(load_dir)
+        if os.path.exists(os.path.join(load_dir, "serving_meta.json")):
+            return self._load_serving_checkpoint(load_dir)
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()
@@ -404,9 +496,7 @@ class InferenceEngine:
             quantized = self._quantize_weights(
                 restored, self._config.quant.weight.group_size)
             with self.mesh:
-                self.params = jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, NamedSharding(self.mesh, P())), quantized)
+                self.params = self._replicate(quantized)
             return self
         # preserve dtype AND the TP sharding applied in __init__
         self.params = jax.tree.map(

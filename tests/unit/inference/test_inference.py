@@ -181,3 +181,38 @@ def test_weight_only_quant_checkpoint_load(tmp_path):
     assert np.mean(np.abs(lf - lq)) / (np.mean(np.abs(lf)) + 1e-9) < 0.05
     groups.reset_mesh()
     dist.destroy_process_group()
+
+
+def test_init_inference_checkpoint_and_mp_snapshot(tmp_path):
+    """r5 (reference init_inference checkpoint flow): `checkpoint=` loads
+    at construction; `save_mp_checkpoint_path=` snapshots the SERVED tree
+    (post-quant) and reloads bit-identically via `checkpoint=`."""
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
+
+    model, cfg = _make("gpt2")
+    params = _params(model, cfg)
+    snap = tmp_path / "snap"
+
+    groups.reset_mesh(); dist.destroy_process_group()
+    q = deepspeed_tpu.init_inference(
+        (model, params), dtype="float32",
+        quant={"enabled": True, "weight": {"num_bits": 8}},
+        save_mp_checkpoint_path=str(snap))
+    ids = np.asarray([[2, 7, 1, 8, 2, 8, 1, 8]], np.int32)
+    lq = np.asarray(q(ids))
+    assert (snap / "serving_meta.json").exists()
+
+    groups.reset_mesh(); dist.destroy_process_group()
+    q2 = deepspeed_tpu.init_inference(
+        (model, params), dtype="float32",
+        quant={"enabled": True, "weight": {"num_bits": 8}},
+        checkpoint=str(snap))
+    np.testing.assert_array_equal(np.asarray(q2(ids)), lq)
+
+    # quant-config mismatch rejects with config vocabulary
+    groups.reset_mesh(); dist.destroy_process_group()
+    with pytest.raises(ValueError, match="quant_bits"):
+        deepspeed_tpu.init_inference((model, params), dtype="float32",
+                                     checkpoint=str(snap))
+    groups.reset_mesh(); dist.destroy_process_group()
