@@ -83,11 +83,19 @@ def parameter_names(engine):
 
 
 # ------------------------------------------------------------------ getters
-def safe_get_full_fp32_param(engine, name):
-    """Full fp32 master weight (reference tensor_fragment.py:187)."""
+def _resident_master_or_params(engine):
+    """Restore the fp32 source of truth only: master when the engine keeps
+    one, else params (stage-0: params ARE the master).  Never restores an
+    offloaded params tree alongside a live master — that would re-fill the
+    HBM offload_states() freed for a tree the caller won't touch."""
     _resident(engine, "master")
     if engine.master is None:
         _resident(engine, "params")
+
+
+def safe_get_full_fp32_param(engine, name):
+    """Full fp32 master weight (reference tensor_fragment.py:187)."""
+    _resident_master_or_params(engine)
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:
@@ -256,7 +264,7 @@ def safe_set_local_fp32_param(engine, name, value):
     directly.  NOTE the master and compute copies may be sharded
     differently, so only the master's local geometry is meaningful here —
     use :func:`safe_set_full_fp32_param` to update both views at once."""
-    _resident(engine, "master", "params")
+    _resident_master_or_params(engine)
     if engine.master is not None:
         old = _lookup(engine.master, name)
         engine.master = _set_leaf(engine.master, name,
@@ -297,9 +305,7 @@ def safe_set_local_optimizer_state(engine, name, state_key, value):
 
 def safe_get_local_fp32_param(engine, name):
     """This host's shard of the fp32 master (reference ZeRO-3 local API :280)."""
-    _resident(engine, "master")
-    if engine.master is None:
-        _resident(engine, "params")
+    _resident_master_or_params(engine)
     src = engine.master if engine.master is not None else engine.params
     leaf = _lookup(src, name)
     if leaf is None:

@@ -220,3 +220,32 @@ def test_tensor_fragment_setters_roundtrip():
                                    np.zeros_like(ml))
     assert np.abs(safe_get_local_optimizer_state(
         engine, "layer_0/w", "exp_avg")).sum() == 0
+
+
+def test_local_fp32_set_preserves_params_offload():
+    """r5: on an engine with a live master, safe_set_local_fp32_param must
+    NOT restore the offloaded compute params (re-filling the HBM that
+    offload_states() freed) — the boundary apply refreshes them from
+    master anyway."""
+    from deepspeed_tpu.utils import (safe_get_local_fp32_param,
+                                     safe_set_local_fp32_param)
+
+    engine = _make_engine(stage=2)
+    data = batches(random_dataset(32, HIDDEN), 8)
+    x, y = data[0]
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert engine.master is not None
+    engine.offload_states()
+    assert "params" in engine._host_offloaded
+
+    w = safe_get_local_fp32_param(engine, "layer_0/b")
+    safe_set_local_fp32_param(engine, "layer_0/b", w + 2.0)
+    # master restored and updated; params STILL offloaded
+    assert "params" not in (engine._host_offloaded or {}) or \
+        engine.master is not None
+    assert "params" in engine._host_offloaded, \
+        "params were restored although only master was written"
+    np.testing.assert_allclose(
+        safe_get_local_fp32_param(engine, "layer_0/b"), w + 2.0, rtol=1e-6)

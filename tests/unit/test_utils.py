@@ -93,3 +93,33 @@ def test_partition_balanced_minimizes_max(weights, num_parts):
         m = max(sum(weights[a:b]) for a, b in zip(bounds, bounds[1:]))
         best = m if best is None else min(best, m)
     assert got == best, (parts, got, best)
+
+
+def test_instrument_w_nvtx_and_z3_shims():
+    """r5 (reference deepspeed.utils surface): the NVTX analog wraps
+    callables under a trace annotation, and the z3 leaf markers record
+    intent (designed away under whole-program GSPMD scheduling)."""
+    from deepspeed_tpu import utils as dsu
+
+    @dsu.instrument_w_nvtx
+    def f(a, b=1):
+        return a + b
+
+    assert f(2, b=3) == 5
+    assert f.__name__ == "f"
+
+    class M:
+        pass
+
+    m = M()
+    assert dsu.z3_leaf_module(m) is False
+    assert dsu.get_z3_leaf_modules(m) == []
+    dsu.set_z3_leaf_modules(m, [M])
+    assert dsu.z3_leaf_module(m) is True
+    assert dsu.get_z3_leaf_modules(m) == [M]
+    assert dsu.z3_leaf_parameter(np.zeros(3)) is False
+    dsu.unset_z3_leaf_modules(m, [M])
+    assert dsu.z3_leaf_module(m) is False
+    dsu.set_z3_leaf_module(m, True)
+    assert dsu.z3_leaf_module(m) is True
+    M._z3_leaf = False
