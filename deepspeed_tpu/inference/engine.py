@@ -229,33 +229,10 @@ class InferenceEngine:
 
     # ---------------------------------------------------- weight-only quant
     def _quantize_weights(self, params, group_size):
-        """Replace 2-D+ float leaves with ``{"__q__", "__s__"}`` wire-format
-        dicts (int8 storage + f32 per-group scales); meta (static
-        shape/dtype/groups) lives out-of-band keyed by path."""
-        from ..ops.pallas.quantizer import quantize_blockwise
-        from ..runtime.zero.partition import path_str
-        n_q = 0
-
-        if group_size and int(group_size) < 128:
-            logger.warning(
-                "quant group_size=%s below the TPU lane width; the "
-                "blockwise quantizer runs at group 128", group_size)
-
-        def maybe_q(kp, x):
-            nonlocal n_q
-            if (hasattr(x, "ndim") and x.ndim >= 2
-                    and jnp.issubdtype(x.dtype, jnp.floating)):
-                q, s, meta = quantize_blockwise(
-                    x, num_bits=self._quant_bits,
-                    group_size=max(128, int(group_size or 128)))
-                self._quant_meta[path_str(kp)] = meta
-                n_q += 1
-                return {"__q__": q, "__s__": s}
-            return x
-
-        out = jax.tree_util.tree_map_with_path(maybe_q, params)
-        log_dist(f"weight-only quant: {n_q} weight tensors stored as "
-                 f"int{self._quant_bits} wire format", ranks=[0])
+        """Shared wire-format quantization (``inference/quant_serving``)."""
+        from .quant_serving import quantize_tree
+        out, meta = quantize_tree(params, self._quant_bits, group_size)
+        self._quant_meta.update(meta)
         return out
 
     def _dequantize(self, params):
@@ -264,21 +241,8 @@ class InferenceEngine:
         transiently inside the step."""
         if self._quant_bits is None:
             return params
-        from ..ops.pallas.quantizer import dequantize_blockwise
-        from ..runtime.zero.partition import path_str
-
-        def is_q(x):
-            return isinstance(x, dict) and "__q__" in x
-
-        def dq(kp, x):
-            if not is_q(x):
-                return x
-            # the wrapper dict adds no path segment beyond the leaf name
-            meta = self._quant_meta[path_str(kp)]
-            return dequantize_blockwise(x["__q__"], x["__s__"],
-                                        meta).astype(self.dtype)
-
-        return jax.tree_util.tree_map_with_path(dq, params, is_leaf=is_q)
+        from .quant_serving import dequantize_tree
+        return dequantize_tree(params, self._quant_meta, self.dtype)
 
     # ------------------------------------------------------------- forward
     def _forward_impl(self, params, input_ids):

@@ -1,0 +1,80 @@
+"""Weight-only quantized serving helpers shared by the v1 and v2 engines
+(reference ``inference/quantization``): 2-D+ float weights live as
+blockwise int8/int4 wire format + scales; dequantization is traced inside
+the serving program so fp copies exist only transiently per step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
+from ..runtime.zero.partition import path_str
+from ..utils.logging import log_dist, logger
+
+#: quantization_mode spellings (reference config_v2.py) → bits
+MODES = {"int8": 8, "int4": 4, "q8": 8, "q4": 4}
+
+LANE_GROUP = 128   # the blockwise quantizer's minimum group (TPU lanes)
+
+
+def resolve_mode(mode):
+    """quantization_mode string → bits, or a clear error for modes whose
+    wire format we don't serve (e.g. the reference's CUDA-only
+    ``wf6af16`` FP6 path — fp6 tensors exist in ops/fp_quantizer but the
+    serving integration is int-only for now)."""
+    if mode is None:
+        return None
+    bits = MODES.get(str(mode).lower())
+    if bits is None:
+        raise NotImplementedError(
+            f"quantization_mode={mode!r} is not served here; supported: "
+            f"{sorted(MODES)} (fp6/fp8 wire formats exist in "
+            "ops/fp_quantizer but only int4/int8 serving is wired)")
+    return bits
+
+
+def is_quantized_leaf(x):
+    return isinstance(x, dict) and "__q__" in x
+
+
+def quantize_tree(params, bits, group_size=LANE_GROUP):
+    """Returns (tree with ``{"__q__", "__s__"}`` wire-format dicts for 2-D+
+    float leaves, meta dict keyed by path).  Static meta stays out-of-band
+    so the tree can cross jit boundaries."""
+    if group_size and int(group_size) < LANE_GROUP:
+        logger.warning(
+            "quant group_size=%s below the TPU lane width; the blockwise "
+            "quantizer runs at group %d", group_size, LANE_GROUP)
+    meta_out = {}
+    n_q = 0
+
+    def maybe_q(kp, x):
+        nonlocal n_q
+        if (hasattr(x, "ndim") and x.ndim >= 2
+                and jnp.issubdtype(x.dtype, jnp.floating)):
+            q, s, meta = quantize_blockwise(
+                x, num_bits=bits,
+                group_size=max(LANE_GROUP, int(group_size or LANE_GROUP)))
+            meta_out[path_str(kp)] = meta
+            n_q += 1
+            return {"__q__": q, "__s__": s}
+        return x
+
+    out = jax.tree_util.tree_map_with_path(maybe_q, params)
+    log_dist(f"weight-only quant: {n_q} weight tensors stored as "
+             f"int{bits} wire format", ranks=[0])
+    return out, meta_out
+
+
+def dequantize_tree(params, meta, dtype):
+    """Inverse of :func:`quantize_tree`; traceable (called inside jit)."""
+
+    def dq(kp, x):
+        if not is_quantized_leaf(x):
+            return x
+        m = meta[path_str(kp)]
+        return dequantize_blockwise(x["__q__"], x["__s__"],
+                                    m).astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(dq, params,
+                                            is_leaf=is_quantized_leaf)

@@ -58,6 +58,38 @@ class InferenceEngineV2:
         tp = int(getattr(config.tensor_parallel, "tp_size", 1) or 1)
         self._tp = tp
         self._tp_mesh = None
+        # weight-only quantized serving (reference quantization_mode):
+        # resident weights in int8/int4 wire format, dequantized INSIDE the
+        # jitted ragged step (and inside decode bursts — the wrapper is
+        # traced by the burst program)
+        from ..quant_serving import resolve_mode
+        self._quant_bits = resolve_mode(
+            getattr(config, "quantization_mode", None))
+        self._quant_meta = {}
+        if self._quant_bits is not None and tp > 1:
+            raise NotImplementedError(
+                "quantization_mode does not compose with tensor "
+                "parallelism yet (quant grouping is laid out pre-shard)")
+        if self._quant_bits is not None:
+            from ..quant_serving import quantize_tree
+            self.params, self._quant_meta = quantize_tree(
+                self.params, self._quant_bits)
+            base_step = self._step_fn
+            meta, dt = self._quant_meta, jnp.dtype(config.dtype)
+
+            def dq_step(params, *a, **kw):
+                from ..quant_serving import dequantize_tree
+                return base_step(dequantize_tree(params, meta, dt), *a,
+                                 **kw)
+
+            # jit the wrapper with the SAME statics AND the kv-cache
+            # donation as the registered step (the inner jit's donation is
+            # ignored once inlined — dropping it would double peak KV HBM);
+            # decode_burst traces the wrapper inside its own program
+            self._step_fn = jax.jit(
+                dq_step, static_argnames=("cfg", "block_size", "layout",
+                                          "use_kernel"),
+                donate_argnums=(1, ))
         if tp > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
             devs = jax.devices()

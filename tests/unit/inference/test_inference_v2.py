@@ -531,3 +531,47 @@ def test_v2_tp_gqa_replicated_kv_matches_single():
         outs[tp] = eng.generate(prompts, max_new_tokens=5)
         eng.flush(range(len(prompts)))
     assert outs[1] == outs[4]
+
+
+def test_v2_quantization_mode_serving():
+    """r5 (reference config_v2 quantization_mode): the ragged engine serves
+    with int8 resident weights — wire-format tree, close logits via the
+    dequant-in-step wrapper, decode bursts still engage."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+              max_ragged_sequence_count=8, max_context=128,
+              block_size=16, num_blocks=40)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 96, size=n).tolist() for n in (15, 6)]
+
+    ref = InferenceEngineV2(
+        model, params=params,
+        config=dict(dtype="float32", state_manager=dict(sm)))
+    out_ref = ref.generate(prompts, max_new_tokens=6)
+    ref.flush(range(len(prompts)))
+
+    q = InferenceEngineV2(
+        model, params=params,
+        config=dict(dtype="float32", state_manager=dict(sm),
+                    quantization_mode="int8"))
+    leaf = q.params["layers_0"]["self_attn"]["q_proj"]["kernel"]
+    assert isinstance(leaf, dict) and leaf["__q__"].dtype == jnp.int8
+    out_q = q.generate(prompts, max_new_tokens=6)
+    assert getattr(q, "burst_steps", 0) >= 1   # bursts run quantized too
+    # token-for-token equality is not guaranteed under int8 weights; the
+    # shapes and the machinery are what this pins (logit closeness is
+    # covered at the v1 level with the same shared quant module)
+    assert [len(o) for o in out_q] == [len(o) for o in out_ref]
+
+    with pytest.raises(NotImplementedError, match="quantization_mode"):
+        InferenceEngineV2(model, params=params,
+                          config=dict(dtype="float32",
+                                      quantization_mode="wf6af16"))
