@@ -177,23 +177,43 @@ def test_cross_world_size_resume(worker_losses):
     np.testing.assert_allclose(resumed, got[2:], rtol=1e-5, atol=1e-7)
 
 
-def test_p2p_obj_two_process():
-    """Out-of-band object p2p across 2 real processes (VERDICT r3 missing
-    #6): send_obj/recv_obj over the coordination-service KV store."""
+def _spawn_pair(worker_file, extra_args=(), timeout=900):
+    """Launch a 2-process worker pair (pid, nproc=2, port, *extra_args):
+    reap BOTH before asserting (a failed rank must not leave its peer
+    running), kill both on timeout.  Returns [stdout_rank0, stdout_rank1].
+    (worker_zero_parity keeps its own multi-leg protocol in
+    _launch_workers.)"""
     port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__), "worker_p2p.py")
+    worker = os.path.join(os.path.dirname(__file__), worker_file)
     repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                              "..", "..", ".."))
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen([sys.executable, worker, str(pid), "2",
-                               str(port)], env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True)
-             for pid in range(2)]
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"rank{pid} rc={p.returncode}\n{err[-2000:]}"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port),
+         *map(str, extra_args)], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in range(2)]
+    results = []
+    try:
+        for p in procs:
+            results.append(p.communicate(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    for pid, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, \
+            f"rank{pid} rc={p.returncode}\n--- stdout\n{out}" \
+            f"\n--- stderr\n{err[-3000:]}"
+    return [out for out, _ in results]
+
+
+def test_p2p_obj_two_process():
+    """Out-of-band object p2p across 2 real processes (VERDICT r3 missing
+    #6): send_obj/recv_obj over the coordination-service KV store."""
+    outs = _spawn_pair("worker_p2p.py", timeout=300)
+    for pid, out in enumerate(outs):
         assert f"P2P-OK rank{pid}" in out
 
 
@@ -207,22 +227,7 @@ def test_infinity_streaming_two_process():
     """ZeRO-Infinity streaming across 2 real processes: both hosts stream
     identical stores and run identical host sweeps; the trajectory must
     equal a single-process 8-device run of the same model+data."""
-    port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__), "worker_infinity.py")
-    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
-                                             "..", "..", ".."))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen([sys.executable, worker, str(pid), "2",
-                               str(port)], env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True)
-             for pid in range(2)]
-    outs = []
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"rank{pid} rc={p.returncode}\n{err[-3000:]}"
-        outs.append(out)
+    outs = _spawn_pair("worker_infinity.py", timeout=600)
     line = [l for l in outs[0].splitlines() if l.startswith("INF-LOSSES")][0]
     two_proc = [float(v) for v in line.split()[1:]]
 
@@ -268,24 +273,9 @@ def test_distributed_data_analyzer_two_process(transport, tmp_path):
     2 real processes, reduce via shared-fs files or the object-gather
     channel; artifacts must be byte-identical to a single-process run on
     the same seeded dataset."""
-    port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__),
-                          "worker_data_analyzer.py")
-    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
-                                             "..", "..", ".."))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     out_dir = tmp_path / f"dist_{transport}"
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port), str(out_dir),
-         transport], env=env, stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True) for pid in range(2)]
-    outs = []
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"rank{pid} rc={p.returncode}\n{err[-3000:]}"
-        outs.append(out)
+    outs = _spawn_pair("worker_data_analyzer.py",
+                       extra_args=(out_dir, transport), timeout=600)
     assert any("ANALYZER-TOTAL" in o for o in outs)
 
     # single-process oracle over the identical seeded dataset
@@ -317,3 +307,47 @@ def test_distributed_data_analyzer_two_process(transport, tmp_path):
         if (ref_dir / suffix).exists():
             assert (out_dir / suffix).read_bytes() == \
                 (ref_dir / suffix).read_bytes(), f"{suffix} differs"
+
+
+def test_uneven_heads_ulysses_two_process():
+    """r5: the padded-head q a2a + routed kv a2a (h=6, kv=2, sp=4) as REAL
+    multi-controller collectives — dp2×sp4 spanning 2 processes must
+    reproduce the single-process 8-device trajectory."""
+    outs = _spawn_pair("worker_ulysses.py", timeout=900)
+    line = [l for l in outs[0].splitlines() if l.startswith("ULY-LOSSES")][0]
+    two_proc = [float(v) for v in line.split()[1:]]
+
+    # single-process oracle: same mesh shape, same data stream
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils import groups
+    import deepspeed_tpu.comm as dist
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=6, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32", remat=False,
+        tie_word_embeddings=False, use_ulysses=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"dp": 2, "sp": 4}})
+    rng = np.random.default_rng(0)
+    sample = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    engine.initialize_parameters(0, sample, sample)
+    single = []
+    for _ in range(4):
+        x = rng.integers(0, 64, (4, 32)).astype(np.int32)
+        loss = engine(x, x)
+        engine.backward(loss)
+        engine.step()
+        single.append(float(loss))
+    # clean up BEFORE asserting: a parity failure must not leak the
+    # dp2×sp4 mesh into later tests
+    groups.reset_mesh()
+    dist.destroy_process_group()
+    np.testing.assert_allclose(two_proc, single, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,84 @@
+"""2-process worker: uneven-heads GQA Ulysses (h=6, kv=2) on a dp2×sp4
+mesh spanning two processes — the padded-head q a2a and the routed kv a2a
+run as REAL multi-controller collectives.  Rank 0 prints losses for the
+parent to compare against a single-process run of the same model + data.
+
+Usage: worker_ulysses.py <pid> <nproc> <port>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_COUNT"] = str(nproc)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.environ.get(
+        "DS_TPU_TEST_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cache))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.utils import groups
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=6, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32", remat=False,
+        tie_word_embeddings=False, use_ulysses=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=llama.LlamaModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"dp": 2, "sp": 4}})
+    assert jax.process_count() == nproc
+    assert engine.seq_parallel_world_size == 4
+
+    rng = np.random.default_rng(0)
+    sample = rng.integers(0, 64, (4, 32)).astype(np.int32)
+    engine.initialize_parameters(0, sample, sample)
+
+    dp_rank = groups._get_data_parallel_rank()
+    # dp=2 over 2 processes × (sp×...) — each process feeds its dp shard
+    rows_per_rank = 4 // 2
+    losses = []
+    for _ in range(4):
+        x = rng.integers(0, 64, (4, 32)).astype(np.int32)
+        sl = slice(dp_rank * rows_per_rank, (dp_rank + 1) * rows_per_rank)
+        loss = engine(x[sl], x[sl])
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    if pid == 0:
+        print("ULY-LOSSES " + " ".join(f"{v:.8f}" for v in losses),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
