@@ -2,9 +2,10 @@
 """FastGen-style continuous-batching serving (inference v2): put/query/flush
 scheduling over a paged KV cache, plus the one-call generate wrapper.
 
-  JAX_PLATFORMS=cpu python examples/serve_fastgen.py
+  JAX_PLATFORMS=cpu python examples/serve_fastgen.py [--quant int8]
 """
 
+import argparse
 import os
 import sys
 
@@ -25,6 +26,12 @@ from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quant", default=None, choices=("int8", "int4"),
+                    help="weight-only quantized serving (wire-format "
+                    "resident weights, ~1 byte/weight)")
+    args = ap.parse_args()
+
     cfg = llama.llama_tiny(dtype="float32", remat=False)
     model = llama.LlamaModel(cfg)
     params = model.init(jax.random.PRNGKey(0),
@@ -32,6 +39,7 @@ def main():
     eng = InferenceEngineV2(
         model, params=params,
         config=dict(dtype=cfg.dtype,
+                    quantization_mode=args.quant,
                     state_manager=dict(max_tracked_sequences=8,
                                        max_ragged_batch_size=64,
                                        max_ragged_sequence_count=8,
