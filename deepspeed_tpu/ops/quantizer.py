@@ -1,0 +1,6 @@
+"""Back-compat import path (reference ``deepspeed/ops/quantizer``) — the
+blockwise int8/int4 quantizer implementation lives in
+``ops/pallas/quantizer`` (Pallas kernel + XLA fallback)."""
+
+from .pallas.quantizer import (dequantize_blockwise,  # noqa: F401
+                               quantize_blockwise)

@@ -1,0 +1,7 @@
+"""Back-compat import path (reference ``deepspeed/ops/random_ltd``) — the
+random layerwise token dropping ops live in
+``runtime/data_pipeline/data_routing`` (jnp take/argsort formulation; the
+reference's CUDA gather/scatter kernels are XLA ops here)."""
+
+from ..runtime.data_pipeline.data_routing import (  # noqa: F401
+    random_ltd_gather, random_ltd_scatter, random_ltd_select)
