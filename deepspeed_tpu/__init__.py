@@ -7,7 +7,7 @@ Public API mirrors the reference's ``deepspeed/__init__.py`` surface
 at ``:291``, ``add_config_arguments`` at ``:268``).
 """
 
-__version__ = "0.4.0"   # keep in sync with version.txt (setup.py reads it)
+__version__ = "0.5.0"   # keep in sync with version.txt (setup.py reads it)
 # __git_branch__/git_hash/git_branch resolve lazily from the checkout (see
 # __getattr__); "unknown" outside a git checkout
 __git_branch__ = "unknown"
