@@ -6,3 +6,4 @@ from .constants import (ELASTICITY, ENABLED, ENABLED_DEFAULT,
                         MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT, MICRO_BATCHES,
                         MICRO_BATCHES_DEFAULT)
 from .elastic_agent import DSElasticAgent
+from .watchdog import HeartbeatMonitor, HeartbeatWriter

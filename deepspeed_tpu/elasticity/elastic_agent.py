@@ -18,9 +18,22 @@ from .elasticity import (ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
 
 
+#: synthetic "return code" recorded when the watchdog killed a hung worker
+STALLED = "stalled"
+
+
 class DSElasticAgent:
     def __init__(self, cmd, env, ds_config, min_nodes=1, max_nodes=None,
-                 max_restarts=100, monitor_interval=1.0):
+                 max_restarts=100, monitor_interval=1.0,
+                 heartbeat_dir=None, stall_timeout=0.0,
+                 restart_backoff=1.0, max_restart_backoff=60.0):
+        """``stall_timeout`` > 0 arms the heartbeat watchdog: workers beat
+        into ``heartbeat_dir`` (exported as ``DS_TPU_HEARTBEAT_DIR``) once
+        per step, and a worker silent for longer than the timeout is killed
+        and funneled into the same rescale-and-relaunch path a dead worker
+        takes — a hung collective no longer wedges the pod forever.
+        Restarts back off exponentially (``restart_backoff · 2^k``, capped)
+        so a crash-looping cluster doesn't hot-spin."""
         self.cmd = list(cmd)
         self.env = dict(env)
         self.ds_config = ds_config
@@ -29,6 +42,28 @@ class DSElasticAgent:
         self.max_restarts = max_restarts
         self.monitor_interval = monitor_interval
         self.restart_count = 0
+        self.stall_timeout = float(stall_timeout or 0.0)
+        self.restart_backoff = float(restart_backoff)
+        self.max_restart_backoff = float(max_restart_backoff)
+        self.heartbeat_dir = heartbeat_dir
+        if not self.stall_timeout and isinstance(ds_config, dict):
+            # a parsed DS config can carry the watchdog block — honor it so
+            # the JSON knob works wherever the agent sees the config (under
+            # bare launch.py there is no parsed config; use --stall_timeout)
+            wd = (ds_config.get("resilience") or {}).get("watchdog") or {}
+            if wd.get("enabled"):
+                self.stall_timeout = float(wd.get("stall_timeout", 300.0))
+                self.heartbeat_dir = (self.heartbeat_dir
+                                      or wd.get("heartbeat_dir") or None)
+        self._watchdog = None
+        if self.stall_timeout > 0:
+            from .watchdog import HeartbeatMonitor
+            if self.heartbeat_dir is None:
+                import tempfile
+                self.heartbeat_dir = os.path.join(
+                    tempfile.gettempdir(), f"ds_tpu_heartbeat_{os.getpid()}")
+            self._watchdog = HeartbeatMonitor(self.heartbeat_dir,
+                                              self.stall_timeout)
 
     def _validate_world(self, world_size):
         if self.ds_config is None:
@@ -62,7 +97,30 @@ class DSElasticAgent:
             env["DS_ELASTIC_TRAIN_BATCH_SIZE"] = str(final)
             env["DS_ELASTIC_MICRO_BATCH_SIZE"] = str(micro)
             env["DS_ELASTIC_WORLD_SIZE"] = str(world_size)
+        if self._watchdog is not None:
+            from .watchdog import HEARTBEAT_DIR_ENV
+            env[HEARTBEAT_DIR_ENV] = self.heartbeat_dir
         return env
+
+    def _backoff_delay(self, restart_count):
+        """Exponential restart backoff, capped: restart k waits
+        ``restart_backoff · 2^(k-1)`` seconds (0 disables)."""
+        if self.restart_backoff <= 0 or restart_count <= 0:
+            return 0.0
+        return min(self.restart_backoff * (2.0 ** (restart_count - 1)),
+                   self.max_restart_backoff)
+
+    def _kill_stalled(self, proc):
+        """Terminate a hung worker (escalating to SIGKILL) so the hang
+        becomes a restartable failure."""
+        logger.error("elastic agent: worker pid %s STALLED (%s); killing",
+                     proc.pid, self._watchdog.stall_report())
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
 
     def run(self, world_size, rescale=None, coordinator=None):
         """Supervise one local worker; restart on failure up to
@@ -81,15 +139,28 @@ class DSElasticAgent:
                 raise ElasticityIncompatibleWorldSize(
                     f"cannot run with world size {world_size}")
             env = self._elastic_env(world_size, coordinator)
+            if self._watchdog is not None:
+                self._watchdog.reset()  # stale beats must not vouch for
+                                        # the new incarnation
             proc = subprocess.Popen(self.cmd, env=env)
+            stalled = False
             while proc.poll() is None:
                 time.sleep(self.monitor_interval)
-            if proc.returncode == 0:
+                if self._watchdog is not None and self._watchdog.stalled():
+                    self._kill_stalled(proc)
+                    stalled = True
+                    break
+            rc = STALLED if stalled else proc.returncode
+            if rc == 0:
                 return 0
             self.restart_count += 1
             if self.restart_count > self.max_restarts:
-                logger.error("elastic agent: max restarts exceeded")
-                return proc.returncode
+                logger.error("elastic agent: max restarts exceeded "
+                             "(last failure: %s)", rc)
+                # a stall-killed worker may exit 0 from its own SIGTERM
+                # handler — a job that died of a stall loop must never
+                # report success
+                return proc.returncode if proc.returncode else 1
             if rescale is not None:
                 new_world, new_coord = rescale(world_size,
                                                self.restart_count)
@@ -99,7 +170,11 @@ class DSElasticAgent:
                         world_size, new_world)
                 world_size = new_world
                 coordinator = new_coord or coordinator
+            delay = self._backoff_delay(self.restart_count)
             logger.warning(
-                "elastic agent: worker died rc=%s; restart %d/%d "
-                "(world=%d)", proc.returncode, self.restart_count,
-                self.max_restarts, world_size)
+                "elastic agent: worker %s rc=%s; restart %d/%d "
+                "(world=%d, backoff %.1fs)",
+                "stalled" if stalled else "died", rc, self.restart_count,
+                self.max_restarts, world_size, delay)
+            if delay > 0:
+                time.sleep(delay)

@@ -1,0 +1,136 @@
+"""Heartbeat watchdog — hang detection for the elastic restart supervisor.
+
+``DSElasticAgent`` (reference ``elasticity/elastic_agent.py:32``) only
+notices a worker that *died*; at pod scale the dominant availability
+failure is a worker that *hangs* — a wedged collective, a stuck host, an
+NFS stall — which blocks the whole data-parallel group forever while every
+process stays alive.  The watchdog closes that gap:
+
+* each worker writes a tiny heartbeat file once per optimizer step (the
+  engine does this when ``resilience.watchdog`` is enabled or the agent
+  exports ``DS_TPU_HEARTBEAT_DIR``);
+* the agent's monitor loop checks heartbeat ages; a worker whose newest
+  beat is older than ``stall_timeout`` is killed, which funnels the hang
+  into the existing rescale-and-relaunch + checkpoint-resume path.
+
+Writes are atomic (tmp + rename), one file per rank, JSON payload
+``{"ts": ..., "step": ..., "pid": ...}`` — cheap enough for every step and
+inspectable by humans mid-incident.
+"""
+
+import json
+import os
+import time
+
+from ..utils.fault_injection import fault_point
+from ..utils.logging import logger
+
+#: env var the agent exports so workers know where to beat
+HEARTBEAT_DIR_ENV = "DS_TPU_HEARTBEAT_DIR"
+
+
+def _rank_file(directory, rank):
+    return os.path.join(directory, f"heartbeat_rank{rank}.json")
+
+
+class HeartbeatWriter:
+    """Worker side: ``beat(step)`` once per optimizer step."""
+
+    def __init__(self, directory, rank=0):
+        self.directory = os.path.abspath(directory)
+        self.rank = int(rank)
+        os.makedirs(self.directory, exist_ok=True)
+        self._path = _rank_file(self.directory, self.rank)
+
+    def beat(self, step):
+        if fault_point("heartbeat.beat", rank=self.rank, step=step):
+            return False  # injected stall: the worker "hangs"
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"ts": time.time(), "step": int(step),
+                           "pid": os.getpid()}, f)
+            os.replace(tmp, self._path)
+            return True
+        except OSError as e:
+            # a failing heartbeat must not kill a healthy training step;
+            # the watchdog treats prolonged silence as the signal
+            logger.warning("heartbeat write failed (%s); worker will look "
+                           "stalled if this persists", e)
+            return False
+
+
+class HeartbeatMonitor:
+    """Agent side: judge worker liveness from heartbeat file ages.
+
+    A worker with no heartbeat file yet is measured from ``reset()`` (the
+    last (re)launch) — startup compilation counts against the same
+    ``stall_timeout``, so set it well above the expected first-step time.
+
+    The directory belongs to ONE agent: ``reset()`` clears every heartbeat
+    file in it at each (re)launch, and all ranks found in it are judged
+    together.  Point each node's agent at a node-local path (the launcher's
+    default per-agent tempdir does this) — a directory shared between
+    agents would let one agent's relaunch wipe another's live beats.
+    """
+
+    def __init__(self, directory, stall_timeout):
+        self.directory = os.path.abspath(directory)
+        self.stall_timeout = float(stall_timeout)
+        self._epoch = time.time()
+        os.makedirs(self.directory, exist_ok=True)
+
+    def reset(self):
+        """Call at every (re)launch: clears stale beats from the previous
+        incarnation so they don't vouch for the new one."""
+        self._epoch = time.time()
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith("heartbeat_rank"):
+                    os.remove(os.path.join(self.directory, name))
+        except OSError:
+            pass
+
+    def last_beats(self):
+        """{rank: payload} for every heartbeat file present."""
+        out = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("heartbeat_rank")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    payload = json.load(f)
+                rank = int(name[len("heartbeat_rank"):-len(".json")])
+            except (OSError, ValueError):
+                continue  # mid-replace race or junk file: skip this scan
+            out[rank] = payload
+        return out
+
+    def stalled(self, now=None):
+        """True when ANY rank's last heartbeat (or, with none yet, the
+        launch epoch) is older than ``stall_timeout`` — one hung rank wedges
+        the whole collective, so the OLDEST beat is the one that matters
+        (a still-beating neighbor must not mask it)."""
+        now = time.time() if now is None else now
+        beats = self.last_beats()
+        if not beats:
+            return now - self._epoch > self.stall_timeout
+        oldest = min(max(p.get("ts", 0.0), self._epoch)
+                     for p in beats.values())
+        return now - oldest > self.stall_timeout
+
+    def stall_report(self, now=None):
+        now = time.time() if now is None else now
+        beats = self.last_beats()
+        if not beats:
+            return (f"no heartbeat within {self.stall_timeout:.1f}s of "
+                    f"launch (dir={self.directory})")
+        lines = [f"rank {r}: step {p.get('step')} "
+                 f"{now - p.get('ts', 0.0):.1f}s ago"
+                 for r, p in sorted(beats.items())]
+        return "; ".join(lines)

@@ -11,6 +11,11 @@ from pydantic import Field
 
 from ..runtime.config_utils import DeepSpeedConfigModel
 
+#: the blockwise quantizer's minimum group — one TPU lane row.  Canonical
+#: home is here (dependency-light) so config defaults and the quantizer
+#: (``quant_serving``) agree by construction.
+LANE_GROUP = 128
+
 
 class DeepSpeedTPConfig(DeepSpeedConfigModel):
     """Reference ``inference/config.py`` TP block."""
@@ -31,7 +36,9 @@ class DeepSpeedMoEConfig(DeepSpeedConfigModel):
 class QuantTypeConfig(DeepSpeedConfigModel):
     enabled: bool = False
     num_bits: int = 8
-    group_size: int = 64
+    # default derives from the TPU lane width: anything smaller just trips
+    # the quantizer's clamp-and-warn path on every quantized-serving run
+    group_size: int = LANE_GROUP
     group_dim: int = 0
     symmetric: bool = True
 

@@ -14,7 +14,9 @@ from ..utils.logging import log_dist, logger
 #: quantization_mode spellings (reference config_v2.py) → bits
 MODES = {"int8": 8, "int4": 4, "q8": 8, "q4": 4}
 
-LANE_GROUP = 128   # the blockwise quantizer's minimum group (TPU lanes)
+# one TPU lane row — re-exported so existing imports keep working; the
+# canonical definition lives with the config defaults derived from it
+from .config import LANE_GROUP  # noqa: E402
 
 
 def resolve_mode(mode):

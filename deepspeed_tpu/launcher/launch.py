@@ -54,6 +54,18 @@ def parse_args(args=None):
     parser.add_argument("--enable_elastic_training", action="store_true")
     parser.add_argument("--min_elastic_nodes", type=int, default=-1)
     parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--stall_timeout", type=float, default=0.0,
+                        help="Elastic watchdog: kill+relaunch a worker "
+                        "whose newest heartbeat is older than this many "
+                        "seconds (0 disables hang detection; set well "
+                        "above first-step compile time).")
+    parser.add_argument("--heartbeat_dir", type=str, default=None,
+                        help="Directory for worker heartbeat files "
+                        "(exported to workers as DS_TPU_HEARTBEAT_DIR; "
+                        "default: a per-agent tempdir).")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="Base seconds of exponential backoff between "
+                        "elastic restarts (doubles per restart, capped).")
     parser.add_argument("--save_pid", action="store_true")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -131,7 +143,10 @@ def main(args=None):
         env = build_child_env(args, world_info, node_rank, 0, 1)
         agent = DSElasticAgent(child_cmd(), env, ds_config=None,
                                min_nodes=args.min_elastic_nodes,
-                               max_nodes=args.max_elastic_nodes)
+                               max_nodes=args.max_elastic_nodes,
+                               heartbeat_dir=args.heartbeat_dir,
+                               stall_timeout=args.stall_timeout,
+                               restart_backoff=args.restart_backoff)
         sys.exit(agent.run(world_size=len(hosts)))
 
     processes = []

@@ -118,6 +118,11 @@ class csv_monitor(Monitor):
                 w.writerow([step, float(value)])
 
 
+#: event-name prefix for the resilience subsystem's telemetry (skipped
+#: poisoned steps, checkpoint rollbacks, watchdog restarts)
+RESILIENCE_EVENT_PREFIX = "Train/Resilience/"
+
+
 class MonitorMaster(Monitor):
     """Reference ``monitor/monitor.py:30``: dispatch to enabled backends."""
 
@@ -140,3 +145,11 @@ class MonitorMaster(Monitor):
             self.comet_monitor.write_events(event_list)
         if self.csv_monitor.enabled:
             self.csv_monitor.write_events(event_list)
+
+    def write_resilience_events(self, pairs, step):
+        """Resilience telemetry — ``pairs``: [(short_name, value), ...]
+        written under ``Train/Resilience/`` so availability incidents
+        (skipped poisoned steps, checkpoint rollbacks, watchdog kills) land
+        on the same dashboards as the loss curve."""
+        self.write_events([(RESILIENCE_EVENT_PREFIX + name, value, step)
+                           for name, value in pairs])

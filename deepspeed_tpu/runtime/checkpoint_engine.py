@@ -17,11 +17,226 @@ semantics (reference ``deepspeed/checkpoint/``) by construction.
 
 import json
 import os
+import time
+import zlib
 
 import jax
 import numpy as np
 
+from ..utils.fault_injection import fault_point
 from ..utils.logging import log_dist, logger
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+
+# --------------------------------------------------------------- integrity
+def _retry(fn, attempts, backoff, what):
+    """Retry ``fn`` on transient failures with exponential backoff
+    (reference Nebula engine retries commit the same way).  ``attempts`` is
+    the number of RE-tries; 0 = fail on the first error."""
+    for i in range(attempts + 1):
+        try:
+            return fn()
+        except (OSError, IOError) as e:
+            if i >= attempts:
+                raise
+            delay = backoff * (2 ** i)
+            logger.warning("checkpoint %s failed (%s: %s); retry %d/%d "
+                           "in %.2fs", what, type(e).__name__, e, i + 1,
+                           attempts, delay)
+            if delay > 0:
+                time.sleep(delay)
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(b, crc)
+
+
+def _walk_tag_files(root):
+    """Relative paths of every file in a tag dir, manifest excluded."""
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            if rel != MANIFEST_NAME and not rel.endswith(".tmp"):
+                out.append(rel)
+    return sorted(out)
+
+
+def write_manifest(root, config_hash=None, tag=None):
+    """Commit the tag's integrity manifest — file list + sizes + content
+    checksums + config hash — written to a temp file and atomically
+    renamed, AFTER every tree write finished: its presence certifies the
+    tag is complete, its checksums certify the bytes."""
+    files = {}
+    for rel in _walk_tag_files(root):
+        path = os.path.join(root, rel)
+        files[rel] = {"size": os.path.getsize(path),
+                      "crc32": _file_crc32(path)}
+    manifest = {"version": MANIFEST_VERSION, "tag": str(tag),
+                "config_hash": config_hash, "files": files}
+    # pid-unique tmp: every process may commit (node-local-storage layouts
+    # need a latest/manifest per host) and shared-fs ranks must not
+    # interleave writes into one tmp file
+    tmp = os.path.join(root, f"{MANIFEST_NAME}.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(root, MANIFEST_NAME))
+    return manifest
+
+
+def verify_checkpoint_tag(root):
+    """Verify a tag dir against its manifest.
+
+    Returns ``(status, detail)`` with status one of ``"valid"`` (manifest
+    present, every file matches size+checksum), ``"legacy"`` (no manifest —
+    a pre-integrity checkpoint OR a partial write that died before commit;
+    indistinguishable, so callers prefer any verified tag over it), or
+    ``"corrupt"`` (manifest present but unreadable / files missing or
+    mismatched)."""
+    if not os.path.isdir(root):
+        return "corrupt", "tag directory missing"
+    mpath = os.path.join(root, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return "legacy", "no manifest (pre-integrity save or partial write)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (ValueError, KeyError, OSError) as e:
+        return "corrupt", f"unreadable manifest: {e}"
+    for rel, meta in files.items():
+        path = os.path.join(root, rel)
+        try:
+            if not os.path.exists(path):
+                return "corrupt", f"missing file {rel}"
+            size = os.path.getsize(path)
+            if size != meta["size"]:
+                return "corrupt", (f"size mismatch {rel}: "
+                                   f"{size} != {meta['size']}")
+            if _file_crc32(path) != meta["crc32"]:
+                return "corrupt", f"checksum mismatch {rel}"
+        except OSError as e:
+            # a file vanishing mid-check (concurrent retention on another
+            # rank, fs hiccup) is a failed verification, not a crash
+            return "corrupt", f"unreadable file {rel}: {e}"
+    return "valid", "ok"
+
+
+def _tag_sort_key(load_dir, tag):
+    """Newest-first ordering: the step counter recorded in the tag's own
+    engine_state.json (mtime breaks ties / stands in when unreadable)."""
+    root = os.path.join(load_dir, tag)
+    step = -1
+    try:
+        with open(os.path.join(root, "engine_state.json")) as f:
+            step = int(json.load(f).get("global_steps", -1))
+    except (OSError, ValueError, TypeError):
+        pass
+    try:
+        mtime = os.path.getmtime(root)
+    except OSError:
+        mtime = 0.0
+    return (step, mtime)
+
+
+def list_checkpoint_tags(load_dir):
+    """Tag subdirs (anything holding an engine_state.json or a manifest),
+    newest first."""
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    tags = [n for n in names
+            if os.path.isdir(os.path.join(load_dir, n)) and
+            (os.path.exists(os.path.join(load_dir, n, "engine_state.json"))
+             or os.path.exists(os.path.join(load_dir, n, MANIFEST_NAME)))]
+    return sorted(tags, key=lambda t: _tag_sort_key(load_dir, t),
+                  reverse=True)
+
+
+def find_latest_valid_tag(load_dir, exclude=(), not_newer_than=None):
+    """Newest tag that passes manifest verification; falls back to the
+    newest legacy (manifest-less) tag only when NO verified tag exists.
+    ``not_newer_than``: a tag name — candidates newer than it (step counter,
+    mtime tiebreak) are skipped, so a fallback can only roll BACK."""
+    ceiling = (_tag_sort_key(load_dir, not_newer_than)
+               if not_newer_than is not None else None)
+
+    def newer_than_ceiling(key):
+        if ceiling is None:
+            return False
+        if ceiling[0] < 0:
+            # the reference tag's step counter is unreadable (that is often
+            # WHY we are falling back) — compare by mtime alone, or every
+            # older valid tag would count as "newer" than step -1
+            return key[1] > ceiling[1]
+        return key > ceiling
+
+    legacy = None
+    for tag in list_checkpoint_tags(load_dir):
+        if tag in exclude:
+            continue
+        if newer_than_ceiling(_tag_sort_key(load_dir, tag)):
+            continue
+        status, _ = verify_checkpoint_tag(os.path.join(load_dir, tag))
+        if status == "valid":
+            return tag, "valid"
+        if status == "legacy" and legacy is None:
+            legacy = tag
+    return (legacy, "legacy") if legacy is not None else (None, None)
+
+
+def _tag_committed(root):
+    """Cheap committed-ness check for retention: a readable manifest.
+    Retention must not re-CRC every byte of every retained tag on each
+    save — full verification is the LOADER's job; GC only needs to know
+    the tag finished its commit."""
+    try:
+        with open(os.path.join(root, MANIFEST_NAME)) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def prune_checkpoint_tags(save_dir, keep_n, protect=None):
+    """Bounded retention: delete *committed* tags (manifest present)
+    beyond the newest ``keep_n``.  Uncommitted/corrupt tags are never
+    deleted (the loader skips them anyway, and deleting data because its
+    verification failed would be exactly backwards); the newest committed
+    tag — plus ``protect``, the tag just written — always survives."""
+    if not keep_n or keep_n < 1:
+        return []
+    try:
+        committed = [t for t in list_checkpoint_tags(save_dir)
+                     if _tag_committed(os.path.join(save_dir, t))]
+        doomed = [t for t in committed[keep_n:] if t != protect]
+    except OSError as e:   # retention must never fail a committed save
+        logger.warning("checkpoint retention: scan failed (%s); skipped", e)
+        return []
+    import shutil
+    removed = []
+    for tag in doomed:
+        try:
+            shutil.rmtree(os.path.join(save_dir, tag))
+            removed.append(tag)
+        except OSError as e:
+            logger.warning("checkpoint retention: could not remove %s (%s)",
+                           tag, e)
+    if removed:
+        log_dist(f"checkpoint retention: pruned {removed} "
+                 f"(keep_n={keep_n})", ranks=[0])
+    return removed
 
 
 def _strip_lr_override(opt_state):
@@ -38,6 +253,15 @@ def _reattach_lr_override(restored, current):
             getattr(current, "lr_override", None) is not None:
         return restored._replace(lr_override=current.lr_override)
     return restored
+
+
+def _write_latest(latest_path, tag):
+    tmp = f"{latest_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(str(tag))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, latest_path)
 
 
 def _pytree_save(path, tree):
@@ -57,13 +281,24 @@ def _pytree_save_async(path, tree):
 
 
 class _AsyncSaveHandle:
-    """Pending async checkpoint: ``wait()`` commits the `latest` tag only
-    after every tree is durably written (Nebula's commit semantics)."""
+    """Pending async checkpoint: ``wait()`` commits manifest + `latest` tag
+    only after every tree is durably written (Nebula's commit semantics) and
+    re-raises any background-write exception — a failed async save must
+    never be silently treated as durable."""
 
-    def __init__(self, checkpointers, latest_path=None, tag=None):
+    def __init__(self, checkpointers, latest_path=None, tag=None,
+                 root=None, config_hash=None, integrity=False,
+                 keep_n=0, save_dir=None, retries=0, backoff=0.0):
         self._ckptrs = checkpointers
         self._latest_path = latest_path
         self._tag = tag
+        self._root = root
+        self._config_hash = config_hash
+        self._integrity = integrity
+        self._keep_n = keep_n
+        self._save_dir = save_dir
+        self._retries = retries
+        self._backoff = backoff
         self._done = False
 
     def wait(self):
@@ -82,11 +317,29 @@ class _AsyncSaveHandle:
                     except Exception:
                         pass
             if errors:
-                # `latest` is NOT written: the checkpoint is not durable
+                # neither manifest nor `latest` is written: the checkpoint
+                # is not durable and the previous valid tag stays current.
+                # Background-write failures cannot be retried here — the
+                # staged device buffers may have been donated away by
+                # subsequent train steps — so save_retries covers staging
+                # and the commit files only on the async path (the sync
+                # path retries the tree writes themselves).
+                logger.error(
+                    "async checkpoint %s FAILED in background write (%s); "
+                    "tag not committed — the previous valid tag remains "
+                    "the resume target", self._tag, errors[0])
                 raise errors[0]
+            if self._integrity and self._root is not None:
+                _retry(lambda: write_manifest(self._root, self._config_hash,
+                                              self._tag),
+                       self._retries, self._backoff, "manifest commit")
             if self._latest_path is not None:
-                with open(self._latest_path, "w") as f:
-                    f.write(str(self._tag))
+                _retry(lambda: _write_latest(self._latest_path, self._tag),
+                       self._retries, self._backoff, "latest commit")
+            fault_point("ckpt.committed", tag=self._tag, root=self._root)
+            if self._integrity and self._save_dir is not None:
+                prune_checkpoint_tags(self._save_dir, self._keep_n,
+                                      protect=str(self._tag))
         finally:
             self._done = True  # a failed commit must not wedge retries
 
@@ -169,18 +422,51 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     latest_path = (os.path.join(os.path.abspath(save_dir), "latest")
                    if save_latest else None)
 
+    ic = engine._config.resilience_config.checkpoint_integrity
+    config_hash = engine._config.config_hash()
+    # one manifest/prune per checkpoint, not per rank: on a shared fs the
+    # CRC walk re-reads every byte of the tag, so world_size× of it is pure
+    # redundant I/O (node-local layouts need every host to commit its own)
+    commits_integrity = (ic.enabled and
+                         (jax.process_index() == 0
+                          or engine._config.use_node_local_storage))
+
+    def saved_tree(sub, tree, async_):
+        def once():
+            fault_point("ckpt.save_tree", tag=tag, sub=sub)
+            if async_:
+                return _pytree_save_async(os.path.join(root, sub), tree)
+            return _pytree_save(os.path.join(root, sub), tree)
+        return _retry(once, ic.save_retries, ic.retry_backoff,
+                      f"write of {tag}/{sub}")
+
     handle = None
     if async_save:
+        ckptrs = []
+        for sub, tree in trees:
+            ckptrs.append(saved_tree(sub, tree, async_=True))
+            fault_point("ckpt.mid_write", tag=tag, root=root, sub=sub)
         handle = _AsyncSaveHandle(
-            [_pytree_save_async(os.path.join(root, sub), tree)
-             for sub, tree in trees],
-            latest_path=latest_path, tag=tag)
+            ckptrs, latest_path=latest_path, tag=tag, root=root,
+            config_hash=config_hash, integrity=commits_integrity,
+            keep_n=ic.keep_n, save_dir=os.path.abspath(save_dir),
+            retries=ic.save_retries, backoff=ic.retry_backoff)
     else:
         for sub, tree in trees:
-            _pytree_save(os.path.join(root, sub), tree)
+            saved_tree(sub, tree, async_=False)
+            fault_point("ckpt.mid_write", tag=tag, root=root, sub=sub)
+        # commit order matters: manifest BEFORE `latest` — `latest` must
+        # never name a tag whose completeness certificate does not exist
+        if commits_integrity:
+            _retry(lambda: write_manifest(root, config_hash, tag),
+                   ic.save_retries, ic.retry_backoff, "manifest commit")
         if latest_path is not None:
-            with open(latest_path, "w") as f:
-                f.write(str(tag))
+            _retry(lambda: _write_latest(latest_path, tag),
+                   ic.save_retries, ic.retry_backoff, "latest commit")
+        fault_point("ckpt.committed", tag=tag, root=root)
+        if commits_integrity:
+            prune_checkpoint_tags(os.path.abspath(save_dir), ic.keep_n,
+                                  protect=str(tag))
 
     # ship the recovery script into the checkpoint (reference engine.py:3540
     # _copy_recovery_script copies zero_to_fp32.py next to the shards)
@@ -198,18 +484,107 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     return True
 
 
+def _resolve_load_tag(engine, load_dir, tag):
+    """Resolve + verify the tag to load.  A corrupt or partial tag (failed
+    manifest verification) logs LOUDLY and falls back to the newest valid
+    tag instead of crashing — or worse, silently loading garbage weights
+    into a healthy optimizer state.  Returns the tag or None."""
+    requested = tag
+    integrity = engine._config.resilience_config.checkpoint_integrity.enabled
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip() or None
+        if tag is None:
+            # no auto-recovery here: `save_latest=False` snapshots are
+            # SUPPOSED to be invisible to auto-resume, and a dir whose only
+            # tags are partial first saves must mean a clean fresh start —
+            # but tell the operator what IS recoverable
+            hint, status = (find_latest_valid_tag(load_dir) if integrity
+                            else (None, None))
+            logger.warning(
+                f"no 'latest' file at {load_dir}; nothing loaded"
+                + (f" (a {status} tag '{hint}' exists — pass "
+                   f"tag={hint!r} to resume from it)"
+                   if hint is not None else ""))
+            return None
+
+    if not integrity:
+        if not os.path.isdir(os.path.join(load_dir, str(tag))):
+            logger.warning(f"checkpoint dir {load_dir}/{tag} missing; "
+                           "nothing loaded")
+            return None
+        return tag
+
+    # an EXPLICITLY requested tag may only ever fall back to an OLDER tag:
+    # the user naming 'step1000' is often a deliberate rollback away from a
+    # newer state — silently rolling FORWARD to the newest valid tag would
+    # hand back exactly the state they were escaping
+    ceiling = str(tag) if requested is not None else None
+
+    status, detail = verify_checkpoint_tag(os.path.join(load_dir, str(tag)))
+    if status == "valid":
+        return tag
+    if status == "legacy":
+        # no manifest: either a pre-integrity checkpoint (fine) or a save
+        # that died before commit (poison).  Prefer a VERIFIED tag (never
+        # newer than an explicit request); load the legacy one best-effort
+        # only when none exists.
+        fallback, fstatus = find_latest_valid_tag(load_dir,
+                                                  exclude=(str(tag),),
+                                                  not_newer_than=ceiling)
+        if fstatus == "valid":
+            logger.error(
+                f"CHECKPOINT INTEGRITY: tag '{tag}' at {load_dir} has no "
+                f"manifest ({detail}) — treating as partial; falling back "
+                f"to newest verified tag '{fallback}'")
+            _fallback_event(engine, load_dir, str(tag), fallback)
+            return fallback
+        if os.path.isdir(os.path.join(load_dir, str(tag))):
+            logger.warning(
+                f"checkpoint tag '{tag}' has no integrity manifest "
+                f"({detail}); loading best-effort (legacy layout)")
+            return tag
+        logger.warning(f"checkpoint dir {load_dir}/{tag} missing; "
+                       "nothing loaded")
+        return None
+    # corrupt: manifest says the bytes are wrong
+    logger.error(
+        f"CHECKPOINT INTEGRITY: tag '{tag}' at {load_dir} FAILED "
+        f"verification ({detail}); refusing to load it")
+    fallback, fstatus = find_latest_valid_tag(load_dir, exclude=(str(tag),),
+                                              not_newer_than=ceiling)
+    if fallback is None:
+        logger.error(f"no other usable tag under {load_dir}; nothing loaded"
+                     + ("" if requested is None else
+                        f" (requested tag was '{requested}')"))
+        return None
+    logger.error(f"RECOVERY: falling back to newest {fstatus} tag "
+                 f"'{fallback}'")
+    _fallback_event(engine, load_dir, str(tag), fallback)
+    return fallback
+
+
+def _fallback_event(engine, load_dir, bad_tag, good_tag):
+    """Surface a rollback through the monitor so dashboards see silent
+    corruption events (reference monitor event stream role)."""
+    monitor = getattr(engine, "monitor", None)
+    if monitor is not None and getattr(monitor, "enabled", False):
+        monitor.write_resilience_events(
+            [("ckpt_fallback", 1.0)], step=engine.global_samples)
+    logger.error("checkpoint rollback: %s/%s → %s", load_dir, bad_tag,
+                 good_tag)
+
+
 def load_engine_checkpoint(engine, load_dir, tag=None,
                            load_optimizer_states=True,
                            load_lr_scheduler_states=True,
                            load_module_only=False):
     load_dir = os.path.abspath(load_dir)
+    tag = _resolve_load_tag(engine, load_dir, tag)
     if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
+        return None, {}
     root = os.path.join(load_dir, str(tag))
     if not os.path.isdir(root):
         logger.warning(f"checkpoint dir {root} missing; nothing loaded")
@@ -217,6 +592,20 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
 
     with open(os.path.join(root, "engine_state.json")) as f:
         state = json.load(f)
+
+    mpath = os.path.join(root, MANIFEST_NAME)
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                saved_hash = json.load(f).get("config_hash")
+        except (OSError, ValueError):
+            saved_hash = None
+        if saved_hash and saved_hash != engine._config.config_hash():
+            logger.warning(
+                f"checkpoint {root} was saved under a different config "
+                f"(hash {saved_hash} != {engine._config.config_hash()}); "
+                "resuming anyway — expected after an elastic rescale, "
+                "suspicious otherwise")
 
     engine.params = _pytree_restore(
         os.path.join(root, "model"), template=engine.params,

@@ -220,6 +220,47 @@ class AioConfig(DeepSpeedConfigModel):
     use_gds: bool = False
 
 
+class CheckpointIntegrityConfig(DeepSpeedConfigModel):
+    """Per-tag ``manifest.json`` (file list + sizes + checksums + config
+    hash) committed after all tree writes; ``load_checkpoint`` verifies it
+    and falls back to the newest *valid* tag on mismatch/partial tags."""
+    enabled: bool = True
+    keep_n: int = Field(0, ge=0)  # valid tags retained; 0 = unlimited
+    save_retries: int = Field(3, ge=0)      # transient-FS retry attempts
+    retry_backoff: float = Field(0.25, ge=0.0)  # seconds, doubles per retry
+
+
+class FiniteGradsConfig(DeepSpeedConfigModel):
+    """Opt-in NaN/Inf + grad-norm-spike step guard: a poisoned step is
+    skipped via the fp16 loss-scaler skip path (also for bf16/fp32) and
+    consecutive skips past ``max_consecutive_skips`` abort loudly.  Enabling
+    it syncs the skip flag to host each boundary."""
+    enabled: bool = False
+    max_consecutive_skips: int = Field(5, ge=1)
+    # skip when gnorm > factor × running mean of recent gnorms; 0 disables
+    grad_norm_spike_factor: float = Field(0.0, ge=0.0)
+    spike_warmup_steps: int = Field(10, ge=0)  # steps before spikes arm
+
+
+class WatchdogConfig(DeepSpeedConfigModel):
+    """Worker-side heartbeat files monitored by ``DSElasticAgent`` so a
+    *hung* worker (stuck collective) is killed and relaunched, not just a
+    dead one.  ``heartbeat_dir`` defaults to ``$DS_TPU_HEARTBEAT_DIR`` (the
+    elastic agent exports a per-agent tempdir) and must be NODE-LOCAL per
+    agent — see ``elasticity/watchdog.py``."""
+    enabled: bool = False
+    heartbeat_dir: str = ""
+    stall_timeout: float = Field(300.0, gt=0.0)
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``"resilience"`` JSON section — see docs/resilience.md."""
+    checkpoint_integrity: CheckpointIntegrityConfig = \
+        CheckpointIntegrityConfig()
+    check_finite_grads: FiniteGradsConfig = FiniteGradsConfig()
+    watchdog: WatchdogConfig = WatchdogConfig()
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -353,6 +394,8 @@ class DeepSpeedConfig:
         self.data_types_config = DataTypesConfig(**pd.get("data_types", {}) or {})
         self.aio_config = AioConfig(**pd.get("aio", {}) or {})
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}) or {})
+        self.resilience_config = ResilienceConfig(
+            **pd.get("resilience", {}) or {})
 
         self.gradient_accumulation_dtype = self.data_types_config.grad_accum_dtype
 
@@ -453,6 +496,15 @@ class DeepSpeedConfig:
                                                      or self.bfloat16_enabled):
             logger.debug("ZeRO enabled with fp32 — allowed, but bf16 is the "
                          "TPU-recommended precision")
+
+    def config_hash(self):
+        """Stable content hash of the user config — recorded in each
+        checkpoint manifest so a resume under a *different* config is
+        flagged (warning, not error: elastic rescales legitimately resume
+        with a re-solved batch schedule)."""
+        import hashlib
+        blob = json.dumps(self._param_dict, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     def print_user_config(self):
         logger.info(json.dumps(self._param_dict, sort_keys=True, indent=4))

@@ -280,7 +280,9 @@ class DistributedDataAnalyzer:
     def __init__(self, dataset, output_path, metric_names=None,
                  metric_functions=None, metric_types=None,
                  metric_dtypes=None, batch_size=64, sample_indices=None,
-                 shared_fs=True, comm=None):
+                 shared_fs=True, comm=None, custom_map_init=None,
+                 custom_map_update=None, custom_map_finalize=None,
+                 custom_reduce=None):
         from ... import comm as dist
         self._dist = comm or dist
         if not self._dist.is_initialized():
@@ -297,7 +299,11 @@ class DistributedDataAnalyzer:
             metric_functions=metric_functions, metric_types=metric_types,
             metric_dtypes=metric_dtypes, batch_size=batch_size,
             num_workers=self.num_workers, worker_id=self.worker_rank,
-            sample_indices=sample_indices)
+            sample_indices=sample_indices,
+            custom_map_init=custom_map_init,
+            custom_map_update=custom_map_update,
+            custom_map_finalize=custom_map_finalize,
+            custom_reduce=custom_reduce)
 
     def run_map_reduce(self):
         """Returns the merged dict on rank 0, None elsewhere."""
@@ -315,9 +321,23 @@ class DistributedDataAnalyzer:
                 return 0.0
             return np.asarray(v).tolist() if not np.isscalar(v) else v
 
+        def local_custom_state():
+            """This rank's custom_map_finalize output (written by run_map
+            to a LOCAL json) — it must ride the send payload: without a
+            shared mount, rank 0's reduce cannot see the file, and the
+            reference's custom_reduce would silently fold rank-0 state
+            only."""
+            if self._an.custom_map_finalize is None:
+                return None
+            path = os.path.join(self._an.output_path,
+                                f"custom_worker{self.worker_rank}.json")
+            with open(path) as f:
+                return json.load(f)
+
         if self.worker_rank != 0:
-            self._dist.send_obj({k: wire(v) for k, v in local.items()},
-                                dst=0, tag=701)
+            payload = {k: wire(v) for k, v in local.items()}
+            payload["__custom_state__"] = local_custom_state()
+            self._dist.send_obj(payload, dst=0, tag=701)
             self._dist.barrier()
             return None
         shards = [local]
@@ -333,6 +353,10 @@ class DistributedDataAnalyzer:
                     val = [val]
                 np.save(self._an._shard_file(name, w),
                         np.asarray(val, dtype=np.float64))
+            if w > 0 and shard.get("__custom_state__") is not None:
+                with open(os.path.join(self._an.output_path,
+                                       f"custom_worker{w}.json"), "w") as f:
+                    json.dump(shard["__custom_state__"], f)
         out = self._an.run_reduce()
         self._dist.barrier()
         return out

@@ -96,6 +96,20 @@ class DeepSpeedDataSampler:
         # step), which is then yielded as gas micro index-lists — the
         # reference paces difficulty by global step the same way
         self.gas = max(1, int(gradient_accumulation_steps))
+        # the per-rank slice must divide evenly into gas micro index-lists:
+        # a remainder would be silently DROPPED from every global batch in
+        # __iter__ (yet still counted as consumed), starving each step
+        if self.global_batch_size % self.dp_size != 0:
+            raise ValueError(
+                f"global_batch_size ({self.global_batch_size}) is not "
+                f"divisible by data_parallel_size ({self.dp_size})")
+        if (self.global_batch_size // self.dp_size) % self.gas != 0:
+            raise ValueError(
+                f"per-rank batch ({self.global_batch_size} // "
+                f"{self.dp_size} = {self.global_batch_size // self.dp_size})"
+                f" is not divisible by gradient_accumulation_steps "
+                f"({self.gas}) — the trailing samples of every global "
+                f"batch would be dropped; adjust the batch-size trinity")
         self.batch_step = 0         # lifetime GLOBAL batches drawn
         self.epoch_batch_step = 0   # global batches drawn in current epoch
         self.consumed_samples = 0

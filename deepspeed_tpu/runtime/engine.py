@@ -51,6 +51,21 @@ from .zero.partition import ZeroPartitionPlan
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
 
+def _owned_host_tree(tree):
+    """``jax.device_get`` that GUARANTEES owning numpy arrays.
+
+    On the CPU backend device_get returns zero-copy views (``owndata=False``,
+    dlpack capsule base) aliasing the live XLA buffer; an offload path that
+    drops the device reference and later reads the "host copy" is then
+    reading freed/donation-reused memory — observed as NaN losses or a
+    hard interpreter abort after ``offload_states``.  Copy only when the
+    result actually aliases, so real-device transfers stay single-copy."""
+    def own(a):
+        a = np.asarray(a)
+        return a if a.flags.owndata else np.array(a, copy=True)
+    return jax.tree_util.tree_map(own, jax.device_get(tree))
+
+
 class _ParamGroup(dict):
     """torch-style param group whose ``["lr"] = x`` writes reach the compiled
     step: the engine routes the value into the optimizer state's runtime
@@ -337,6 +352,32 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
 
+        # -------------------------------------------------------- resilience
+        rs = config.resilience_config
+        self._finite_guard = rs.check_finite_grads
+        self._consecutive_skips = 0
+        self._gnorm_ema = None   # host-side running mean for spike detection
+        if self._finite_guard.enabled and self._onebit_opt is not None:
+            raise ValueError(
+                "resilience.check_finite_grads is not supported with 1-bit "
+                "optimizers (their apply path manages its own skip logic); "
+                "disable one of them")
+        self._heartbeat = None
+        from ..elasticity.watchdog import HEARTBEAT_DIR_ENV
+        hb_dir = rs.watchdog.heartbeat_dir or os.environ.get(
+            HEARTBEAT_DIR_ENV, "")
+        if (rs.watchdog.enabled or HEARTBEAT_DIR_ENV in os.environ) \
+                and hb_dir:
+            from ..elasticity.watchdog import HeartbeatWriter
+            self._heartbeat = HeartbeatWriter(hb_dir,
+                                              rank=jax.process_index())
+        elif rs.watchdog.enabled:
+            logger.warning(
+                "resilience.watchdog enabled but no heartbeat_dir "
+                "configured and DS_TPU_HEARTBEAT_DIR is unset — no "
+                "heartbeats will be written (run under the elastic agent "
+                "or set resilience.watchdog.heartbeat_dir)")
+
         # ------------------------------------------- progressive layer drop
         pld_cfg = getattr(config, "pld_config", None)
         if pld_cfg is not None and pld_cfg.enabled:
@@ -595,7 +636,7 @@ class DeepSpeedEngine:
         """Move (master, opt_state) HBM → disk; async writes, device buffers
         released immediately (this is what shrinks the HBM footprint)."""
         tree = {"master": self.master, "opt_state": self.opt_state}
-        host = jax.device_get(tree)
+        host = _owned_host_tree(tree)
         self.master = None
         self.opt_state = None
         self._state_on_nvme = True
@@ -640,9 +681,11 @@ class DeepSpeedEngine:
         if desc is None or self._config.fp16_enabled or \
                 self._param_transforms or \
                 getattr(self, "_host_offloaded", None) or \
+                self._finite_guard.enabled or \
                 jax.process_count() > 1:
-            # dynamic loss scaling / QAT transforms / multi-host keep the
-            # compiled device path (each would need its own host pass)
+            # dynamic loss scaling / QAT transforms / finite-grad guard /
+            # multi-host keep the compiled device path (each would need its
+            # own host pass — the guard's skip-select in particular)
             return None
         name, p = desc
         from ..ops import cpu_optimizers as K
@@ -1106,17 +1149,24 @@ class DeepSpeedEngine:
     def _apply_update_fn(self):
         """The boundary step: unscale, overflow, clip, optimizer, recast."""
         if self._onebit_opt is not None:
-            return self._onebit_opt.build_apply(self)
+            inner = self._onebit_opt.build_apply(self)
+            # 1-bit applies manage their own skip logic; accept (and drop)
+            # the guard's spike-limit operand so step() calls uniformly
+            return (lambda params, master, opt_state, grad_acc, scale_state,
+                    spike_limit: inner(params, master, opt_state, grad_acc,
+                                       scale_state))
         plan = self.plan
         cfg = self._config
         grad_clip = cfg.gradient_clipping
         transform = self._grad_transform
         scaler = self.loss_scaler
         fp16 = cfg.fp16_enabled
+        guard = self._finite_guard.enabled
         compute_dtype = self.compute_dtype
         has_master = self.master is not None
 
-        def apply(params, master, opt_state, grad_acc, scale_state):
+        def apply(params, master, opt_state, grad_acc, scale_state,
+                  spike_limit):
             inv = 1.0 / scale_state.scale
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) * inv, grad_acc)
@@ -1125,8 +1175,14 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(g, s),
                 grads, plan.master_shardings(grads))
-            overflow = has_overflow(grads) if fp16 else jnp.zeros((), jnp.bool_)
+            overflow = (has_overflow(grads) if fp16 or guard
+                        else jnp.zeros((), jnp.bool_))
             gnorm = global_grad_norm(grads)
+            # the poisoned/spiking step rides the fp16 skip path for every
+            # precision: the update is computed but never committed
+            skip = overflow
+            if guard:
+                skip = jnp.logical_or(skip, gnorm > spike_limit)
             if grad_clip and grad_clip > 0:
                 grads, _ = clip_grads_by_global_norm(grads, grad_clip, norm=gnorm)
 
@@ -1139,7 +1195,7 @@ class DeepSpeedEngine:
             # skip on overflow (reference fp16 optimizer step semantics)
             def sel(new, old):
                 return jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(overflow, o, n), new, old)
+                    lambda n, o: jnp.where(skip, o, n), new, old)
             new_target = sel(new_target, target)
             new_opt = sel(new_opt, opt_state)
 
@@ -1153,8 +1209,10 @@ class DeepSpeedEngine:
                 new_master = None
                 new_params = new_target
 
+            # loss-scale dynamics key off true fp16 overflow only — a
+            # grad-norm spike must not shrink the scale
             new_scale = scaler.update(scale_state, overflow)
-            return new_params, new_master, new_opt, new_scale, overflow, gnorm
+            return new_params, new_master, new_opt, new_scale, skip, gnorm
 
         return apply
 
@@ -1163,6 +1221,52 @@ class DeepSpeedEngine:
             self._compiled_apply = jax.jit(
                 self._apply_update_fn(), donate_argnums=(0, 1, 2, 3, 4))
         return self._compiled_apply
+
+    def _spike_limit(self):
+        """Grad-norm ceiling for the current step (replicated f32 scalar):
+        ``spike_factor ×`` the running mean of recent healthy grad norms,
+        +inf while disabled / warming up."""
+        g = self._finite_guard
+        if (not g.enabled or g.grad_norm_spike_factor <= 0
+                or self._gnorm_ema is None
+                or self.global_steps < g.spike_warmup_steps):
+            return jnp.asarray(jnp.inf, jnp.float32)
+        return jnp.asarray(g.grad_norm_spike_factor * self._gnorm_ema,
+                           jnp.float32)
+
+    def _account_guarded_step(self, skip, gnorm):
+        """Host-side consecutive-skip bookkeeping for the finite-grad guard
+        (one device sync per boundary — the documented cost of enabling
+        it).  Aborts loudly when skips persist: silently skipping forever
+        turns a poisoned data pipeline into a training run that 'finishes'
+        without having trained."""
+        g = self._finite_guard
+        tripped = bool(jax.device_get(skip))
+        gn = float(jax.device_get(gnorm))
+        if not tripped:
+            self._consecutive_skips = 0
+            if np.isfinite(gn):
+                self._gnorm_ema = (gn if self._gnorm_ema is None
+                                   else 0.9 * self._gnorm_ema + 0.1 * gn)
+            return
+        self._consecutive_skips += 1
+        logger.warning(
+            "finite-grad guard: skipped poisoned step %d (grad norm %s, "
+            "%d consecutive skip(s), abort at %d)", self.global_steps + 1,
+            gn, self._consecutive_skips, g.max_consecutive_skips)
+        if self.monitor.enabled:
+            self.monitor.write_resilience_events(
+                [("consecutive_skips", float(self._consecutive_skips))],
+                step=self.global_samples)
+        if self._consecutive_skips >= g.max_consecutive_skips:
+            raise RuntimeError(
+                f"finite-grad guard: {self._consecutive_skips} consecutive "
+                f"steps produced non-finite or spiking gradients (last "
+                f"grad norm {gn}, step {self.global_steps + 1}) — the "
+                "input pipeline or numerics are poisoned, not transient; "
+                "aborting so the supervisor can restart from the last "
+                "valid checkpoint. Raise resilience.check_finite_grads."
+                "max_consecutive_skips if this is expected.")
 
     # ------------------------------------------------------------- public API
     def forward(self, *inputs, **kwargs):
@@ -1179,6 +1283,14 @@ class DeepSpeedEngine:
                       jax.random.PRNGKey(self.micro_steps))
         micro = self._get_compiled_micro(inputs)
         loss, grads = micro(self.params, self.scale_state.scale, inputs)
+        from ..utils.fault_injection import fault_point
+        if fault_point("engine.poison", step=self.micro_steps):
+            # injected data poisoning: NaN loss + grads, exactly what a bad
+            # batch / numerics blow-up produces — drives the finite-grad
+            # guard tests
+            loss = jnp.full_like(loss, jnp.nan)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.full_like(g, jnp.nan), grads)
         self._stashed_grads = grads
         self._micro_losses.append(loss)  # device scalar; synced only on report
         self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -1291,7 +1403,7 @@ class DeepSpeedEngine:
                                    "any backward() since the last boundary")
             host_gnorm = self._try_host_offload_step()
             if host_gnorm is not None:
-                overflow = jnp.zeros((), jnp.bool_)
+                skipped = jnp.zeros((), jnp.bool_)
                 gnorm = host_gnorm
             else:
                 # restore offloaded state FIRST — grads may live on host via
@@ -1303,13 +1415,15 @@ class DeepSpeedEngine:
                         "backward() since the last boundary")
                 apply = self._get_compiled_apply()
                 (self.params, self.master, self.opt_state,
-                 self.scale_state, overflow, gnorm) = apply(
+                 self.scale_state, skipped, gnorm) = apply(
                     self.params, self.master, self.opt_state, self.grad_acc,
-                    self.scale_state)
+                    self.scale_state, self._spike_limit())
                 self.grad_acc = None
                 if self._nvme_swapper is not None:
                     # updated state back to disk (async; overlaps next fwd)
                     self._nvme_swap_out()
+            if self._finite_guard.enabled:
+                self._account_guarded_step(skipped, gnorm)
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if self.progressive_layer_drop is not None:
@@ -1317,7 +1431,7 @@ class DeepSpeedEngine:
             if self._config.fp16_enabled:
                 # NO host sync here: the overflow flag accumulates on device
                 # and drains at steps_per_print (or on a skipped_steps read)
-                ov = overflow.astype(jnp.int32)
+                ov = skipped.astype(jnp.int32)
                 self._overflow_acc = (ov if self._overflow_acc is None
                                       else self._overflow_acc + ov)
             if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
@@ -1334,6 +1448,10 @@ class DeepSpeedEngine:
                 self._last_loss = self._micro_losses
                 self._micro_losses = []
             self._report_step_metrics(gnorm)
+            if self._heartbeat is not None:
+                # liveness signal for the elastic agent's watchdog: one
+                # atomic file write per optimizer step
+                self._heartbeat.beat(self.global_steps)
         self.micro_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
 
@@ -1460,7 +1578,8 @@ class DeepSpeedEngine:
             if tree is None or attr in self._host_offloaded:
                 continue
             shardings = jax.tree_util.tree_map(lambda x: x.sharding, tree)
-            host = jax.device_get(tree)   # commits to host numpy
+            host = _owned_host_tree(tree)  # OWNING host copy — a device_get
+            # view would alias the buffer released on the next line
             setattr(self, attr, None)     # release the HBM buffers
             self._host_offloaded[attr] = (host, shardings)
 

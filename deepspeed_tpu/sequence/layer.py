@@ -64,12 +64,24 @@ class DistributedAttention:
         self.scatter_idx = scatter_idx  # head dim of [B,S,H,D]
         self.gather_idx = gather_idx    # sequence dim
 
+    @staticmethod
+    def _check_gqa_heads(n_q_heads, n_kv):
+        """GQA requires q heads in whole groups per kv head — otherwise the
+        routing table's clip-mode ``jnp.take`` silently maps the surplus q
+        heads onto the LAST kv head (wrong attention, right shapes)."""
+        if n_q_heads % n_kv != 0:
+            raise ValueError(
+                f"invalid GQA config: {n_q_heads} query heads are not an "
+                f"integer multiple of {n_kv} kv heads — each kv head must "
+                "serve the same whole number of q heads")
+
     def _align_gqa_local(self, q, k, v):
         """sp=1 / passthrough: the local core expects matched head counts,
         so native-width GQA kv repeats here (callers pass kv UN-repeated —
         the sp>1 reshard aligns on the wire instead)."""
         n_kv, H = k.shape[self.scatter_idx], q.shape[self.scatter_idx]
         if n_kv != H:
+            self._check_gqa_heads(H, n_kv)
             rep = H // n_kv
             k = jnp.repeat(k, rep, axis=self.scatter_idx)
             v = jnp.repeat(v, rep, axis=self.scatter_idx)
@@ -114,6 +126,7 @@ class DistributedAttention:
           full [B, S, n_kv, D] kv (the sequence-all-gather fallback this
           replaces); wire+memory cost equals the q path's."""
         n_kv = t.shape[self.scatter_idx]
+        self._check_gqa_heads(n_q_heads, n_kv)
         group = max(1, n_q_heads // n_kv)  # q heads per kv head
         if n_kv % sp == 0 and n_q_heads % sp == 0:
             t = single_all_to_all(t, self.scatter_idx, self.gather_idx,

@@ -9,6 +9,13 @@ mesh-axis collective (dp/sp/pp/tp/ep) executes with real SPMD semantics.
 
 import os
 
+# torch is imported at collection time (test_torch_migration) and its OpenMP
+# pool coexists badly with XLA's Eigen + tensorstore threads on small CPU
+# boxes — intermittent suite-wide segfaults mid-jit-execution.  Pin OpenMP
+# to one thread BEFORE anything native loads; the suite's torch work is a
+# handful of tiny tensor saves, XLA does not use OpenMP.
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+
 # Must be set before jax is imported anywhere.  Force-override: the ambient
 # environment may pin JAX_PLATFORMS to the real TPU tunnel (e.g. "axon").
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -24,12 +31,56 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache: per-test engine rebuilds re-jit the same
-# programs; caching compiled executables across tests AND across pytest runs
-# is the difference between a ~10-minute and a ~2-minute suite on 1 CPU.
-_cache_dir = os.environ.get("DS_TPU_TEST_CACHE",
-                            os.path.join(os.path.dirname(__file__),
-                                         ".jax_cache"))
+# XLA compilation cache — PER-SESSION by default, cross-run only by opt-in.
+#
+# The disk cache matters even within a single pytest process: each test's
+# engine makes fresh jit objects, so the in-memory cache (keyed by function
+# identity) misses, while the disk cache (keyed by HLO hash) dedupes the
+# recompiles — worth ~40% of suite wall time.
+#
+# It must NOT persist across runs by default: on this jax/jaxlib (0.4.3x
+# CPU) executables deserialized from a cache written by a PREVIOUS process
+# mishandle donated buffers — warm-cache runs deterministically NaN the
+# engine offload/reload tests and intermittently segfault the whole pytest
+# process, while identical cold runs pass.  A fresh per-session directory
+# keeps the in-run speedup and makes cross-run poisoning structurally
+# impossible.
+#
+# DS_TPU_TEST_CACHE opts into a shared cross-run cache (for TPU-tunnel
+# machines where compiles dominate): the dir is namespaced by jax/jaxlib
+# version (a different build's entries segfault on deserialize) and
+# self-heals — a dirty marker held for the session means a crashed run,
+# whose entries may be truncated mid-write, wipes the dir on next start.
+import tempfile  # noqa: E402
+
+_cache_opt_in = os.environ.get("DS_TPU_TEST_CACHE")
+if _cache_opt_in:
+    import jaxlib
+
+    _cache_dir = os.path.join(_cache_opt_in,
+                              f"{jax.__version__}-{jaxlib.__version__}")
+    _dirty_marker = os.path.join(_cache_dir, ".session_dirty")
+    if os.path.exists(_dirty_marker):
+        import shutil
+        shutil.rmtree(_cache_dir, ignore_errors=True)
+    os.makedirs(_cache_dir, exist_ok=True)
+    with open(_dirty_marker, "w") as _f:
+        _f.write(str(os.getpid()))
+
+    def pytest_sessionfinish(session, exitstatus):
+        """Clean exit → this session's cache entries are trustworthy."""
+        try:
+            os.remove(_dirty_marker)
+        except OSError:
+            pass
+else:
+    _cache_dir = tempfile.mkdtemp(prefix="ds_tpu_jax_cache_")
+
+    def pytest_sessionfinish(session, exitstatus):
+        """The per-session cache is garbage once the process exits."""
+        import shutil
+        shutil.rmtree(_cache_dir, ignore_errors=True)
+
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

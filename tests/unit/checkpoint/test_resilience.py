@@ -1,0 +1,382 @@
+"""Checkpoint integrity + rollback + finite-grad guard tests — every
+recovery path driven through the fault-injection harness
+(``deepspeed_tpu/utils/fault_injection.py``), per docs/resilience.md."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime import checkpoint_engine as ce
+from deepspeed_tpu.utils import fault_injection as fi
+from tests.unit.simple_model import (batches, make_simple_mlp_params,
+                                     random_dataset, simple_mlp_apply)
+
+HIDDEN = 16
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _config(resilience=None, **extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adam", "params": {"lr": 0.02}},
+    }
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    cfg.update(extra)
+    return cfg
+
+
+def _make_engine(resilience=None, seed=0, **extra):
+    params = make_simple_mlp_params(HIDDEN, seed=seed)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params,
+        config=_config(resilience, **extra))
+    return engine
+
+
+def _data(engine):
+    return iter(batches(random_dataset(64, HIDDEN),
+                        4 * engine.dp_world_size) * 200)
+
+
+def _step(engine, it):
+    x, y = next(it)
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    return loss
+
+
+def _snap(tree):
+    """OWNING host snapshot — plain device_get returns views that alias the
+    live buffers, which the next donated step reuses (the snapshot would
+    silently follow the training run)."""
+    return jax.tree_util.tree_map(lambda x: np.array(x),
+                                  jax.device_get(tree))
+
+
+# ------------------------------------------------------------- manifest
+def test_manifest_written_and_verifies(tmp_path):
+    engine = _make_engine()
+    it = _data(engine)
+    _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    root = str(tmp_path / "t1")
+    status, detail = ce.verify_checkpoint_tag(root)
+    assert status == "valid", detail
+    with open(os.path.join(root, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["tag"] == "t1"
+    assert manifest["config_hash"] == engine._config.config_hash()
+    assert "engine_state.json" in manifest["files"]
+    assert any(rel.startswith("model") for rel in manifest["files"])
+    for meta in manifest["files"].values():
+        assert meta["size"] > 0
+
+
+def test_truncated_tag_falls_back_to_newest_valid(tmp_path):
+    """Acceptance: post-commit corruption of the latest tag is detected via
+    the manifest and load resumes from the previous valid tag."""
+    engine = _make_engine()
+    it = _data(engine)
+    for _ in range(3):
+        _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    p_t1 = _snap(engine.params)
+    for _ in range(2):
+        _step(engine, it)
+
+    # corrupt t2 AFTER its manifest+latest commit (bit rot / lost flush)
+    fi.inject("ckpt.committed",
+              lambda ctx: (fi.truncate_file_in_tag(ctx["root"],
+                                                   "engine_state.json")
+                           if ctx["tag"] == "t2" else None))
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    assert ce.verify_checkpoint_tag(str(tmp_path / "t2"))[0] == "corrupt"
+
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path))   # latest → corrupt t2
+    assert root is not None and root.endswith("t1")
+    assert fresh.global_steps == 3
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(fresh.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(p_t1)[0]), rtol=0,
+        atol=0, err_msg="fallback must load t1's weights, not garbage")
+
+
+def test_partial_tag_without_manifest_prefers_verified(tmp_path):
+    """A save that dies before manifest commit leaves a manifest-less tag;
+    explicit loads of it must divert to a verified tag instead of opening
+    the partial bytes."""
+    engine = _make_engine(
+        resilience={"checkpoint_integrity": {"save_retries": 0}})
+    it = _data(engine)
+    _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+
+    def die(ctx):
+        raise fi.FaultError("injected: save dies mid-write")
+    fi.inject("ckpt.save_tree", die)
+    with pytest.raises(OSError):
+        engine.save_checkpoint(str(tmp_path), tag="partial")
+    fi.clear()
+    # the partial tag exists on disk but never got a manifest; `latest`
+    # still names the last committed tag
+    assert os.path.isdir(tmp_path / "partial")
+    assert not os.path.exists(tmp_path / "partial" / "manifest.json")
+    assert (tmp_path / "latest").read_text() == "good"
+
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path), tag="partial")
+    assert root is not None and root.endswith("good")
+
+
+def test_save_retries_transient_failures(tmp_path):
+    engine = _make_engine(
+        resilience={"checkpoint_integrity": {"save_retries": 3,
+                                             "retry_backoff": 0.0}})
+    it = _data(engine)
+    _step(engine, it)
+
+    def flaky(ctx):
+        if ctx["call"] <= 2:
+            raise fi.FaultError(f"injected transient failure {ctx['call']}")
+    fi.inject("ckpt.save_tree", flaky)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    assert fi.fire_count("ckpt.save_tree") > 2   # retried through failures
+    assert ce.verify_checkpoint_tag(str(tmp_path / "t1"))[0] == "valid"
+
+
+def test_retry_exhaustion_raises(tmp_path):
+    engine = _make_engine(
+        resilience={"checkpoint_integrity": {"save_retries": 1,
+                                             "retry_backoff": 0.0}})
+    it = _data(engine)
+    _step(engine, it)
+
+    def always(ctx):
+        raise fi.FaultError("injected permanent failure")
+    fi.inject("ckpt.save_tree", always)
+    with pytest.raises(OSError):
+        engine.save_checkpoint(str(tmp_path), tag="t1")
+
+
+def test_latest_missing_loads_nothing_but_hints(tmp_path):
+    """No `latest` keeps the fresh-start contract (save_latest=False
+    snapshots must stay invisible to auto-resume) — but the recoverable
+    tag is discoverable and loads when named explicitly."""
+    engine = _make_engine()
+    it = _data(engine)
+    _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="t1", save_latest=False)
+    assert not os.path.exists(tmp_path / "latest")
+
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path))
+    assert root is None and fresh.global_steps == 0
+    # the hint surfaced in the warning comes from find_latest_valid_tag
+    assert ce.find_latest_valid_tag(str(tmp_path)) == ("t1", "valid")
+    # ...and the hinted tag loads when asked for explicitly
+    root, _ = fresh.load_checkpoint(str(tmp_path), tag="t1")
+    assert root is not None and root.endswith("t1")
+
+
+def test_explicit_tag_never_rolls_forward(tmp_path):
+    """An explicitly requested tag is a deliberate rollback target; if it
+    is corrupt the fallback may only go BACKWARD, never to a newer tag."""
+    engine = _make_engine()
+    it = _data(engine)
+    _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    _step(engine, it)
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    fi.truncate_file_in_tag(str(tmp_path / "t1"), "engine_state.json")
+
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path), tag="t1")
+    assert root is None           # t2 is newer: NOT an acceptable stand-in
+    assert fresh.global_steps == 0
+    # the auto (latest) path is unaffected and still loads t2
+    root, _ = fresh.load_checkpoint(str(tmp_path))
+    assert root is not None and root.endswith("t2")
+
+
+def test_latest_missing_with_only_partial_tag_loads_nothing(tmp_path):
+    """No `latest` + only a manifest-less (partial) tag must mean a clean
+    fresh start, not a crash-looping resume into half-written bytes."""
+    engine = _make_engine(
+        resilience={"checkpoint_integrity": {"save_retries": 0}})
+    it = _data(engine)
+    _step(engine, it)
+
+    def die(ctx):
+        raise fi.FaultError("injected: save dies mid-write")
+    fi.inject("ckpt.save_tree", die)
+    with pytest.raises(OSError):
+        engine.save_checkpoint(str(tmp_path), tag="partial")
+    fi.clear()
+    assert not os.path.exists(tmp_path / "latest")
+
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path))
+    assert root is None and fresh.global_steps == 0
+
+
+def test_keep_n_retention_never_gcs_last_valid(tmp_path):
+    engine = _make_engine(
+        resilience={"checkpoint_integrity": {"keep_n": 2}})
+    it = _data(engine)
+    for i in range(4):
+        _step(engine, it)
+        engine.save_checkpoint(str(tmp_path), tag=f"t{i}")
+    remaining = sorted(t for t in os.listdir(tmp_path)
+                       if (tmp_path / t).is_dir())
+    assert remaining == ["t2", "t3"]
+    fresh = _make_engine(seed=1)
+    root, _ = fresh.load_checkpoint(str(tmp_path))
+    assert root.endswith("t3") and fresh.global_steps == 4
+    # pruning only ever touches VERIFIED tags: the newest valid one (and
+    # anything unverifiable) must survive even with keep_n=1
+    removed = ce.prune_checkpoint_tags(str(tmp_path), keep_n=1)
+    assert removed == ["t2"]
+    assert ce.verify_checkpoint_tag(str(tmp_path / "t3"))[0] == "valid"
+
+
+# ------------------------------------------------------------ async save
+def test_async_save_commits_manifest_and_latest(tmp_path):
+    engine = _make_engine()
+    it = _data(engine)
+    _step(engine, it)
+    handle = engine.save_checkpoint(str(tmp_path), tag="a", async_save=True)
+    handle.wait()
+    assert (tmp_path / "latest").read_text() == "a"
+    assert ce.verify_checkpoint_tag(str(tmp_path / "a"))[0] == "valid"
+
+
+def test_async_wait_surfaces_background_failure(tmp_path):
+    """A failed background write must raise from ``wait()`` and must NOT
+    commit `latest` — a silently-dropped async error is a checkpoint the
+    operator believes exists."""
+
+    class FailingCkptr:
+        def wait_until_finished(self):
+            raise RuntimeError("injected background write failure")
+
+        def close(self):
+            pass
+
+    latest = str(tmp_path / "latest")
+    handle = ce._AsyncSaveHandle([FailingCkptr()], latest_path=latest,
+                                 tag="x", root=str(tmp_path / "x"),
+                                 integrity=True)
+    with pytest.raises(RuntimeError, match="injected background"):
+        handle.wait()
+    assert not os.path.exists(latest)
+    assert handle.done          # a failed commit must not wedge retries
+    handle.wait()               # idempotent after completion
+
+
+# ------------------------------------------------------- finite-grad guard
+def test_poisoned_step_skipped_without_corrupting_state(tmp_path):
+    """Acceptance: a NaN loss step is skipped — params AND optimizer
+    moments keep their pre-poison values — and training continues."""
+    engine = _make_engine(
+        resilience={"check_finite_grads": {"enabled": True,
+                                           "max_consecutive_skips": 5}})
+    it = _data(engine)
+    losses = [float(_step(engine, it)) for _ in range(3)]
+    p_before = _snap(engine.params)
+    o_before = _snap(engine.opt_state)
+
+    fi.inject("engine.poison", lambda ctx: ctx["call"] == 1)  # one step
+    _step(engine, it)
+    assert engine._consecutive_skips == 1
+    p_after = _snap(engine.params)
+    o_after = _snap(engine.opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(p_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(o_before),
+                    jax.tree_util.tree_leaves(o_after)):
+        if np.issubdtype(np.asarray(a).dtype, np.floating):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # poisoned step still advanced the counter (fp16 skip semantics)
+    assert engine.global_steps == 4
+    fi.clear()
+    more = [float(_step(engine, it)) for _ in range(3)]
+    assert engine._consecutive_skips == 0
+    assert np.isfinite(more).all() and more[-1] < losses[0]
+
+
+def test_consecutive_poison_aborts_with_clear_error():
+    engine = _make_engine(
+        resilience={"check_finite_grads": {"enabled": True,
+                                           "max_consecutive_skips": 3}})
+    it = _data(engine)
+    _step(engine, it)
+    fi.inject("engine.poison", lambda ctx: True)
+    with pytest.raises(RuntimeError, match="consecutive"):
+        for _ in range(10):
+            _step(engine, it)
+    assert engine._consecutive_skips == 3
+
+
+def test_grad_norm_spike_skipped():
+    engine = _make_engine(
+        resilience={"check_finite_grads": {
+            "enabled": True, "grad_norm_spike_factor": 10.0,
+            "spike_warmup_steps": 3, "max_consecutive_skips": 5}})
+    it = _data(engine)
+    for _ in range(5):
+        _step(engine, it)
+    assert engine._consecutive_skips == 0
+    assert engine._gnorm_ema is not None
+    p_before = _snap(engine.params)
+    x, y = next(it)
+    loss = engine(x * 1e4, y)     # ~1e8× the healthy grad norm
+    engine.backward(loss)
+    engine.step()
+    assert engine._consecutive_skips == 1
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(_snap(engine.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _step(engine, it)             # healthy step commits again
+    assert engine._consecutive_skips == 0
+
+
+def test_guard_disabled_keeps_fast_path():
+    """Without the guard no per-step host sync or skip logic is armed (the
+    default path stays the default path)."""
+    engine = _make_engine()
+    assert not engine._finite_guard.enabled
+    it = _data(engine)
+    fi.inject("engine.poison", lambda ctx: ctx["call"] == 1)
+    _step(engine, it)   # poisons through — but must not raise
+    assert engine._consecutive_skips == 0
+
+
+# ------------------------------------------------------------- heartbeat
+def test_engine_heartbeats_under_env(tmp_path, monkeypatch):
+    from deepspeed_tpu.elasticity.watchdog import HEARTBEAT_DIR_ENV
+    hb = tmp_path / "hb"
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, str(hb))
+    engine = _make_engine()
+    assert engine._heartbeat is not None
+    it = _data(engine)
+    _step(engine, it)
+    files = list(hb.glob("heartbeat_rank*.json"))
+    assert len(files) == 1
+    payload = json.loads(files[0].read_text())
+    assert payload["step"] == 1 and payload["pid"] == os.getpid()

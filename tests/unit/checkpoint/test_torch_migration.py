@@ -18,6 +18,10 @@ import numpy as np
 import pytest
 
 torch = pytest.importorskip("torch")
+# single-threaded torch: its OpenMP pool races XLA's threadpools on small
+# CPU boxes (intermittent segfaults later in the suite); the fixtures here
+# only save tiny tensors
+torch.set_num_threads(1)
 
 import jax
 import jax.numpy as jnp

@@ -216,3 +216,13 @@ def test_init_inference_checkpoint_and_mp_snapshot(tmp_path):
         deepspeed_tpu.init_inference((model, params), dtype="float32",
                                      checkpoint=str(snap))
     groups.reset_mesh(); dist.destroy_process_group()
+
+
+def test_quant_group_size_default_matches_lane_group():
+    """The default group_size derives from the TPU lane width, so default
+    configs no longer trip the quantizer's clamp-and-warn path on every
+    quantized-serving run (ADVICE.md)."""
+    from deepspeed_tpu.inference.config import LANE_GROUP, QuantTypeConfig
+    from deepspeed_tpu.inference import quant_serving
+    assert QuantTypeConfig().group_size == LANE_GROUP == 128
+    assert quant_serving.LANE_GROUP is LANE_GROUP

@@ -452,3 +452,18 @@ def test_curriculum_survives_universal_checkpoint(tmp_path):
     assert s2.curriculum_scheduler.get_current_difficulty() == \
         s.curriculum_scheduler.get_current_difficulty()
     groups.reset_mesh()
+
+
+def test_sampler_rejects_indivisible_batch_config():
+    """Per-rank batch must split evenly into gas micro-lists — a remainder
+    would be silently dropped from every global batch (ADVICE.md)."""
+    with pytest.raises(ValueError, match="gradient_accumulation_steps"):
+        DeepSpeedDataSampler(total_samples=64, global_batch_size=6,
+                             gradient_accumulation_steps=4)
+    with pytest.raises(ValueError, match="data_parallel_size"):
+        DeepSpeedDataSampler(total_samples=64, global_batch_size=6,
+                             data_parallel_size=4)
+    # divisible configs still construct
+    DeepSpeedDataSampler(total_samples=64, global_batch_size=8,
+                         gradient_accumulation_steps=4,
+                         data_parallel_size=2)

@@ -220,3 +220,16 @@ def test_engine_trains_gqa_uneven_heads_under_sp():
     # forward auto-build a default sp=1 mesh and silently bypass Ulysses
     groups.reset_mesh()
     dist.destroy_process_group()
+
+
+def test_invalid_gqa_head_ratio_fails_loudly():
+    """6 q heads over 4 kv heads has no whole q-group per kv head; the old
+    clip-mode take silently attended the surplus q heads to the LAST kv
+    head (ADVICE.md) — now it raises at trace time."""
+    attn = DistributedAttention(_default_attention)
+    q, k, v = _qkv(H=6, kv_heads=4)
+    with pytest.raises(ValueError, match="GQA"):
+        attn._align_gqa_local(q, k, v)
+    with pytest.raises(ValueError, match="GQA"):
+        DistributedAttention._check_gqa_heads(6, 4)
+    DistributedAttention._check_gqa_heads(8, 4)   # whole groups: fine

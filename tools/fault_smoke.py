@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Fault-injection smoke test: kill a checkpoint save mid-write, prove
+resume from the previous valid tag.
+
+What it does (tiny MLP, CPU devices, ~30s):
+
+1. spawns a worker that trains 2 steps, commits tag ``stepA`` (manifest +
+   ``latest``), trains 2 more, then starts saving ``stepB`` with
+   ``DS_TPU_FAULT_INJECT=kill_save_mid_write:after=1`` armed — the process
+   dies (``os._exit(17)``) between tree writes, exactly like a preempted
+   host: ``stepB`` is a partial tag with no manifest;
+2. verifies the wreckage looks like a real crash (partial dir, no manifest,
+   ``latest`` still naming ``stepA``);
+3. resumes in a fresh process: ``load_checkpoint`` must verify ``stepA``'s
+   manifest and restore step counter 2, never touching the partial bytes.
+
+Run:  python tools/fault_smoke.py
+Exit: 0 on PASS, 1 on any deviation.
+
+See docs/resilience.md for the full fault-injection vocabulary.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HIDDEN = 8
+
+
+def _child_env(ckpt_dir, fault=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_SMOKE_CKPT"] = ckpt_dir
+    env.pop("DS_TPU_FAULT_INJECT", None)
+    if fault:
+        env["DS_TPU_FAULT_INJECT"] = fault
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def worker():
+    """Train → commit stepA → train → save stepB (killed mid-write when
+    the parent armed the fault)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu
+
+    rng = np.random.default_rng(0)
+    params = {"w": rng.standard_normal((HIDDEN, HIDDEN)).astype("float32"),
+              "b": np.zeros((HIDDEN,), "float32")}
+
+    def apply_fn(p, x, y):
+        import jax.numpy as jnp
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adam", "params": {"lr": 0.05}},
+                "resilience": {"checkpoint_integrity": {
+                    "save_retries": 0}}})
+    xs = rng.standard_normal((4 * engine.dp_world_size, HIDDEN)
+                             ).astype("float32")
+    ys = (xs * 0.5).astype("float32")
+
+    ckpt = os.environ["DS_SMOKE_CKPT"]
+    for _ in range(2):
+        loss = engine(xs, ys)
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(ckpt, tag="stepA")
+    for _ in range(2):
+        loss = engine(xs, ys)
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(ckpt, tag="stepB")   # dies here when armed
+    print("worker: stepB committed (fault NOT armed)")
+
+
+def resume_check():
+    """Fresh process: resume must land on stepA at step 2."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu
+
+    rng = np.random.default_rng(1)
+    params = {"w": rng.standard_normal((HIDDEN, HIDDEN)).astype("float32"),
+              "b": np.zeros((HIDDEN,), "float32")}
+
+    def apply_fn(p, x, y):
+        import jax.numpy as jnp
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adam", "params": {"lr": 0.05}}})
+    root, _ = engine.load_checkpoint(os.environ["DS_SMOKE_CKPT"])
+    assert root is not None and root.endswith("stepA"), \
+        f"resumed from {root!r}, expected the stepA tag"
+    assert engine.global_steps == 2, engine.global_steps
+    print(f"resume: OK root={root} global_steps={engine.global_steps}")
+
+
+def main():
+    import tempfile
+    sys.path.insert(0, REPO)
+    from deepspeed_tpu.utils.fault_injection import KILLED_EXIT_CODE
+
+    ckpt = tempfile.mkdtemp(prefix="ds_fault_smoke_")
+    me = os.path.abspath(__file__)
+
+    print("== phase 1: train + kill save mid-write ==")
+    rc = subprocess.call(
+        [sys.executable, me, "--role=worker"],
+        env=_child_env(ckpt, fault="kill_save_mid_write:tag=stepB"))
+    assert rc == KILLED_EXIT_CODE, \
+        f"worker exited {rc}, expected injected death {KILLED_EXIT_CODE}"
+
+    print("== phase 2: verify the wreckage ==")
+    assert os.path.isdir(os.path.join(ckpt, "stepB")), "no partial tag?"
+    assert not os.path.exists(os.path.join(ckpt, "stepB", "manifest.json")), \
+        "partial tag has a manifest — the kill fired too late"
+    with open(os.path.join(ckpt, "latest")) as f:
+        assert f.read().strip() == "stepA", "latest advanced past the crash"
+    print(f"   partial stepB present, no manifest, latest=stepA  ({ckpt})")
+
+    print("== phase 3: resume from the previous valid tag ==")
+    rc = subprocess.call([sys.executable, me, "--role=resume"],
+                         env=_child_env(ckpt))
+    assert rc == 0, f"resume check failed (rc={rc})"
+    print("PASS: mid-write death rolled back to the last valid checkpoint")
+
+
+if __name__ == "__main__":
+    role = next((a.split("=", 1)[1] for a in sys.argv[1:]
+                 if a.startswith("--role=")), "main")
+    if role == "worker":
+        sys.path.insert(0, REPO)
+        worker()
+    elif role == "resume":
+        sys.path.insert(0, REPO)
+        resume_check()
+    else:
+        try:
+            main()
+        except AssertionError as e:
+            print(f"FAIL: {e}")
+            sys.exit(1)
