@@ -4,36 +4,89 @@ Reference: ``bin/ds_bench`` forwards to the DeepSpeedExamples communication
 suite (all_reduce/all_gather/all_to_all/pt2pt sweeps printing algbw/busbw
 per size, nccl-tests conventions).  Here the sweep runs in-process over the
 mesh's collectives (psum / all_gather / all_to_all / ppermute on a chosen
-axis), with the same bandwidth accounting as ``utils/comms_logging.get_bw``.
+axis), with the same bandwidth accounting as ``utils/comms_logging.get_bw``
+— plus the collectives-engine variants (hierarchical all-reduce, quantized
+all-gather/reduce-scatter, 2-hop hierarchical-quantized reduce-scatter)
+so the comm trajectory of ``comm_optimizations`` configs is measurable.
 
     ds_bench                       # sweep all ops over the dp axis
-    ds_bench --op all_reduce --axis dp --maxsize 28
+    ds_bench --op quant_all_gather --axis dp --maxsize 28
     ds_bench --mesh dp=4,tp=2      # explicit mesh factorization
+    ds_bench --json out.json       # machine-readable rows (BENCH_*.json food)
 
-Prints one table row per (op, size): latency, algbw, busbw.
+Prints one table row per (op, size): logical bytes, wire bytes (what the
+bottleneck link actually carries — post-quantization payload + scales),
+latency, algbw, busbw.  Bandwidths are computed from WIRE bytes.
 """
 
 import argparse
+import json
 import time
 
 import numpy as np
 
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all", "pt2pt")
+# collectives-engine variants (comm/collectives/): hierarchy + quantization
+ENGINE_OPS = ("hier_all_reduce", "quant_all_gather", "quant_reduce_scatter",
+              "hier_quant_reduce_scatter")
+ALL_OPS = OPS + ENGINE_OPS
+
+WIRE_FORMAT = "int8"
+GROUP_SIZE = 2048
 
 
-def _bench_one(op, axis, nbytes, mesh, iters, warmup):
+class UnsplittableAxis(ValueError):
+    """The axis has no non-trivial (outer, inner) factorization — hier_*
+    ops are skipped for it, every other error still fails the bench."""
+
+
+def _hier(mesh, axis, intra):
+    """(smesh, outer_axis, inner_axis, n_out, n_in) for the hier ops: the
+    topology layer's split when it can see one, else an even power-of-two
+    split so the hierarchical schedule is still measurable on flat/virtual
+    meshes (the virtual CPU mesh has no physical topology)."""
+    from ..comm.backend import ProcessGroup
+    from ..comm.collectives.topology import factor_group
+    g = ProcessGroup(mesh, (axis, ))
+    h = factor_group(g, intra_node_size=intra)
+    if h is not None and len(h.inner_axes) == 1 and len(h.outer_axes) == 1:
+        return (h.mesh, h.outer_axes[0], h.inner_axes[0], h.outer_size,
+                h.inner_size)
+    n = mesh.shape[axis]
+    inner = 1
+    while inner * inner < n and n % (inner * 2) == 0:
+        inner *= 2
+    if inner <= 1 or inner >= n:
+        # a 1-sized factor on either side is not a hierarchy — measuring it
+        # as one would report bogus hier_* rows (e.g. axis size 2)
+        raise UnsplittableAxis(
+            f"axis {axis!r} (size {n}) has no non-trivial split for "
+            "hierarchical ops — pass --intra or use an axis of size ≥ 4")
+    from ..comm.collectives.topology import split_mesh
+    return (split_mesh(mesh, axis, inner), axis + "_out", axis + "_in",
+            n // inner, inner)
+
+
+def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+    from ..comm.collectives import quantized as Q
 
     n = mesh.shape[axis]
     elems = max(n, nbytes // 4 // n * n)  # fp32, divisible by axis size
     x = jnp.arange(elems, dtype=jnp.float32)
+    size_bytes = elems * 4
+    wire_bytes = size_bytes
+    bw_op = op
 
-    def make(fn):
-        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(axis),
-                                     out_specs=P(axis), check_vma=False))
+    def make(fn, m=mesh, in_spec=None, out_spec=None):
+        return jax.jit(jax.shard_map(
+            fn, mesh=m,
+            in_specs=P(axis) if in_spec is None else in_spec,
+            out_specs=P(axis) if out_spec is None else out_spec,
+            check_vma=False))
 
     if op == "all_reduce":
         f = make(lambda t: jax.lax.psum(t, axis) / n)
@@ -50,6 +103,43 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup):
     elif op == "pt2pt":
         perm = [(i, (i + 1) % n) for i in range(n)]
         f = make(lambda t: jax.lax.ppermute(t, axis, perm))
+        bw_op = "send"
+    elif op == "hier_all_reduce":
+        from ..comm.collectives.engine import _jit_hier_all_reduce
+        from ..comm.reduce_op import ReduceOp
+        smesh, out_ax, in_ax, n_out, n_in = _hier(mesh, axis, intra)
+        # pad the per-rank block to n_in divisibility via elems choice: elems
+        # is divisible by n; require further by n*n_in
+        elems = max(n * n_in, elems // (n * n_in) * (n * n_in))
+        x = jnp.arange(elems, dtype=jnp.float32)
+        size_bytes = elems * 4
+        wire_bytes = size_bytes // n_in  # fp payload crossing DCN
+        # measure the exact kernel the engine ships, not a re-derivation
+        f = _jit_hier_all_reduce(smesh, (in_ax, ), (out_ax, ),
+                                 ReduceOp.AVG, n)
+        bw_op = "all_reduce"
+    elif op == "quant_all_gather":
+        f = make(lambda t: Q.quantized_all_gather(
+            t, (axis, ), 0, WIRE_FORMAT, GROUP_SIZE).reshape(-1)[:t.shape[0]],
+            out_spec=P())
+        wire_bytes = Q.quantized_wire_bytes(elems, WIRE_FORMAT, GROUP_SIZE)
+        bw_op = "all_gather"
+    elif op == "quant_reduce_scatter":
+        f = make(lambda t: Q.all_to_all_quant_reduce(
+            t, (axis, ), 0, n, wire_format=WIRE_FORMAT,
+            group_size=GROUP_SIZE), in_spec=P(), out_spec=P(axis))
+        wire_bytes = Q.quantized_wire_bytes(elems, WIRE_FORMAT, GROUP_SIZE)
+        bw_op = "reduce_scatter"
+    elif op == "hier_quant_reduce_scatter":
+        smesh, out_ax, in_ax, n_out, n_in = _hier(mesh, axis, intra)
+        f = make(lambda t: Q.hierarchical_quant_reduce_scatter(
+            t, (in_ax, ), (out_ax, ), 0, n_in, n_out,
+            wire_format=WIRE_FORMAT, group_size=GROUP_SIZE),
+            m=smesh, in_spec=P(), out_spec=P((in_ax, out_ax)))
+        # quantized payload crossing DCN on 1/n_in of the data
+        wire_bytes = Q.quantized_wire_bytes(elems // n_in, WIRE_FORMAT,
+                                            GROUP_SIZE)
+        bw_op = "reduce_scatter"
     else:
         raise ValueError(op)
 
@@ -63,16 +153,15 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup):
     lat = (time.perf_counter() - t0) / iters
 
     from ..utils.comms_logging import calc_bw_log
-    size_bytes = elems * 4
-    algbw, busbw = calc_bw_log(op if op != "pt2pt" else "send", size_bytes,
-                               lat, n)
-    return size_bytes, lat, algbw, busbw
+    algbw, busbw = calc_bw_log(bw_op, wire_bytes, lat, n)
+    return size_bytes, wire_bytes, lat, algbw, busbw
 
 
-def run(ops=OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
-        iters=20, warmup=3, print_fn=print):
+def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
+        iters=20, warmup=3, print_fn=print, intra=0, json_path=None):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
-    (op, bytes, latency_s, algbw_gbps, busbw_gbps)."""
+    (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps); with
+    ``json_path``, also writes them as machine-readable JSON."""
     from ..utils import groups
     if mesh_spec:
         kw = {}
@@ -87,24 +176,47 @@ def run(ops=OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             f"axis {axis!r} has size {mesh.shape.get(axis, 1)} on mesh "
             f"{dict(mesh.shape)} — nothing to benchmark (pass --mesh)")
     rows = []
-    print_fn(f"# mesh={dict(mesh.shape)} axis={axis} dtype=fp32")
-    print_fn(f"{'op':<16}{'bytes':>12}{'latency_us':>14}"
+    print_fn(f"# mesh={dict(mesh.shape)} axis={axis} dtype=fp32 "
+             f"wire={WIRE_FORMAT}")
+    print_fn(f"{'op':<28}{'bytes':>12}{'wire_bytes':>12}{'latency_us':>14}"
              f"{'algbw_Gbps':>12}{'busbw_Gbps':>12}")
     for op in ops:
         for p in range(minsize, maxsize + 1, 2):
-            size, lat, algbw, busbw = _bench_one(
-                op, axis, 1 << p, mesh, iters, warmup)
-            rows.append((op, size, lat, algbw, busbw))
-            print_fn(f"{op:<16}{size:>12}{lat * 1e6:>14.1f}"
+            try:
+                size, wire, lat, algbw, busbw = _bench_one(
+                    op, axis, 1 << p, mesh, iters, warmup, intra=intra)
+            except UnsplittableAxis as e:
+                # hier_* on an unsplittable axis: note and keep sweeping the
+                # other ops (any other error still fails the bench loudly)
+                print_fn(f"# {op}: skipped ({e})")
+                break
+            rows.append((op, size, wire, lat, algbw, busbw))
+            print_fn(f"{op:<28}{size:>12}{wire:>12}{lat * 1e6:>14.1f}"
                      f"{algbw:>12.2f}{busbw:>12.2f}")
+    if json_path:
+        payload = {
+            "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+            "axis": axis,
+            "dtype": "fp32",
+            "wire_format": WIRE_FORMAT,
+            "quantization_group_size": GROUP_SIZE,
+            "rows": [{"op": op, "bytes": int(size), "wire_bytes": int(wire),
+                      "latency_us": lat * 1e6, "algbw_gbps": algbw,
+                      "busbw_gbps": busbw}
+                     for op, size, wire, lat, algbw, busbw in rows],
+        }
+        with open(json_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+        print_fn(f"# wrote {len(rows)} rows to {json_path}")
     return rows
 
 
 def cli_main(argv=None):
     ap = argparse.ArgumentParser(
         prog="ds_bench", description="collective micro-benchmarks over the "
-        "device mesh (reference bin/ds_bench)")
-    ap.add_argument("--op", choices=OPS, default=None,
+        "device mesh (reference bin/ds_bench), incl. hierarchical/quantized "
+        "engine variants")
+    ap.add_argument("--op", choices=ALL_OPS, default=None,
                     help="single op (default: all)")
     ap.add_argument("--axis", default="dp")
     ap.add_argument("--mesh", default=None,
@@ -115,10 +227,16 @@ def cli_main(argv=None):
                     help="log2 of largest message (default 26 = 64MiB)")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--intra", type=int, default=0,
+                    help="intra-node size for hier_* ops (0 = topology "
+                    "auto-detect, falling back to an even split)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write machine-readable rows to PATH")
     args = ap.parse_args(argv)
-    run(ops=(args.op, ) if args.op else OPS, axis=args.axis,
+    run(ops=(args.op, ) if args.op else ALL_OPS, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
-        iters=args.iters, warmup=args.warmup)
+        iters=args.iters, warmup=args.warmup, intra=args.intra,
+        json_path=args.json)
 
 
 if __name__ == "__main__":

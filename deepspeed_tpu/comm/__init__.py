@@ -12,7 +12,8 @@ from .comm import (all_gather, all_gather_coalesced, all_gather_into_tensor,
                    is_initialized, isend, log_summary, monitored_barrier,
                    new_group, recv, recv_obj, reduce, reduce_scatter,
                    reduce_scatter_fn, reduce_scatter_tensor, scatter, send,
-                   send_obj)
+                   send_obj, set_collectives_engine, get_collectives_engine)
 from .backend import MeshBackend, ProcessGroup
 from .reduce_op import ReduceOp
 from . import functional
+from . import collectives

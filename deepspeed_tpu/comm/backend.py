@@ -40,6 +40,19 @@ import functools
 
 @functools.lru_cache(maxsize=None)
 def _jit_all_reduce(mesh, axes, op, group_size):
+    if op == ReduceOp.PRODUCT:
+        # no pprod primitive in lax — gather the per-rank contributions and
+        # reduce locally (elementwise product across ranks)
+
+        def _k(blk):
+            out = blk
+            for a in axes:
+                out = jnp.prod(
+                    jax.lax.all_gather(out, a, axis=0, tiled=False), axis=0)
+            return out
+
+        return jax.jit(jax.shard_map(_k, mesh=mesh, check_vma=False,
+                                     in_specs=(P(axes), ), out_specs=P()))
     red = _REDUCE_FNS.get(ReduceOp.SUM if op == ReduceOp.AVG else op)
     if red is None:
         raise ValueError(f"unsupported reduce op {op}")

@@ -1,0 +1,21 @@
+"""Topology-aware quantized collectives engine (see docs/collectives.md).
+
+Layers:
+
+* :mod:`.topology` — factorize mesh-axis groups into (inter-node,
+  intra-node) hierarchies;
+* :mod:`.quantized` — inside-shard_map quantized/hierarchical primitives
+  shared with the ZeRO++ runtime paths;
+* :mod:`.engine` — per-op variant selection behind the ``dist.*`` facade;
+* :mod:`.config` — runtime-independent ``comm_optimizations`` options.
+"""
+
+from .config import CommOptimizations
+from .engine import CollectivesEngine, clear_jit_caches
+from .quantized import (DEFAULT_GROUP_SIZE, WIRE_FORMATS,
+                        all_to_all_quant_reduce, effective_group_size,
+                        hierarchical_quant_reduce_scatter,
+                        quantized_all_gather, quantized_wire_bytes,
+                        wire_codec)
+from .topology import (Hierarchy, axis_intra_size, detect_intra_node_size,
+                       factor_group, split_mesh)

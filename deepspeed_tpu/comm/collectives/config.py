@@ -1,0 +1,36 @@
+"""Runtime-independent view of the ``comm_optimizations`` config block.
+
+The JSON-schema'd pydantic model lives with the rest of the config system
+(``runtime/config.py:CommOptimizationsConfig``); this dataclass carries the
+same fields with the same defaults for standalone consumers (benchmarks,
+tests, tools) that must not drag the full runtime config machinery in.  The
+engine itself is duck-typed — either object works.
+"""
+
+from dataclasses import dataclass
+
+from .quantized import DEFAULT_GROUP_SIZE
+
+
+@dataclass
+class CommOptimizations:
+    """See docs/collectives.md for the knob-by-knob story."""
+    enabled: bool = False
+    # hierarchical (intra-node → inter-node → intra-node) all-reduce and the
+    # 2-hop quantized reduce-scatter; engages only when a topology hierarchy
+    # exists (multi-axis group, TPU slice boundary, or intra_node_size)
+    hierarchical_allreduce: bool = True
+    # quantize all-gather payloads (ZeRO++ qwZ-style wire compression)
+    quantized_weights: bool = False
+    # quantize reduce-scatter payloads (ZeRO++ qgZ-style)
+    quantized_gradients: bool = False
+    # wire format for quantized payloads: int8 | int4 | fp8 | fp6 | fp12
+    wire_dtype: str = "int8"
+    # elements per quantization scale group (lane-aligned down to ≥128)
+    quantization_group_size: int = DEFAULT_GROUP_SIZE
+    # devices per node for the hierarchy split; 0 = auto-detect from device
+    # metadata (slice/process boundaries) or DS_TPU_INTRA_NODE_SIZE
+    intra_node_size: int = 0
+    # tensors smaller than this many bytes always take the flat path
+    # (latency-bound regime — quantize/hierarchy overhead beats the savings)
+    min_message_size: int = 0

@@ -1,0 +1,163 @@
+"""Quantized collective primitives — the wire-compression layer.
+
+Canonical home of the quantized collectives that ZeRO++ (qwZ/qgZ,
+arxiv 2306.10209) and EQuARX (arxiv 2506.17615) describe: block-wise
+quantize the payload, move int8/fp8 + per-group f32 scales instead of
+bf16/f32, dequantize on arrival.  ``runtime/zero/zeropp.py`` re-exports
+these for the manual-SPMD ZeRO paths; the eager
+:class:`~deepspeed_tpu.comm.collectives.engine.CollectivesEngine` wraps them
+in shard_map for the ``dist.*`` facade; ``benchmarks/comm_bench.py`` sweeps
+them.
+
+All functions here are **inside-shard_map** primitives: they take axis
+names, operate on the local tile, and compose with
+:mod:`deepspeed_tpu.comm.collectives.topology` hierarchies.  Codecs ride
+``ops/pallas/quantizer.py`` (int) and ``ops/fp_quantizer.py`` (fp) — one
+quantization kernel family for inference, ZeRO++ and the wire.
+"""
+
+from functools import partial
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
+
+DEFAULT_GROUP_SIZE = 2048
+_LANES = 128  # scale-group granularity of the blockwise kernels
+
+# wire formats: name → (quantize, dequantize) closures.  "int8"/"int4" ride
+# the blockwise integer kernels; "fp8"/"fp6"/"fp12" the FP quantizer
+# (reference csrc/fp_quantizer — fp6 packs 4 values → 3 bytes, so the
+# all-gather volume drops to 3/8 of bf16).
+_FP_FORMATS = {"fp8": (8, 3), "fp6": (6, 2), "fp12": (12, 7)}
+
+# transported bytes per element for each wire format (int4 occupies int8
+# storage on the simulated path — reported honestly, not as 0.5)
+PAYLOAD_BYTES = {"int8": 1.0, "int4": 1.0, "fp8": 1.0, "fp6": 0.75,
+                 "fp12": 1.5}
+
+WIRE_FORMATS = tuple(PAYLOAD_BYTES)
+
+
+def wire_codec(wire_format, group_size):
+    """Wire format name → (quantize, dequantize) closure pair."""
+    if wire_format in ("int8", "int4"):
+        bits = 8 if wire_format == "int8" else 4
+        quant = lambda x: quantize_blockwise(x, num_bits=bits,
+                                             group_size=group_size,
+                                             use_pallas=False)
+        dequant = lambda q, s, m: dequantize_blockwise(q, s, m,
+                                                       use_pallas=False)
+        return quant, dequant
+    if wire_format in _FP_FORMATS:
+        from ...ops.fp_quantizer import dequantize_fp, quantize_fp
+        bits, man = _FP_FORMATS[wire_format]
+        quant = lambda x: quantize_fp(x, q_bits=bits, mantissa_bits=man,
+                                      group_size=group_size, use_pallas=False)
+        return quant, dequantize_fp
+    raise ValueError(f"unknown wire format {wire_format!r} "
+                     f"(have {', '.join(WIRE_FORMATS)})")
+
+
+def effective_group_size(group_size):
+    """The scale-group size the kernels actually use (lane-aligned, ≥128)."""
+    return max(_LANES, group_size - group_size % _LANES)
+
+
+def quantized_wire_bytes(n_elements, wire_format, group_size):
+    """Actual transported bytes for a quantized payload of ``n_elements``:
+    quantized values + one f32 scale per (lane-aligned) group.  This is what
+    the comms logger / ds_bench report as wire size — NOT the logical fp
+    tensor size."""
+    gs = effective_group_size(group_size)
+    groups = -(-n_elements // gs)
+    return int(math.ceil(n_elements * PAYLOAD_BYTES[wire_format])) + groups * 4
+
+
+def quantized_all_gather(x, ax_names, dim, wire_format="int8",
+                         group_size=DEFAULT_GROUP_SIZE):
+    """Inside-shard_map: quantize-gather the local tile along mesh axes
+    ``ax_names``, reassembling the full dim in axis-index order (matches GSPMD
+    tiling order).  The wire payload is quantized values + one f32 scale per
+    ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu;
+    fp formats via csrc/fp_quantizer analog)."""
+    quant, dequant = wire_codec(wire_format, group_size)
+    q, s, meta = quant(x)
+    qg = jax.lax.all_gather(q, ax_names)
+    sg = jax.lax.all_gather(s, ax_names)
+    parts = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
+    return jnp.concatenate(list(parts), axis=dim)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def qdq_all_gather_st(x, ax_names, dim, wire_format, group_size):
+    """Straight-through quantized gather: forward is the quantized gather;
+    backward is the exact VJP of a plain all-gather (reduce-scatter of the
+    cotangent) — the quantization rounding must not zero the gradient."""
+    return quantized_all_gather(x, ax_names, dim, wire_format, group_size)
+
+
+def _qdq_fwd(x, ax_names, dim, wire_format, group_size):
+    return qdq_all_gather_st(x, ax_names, dim, wire_format, group_size), None
+
+
+def _qdq_bwd(ax_names, dim, wire_format, group_size, _, dy):
+    return (jax.lax.psum_scatter(dy, ax_names, scatter_dimension=dim,
+                                 tiled=True), )
+
+
+qdq_all_gather_st.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def all_to_all_quant_reduce(g, ax_names, dim, n, num_bits=8,
+                            group_size=DEFAULT_GROUP_SIZE, wire_format=None,
+                            mean=True):
+    """Inside-shard_map: quantized reduce-scatter of a (replicated) gradient:
+    split along ``dim`` into ``n`` partitions, quantized all-to-all so rank i
+    receives every rank's partition i, dequantize and reduce in fp32.
+    Returns this rank's partition — the mean over ranks by default, the sum
+    with ``mean=False`` (reference ``all_to_all_quant_reduce``,
+    runtime/comm/coalesced_collectives.py:31 — single-hop on ICI, see
+    ``runtime/zero/zeropp.py`` module docstring)."""
+    fmt = wire_format or ("int8" if num_bits == 8 else "int4")
+    quant, dequant = wire_codec(fmt, group_size)
+    chunks = jnp.stack(jnp.split(g, n, axis=dim))  # [n, ...chunk]
+    _, _, meta = quant(chunks[0])
+    # dequantize straight to f32 so accumulation never round-trips through a
+    # narrow source dtype
+    meta = (meta[0], jnp.float32) + tuple(meta[2:])
+    q, s = jax.vmap(lambda c: quant(c)[:2])(chunks)
+    qx = jax.lax.all_to_all(q, ax_names, split_axis=0, concat_axis=0)
+    sx = jax.lax.all_to_all(s, ax_names, split_axis=0, concat_axis=0)
+    parts = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qx, sx)
+    out = jnp.sum(parts.astype(jnp.float32), axis=0)
+    return out / n if mean else out
+
+
+def hierarchical_quant_reduce_scatter(g, inner_axes, outer_axes, dim,
+                                      n_inner, n_outer, wire_format="int8",
+                                      group_size=DEFAULT_GROUP_SIZE,
+                                      mean=True):
+    """Inside-shard_map 2-hop qgZ: full-precision reduce-scatter over the
+    intra-node ``inner_axes`` (ICI — cheap, full data), then quantized
+    all-to-all reduce over the inter-node ``outer_axes`` on 1/n_inner of the
+    data (DCN — one quantization error on the slow hop only; reference qgZ,
+    ZeRO++ §4.3, minus the NCCL swizzle which mesh axes make unnecessary).
+
+    Tiling order of the result along ``dim`` is **inner-major**: rank
+    (outer=o, inner=i) holds chunk ``i * n_outer + o`` — callers declaring
+    shard_map out_specs must list ``inner_axes + outer_axes`` on that dim.
+    """
+    part = g
+    for a in inner_axes:
+        part = jax.lax.psum_scatter(part, a, scatter_dimension=dim,
+                                    tiled=True)
+    out = all_to_all_quant_reduce(part, outer_axes, dim, n_outer,
+                                  wire_format=wire_format,
+                                  group_size=group_size, mean=False)
+    if mean:
+        # psum_scatter already summed over inner, the a2a over outer
+        out = out / (n_inner * n_outer)
+    return out

@@ -1,0 +1,174 @@
+"""Mesh topology factorization — the hierarchy layer of the collectives engine.
+
+A collective group in this codebase is a tuple of mesh axis names
+(:class:`deepspeed_tpu.comm.backend.ProcessGroup`).  When such a group spans
+both fast intra-node links (ICI) and a slow inter-node fabric (DCN), a flat
+single-hop collective pays the DCN price on the FULL payload.  The classic
+fix (ZeRO++ hpZ/qgZ, EQuARX, NCCL trees) is hierarchical execution:
+
+    intra-node reduce-scatter  →  inter-node op on 1/N of the data
+                               →  intra-node all-gather
+
+This module answers the one question that scheme needs: *how does a group's
+axis factorize into (inter-node, intra-node) sub-axes?*  Two shapes exist:
+
+* **multi-axis groups** (``("dp", "ep")``, hpZ's ``("zp_outer", "zp")``):
+  mesh axis order is major→minor, and the mesh builders
+  (``utils/groups.py:_physical_device_grid``) put the DCN/slice factor on the
+  outermost axis — so the group's own axes already ARE the hierarchy:
+  first effective axis = inter, the rest = intra.
+* **single-axis groups** (``("dp", )`` over a multi-host pod): the axis is
+  split into ``(axis + "_out", axis + "_in")`` on a *reshaped* mesh (same
+  device order, so ``_in`` spans the physically-adjacent chips — exactly the
+  hpZ-mesh construction in ``utils/groups.py:initialize_mesh``).  The split
+  point comes from the device metadata (slice / process boundaries) or an
+  explicit override (``intra_node_size`` config / ``DS_TPU_INTRA_NODE_SIZE``
+  env) — the override is also what makes hierarchy testable on the virtual
+  CPU mesh, which has no physical topology.
+"""
+
+import functools
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class Hierarchy:
+    """A group factored into (inter-node, intra-node) mesh axes.
+
+    ``mesh`` is the mesh the hierarchical collective must shard_map over —
+    the group's own mesh for multi-axis groups, a reshaped one for a split
+    single axis.  ``outer_axes`` ride DCN, ``inner_axes`` ride ICI.
+    """
+    mesh: Mesh
+    outer_axes: tuple
+    inner_axes: tuple
+    outer_size: int
+    inner_size: int
+
+    @property
+    def size(self):
+        return self.outer_size * self.inner_size
+
+    @property
+    def group_axes(self):
+        """Axis tuple tiling the group's dim, major→minor (= device order of
+        the original flat group axis)."""
+        return self.outer_axes + self.inner_axes
+
+
+def _node_key(device):
+    """Physical-locality key: devices sharing it are 'one node' (cheap
+    links).  Multi-slice TPU pods expose ``slice_index`` (DCN crosses
+    slices); otherwise the host process is the node."""
+    s = getattr(device, "slice_index", None)
+    if s is not None:
+        return ("slice", s)
+    return ("process", getattr(device, "process_index", 0))
+
+
+def axis_intra_size(mesh, axis):
+    """How many consecutive devices along ``axis`` share a node, measured at
+    the origin of all other axes.  Returns 0 when the axis never leaves the
+    node (no hierarchy to exploit) or the run length does not divide the
+    axis size (irregular placement — refuse to guess)."""
+    devs = np.asarray(mesh.devices)
+    i = mesh.axis_names.index(axis)
+    idx = [0] * devs.ndim
+    idx[i] = slice(None)
+    line = list(devs[tuple(idx)].flat)
+    n = len(line)
+    first = _node_key(line[0])
+    run = 1
+    while run < n and _node_key(line[run]) == first:
+        run += 1
+    if run >= n or n % run != 0:
+        return 0
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def split_mesh(mesh, axis, inner):
+    """Reshape ``axis`` (size n) into ``(axis_out, axis_in)`` = (n/inner,
+    inner), device order preserved: ``_in`` is the fastest-varying (physically
+    nearest) factor.  Cached — shard_map'd jits key on Mesh identity."""
+    names = mesh.axis_names
+    devs = np.asarray(mesh.devices)
+    i = names.index(axis)
+    n = devs.shape[i]
+    if inner <= 1 or n % inner != 0:
+        raise ValueError(f"cannot split axis {axis!r} of size {n} with "
+                         f"inner factor {inner}")
+    shape = devs.shape[:i] + (n // inner, inner) + devs.shape[i + 1:]
+    new_names = names[:i] + (axis + "_out", axis + "_in") + names[i + 1:]
+    return Mesh(devs.reshape(shape), new_names)
+
+
+def detect_intra_node_size(mesh, axis, override=0):
+    """Resolve the intra-node run length for ``axis``: explicit override >
+    ``DS_TPU_INTRA_NODE_SIZE`` env > device-metadata probe.  0 = no usable
+    hierarchy."""
+    if override and override > 1:
+        return override
+    env = os.environ.get("DS_TPU_INTRA_NODE_SIZE")
+    if env:
+        try:
+            val = int(env)
+        except ValueError:
+            raise ValueError(
+                f"DS_TPU_INTRA_NODE_SIZE={env!r} is not an integer — set "
+                "it to the devices-per-node count (e.g. 4), or unset it "
+                "for auto-detection") from None
+        if val < 0:
+            raise ValueError(
+                f"DS_TPU_INTRA_NODE_SIZE={env!r} must be non-negative "
+                "(0 = auto-detect)")
+        return val
+    return axis_intra_size(mesh, axis)
+
+
+def factor_group(group, intra_node_size=0):
+    """Factor a ProcessGroup into a :class:`Hierarchy`, or None when there is
+    nothing to factor (single node, size-1 group, indivisible split).
+    Memoized per (mesh, axes, override) — this sits on the dispatch path of
+    every engine collective, and the detection walks device metadata."""
+    return _factor_cached(group.mesh, group.effective_axes(),
+                          intra_node_size,
+                          os.environ.get("DS_TPU_INTRA_NODE_SIZE"))
+
+
+@functools.lru_cache(maxsize=None)
+def _factor_cached(mesh, eff, intra_node_size, _env):
+    # _env participates in the key only so an env-var change between calls
+    # is not masked by the memo
+    if not eff:
+        return None
+    if len(eff) >= 2:
+        outer, inner = eff[:1], eff[1:]
+        osz = mesh.shape[outer[0]]
+        isz = 1
+        for a in inner:
+            isz *= mesh.shape[a]
+        return Hierarchy(mesh=mesh, outer_axes=outer, inner_axes=inner,
+                         outer_size=osz, inner_size=isz)
+    axis = eff[0]
+    n = mesh.shape[axis]
+    s = detect_intra_node_size(mesh, axis, override=intra_node_size)
+    if s <= 1 or s >= n or n % s != 0:
+        return None
+    smesh = split_mesh(mesh, axis, s)
+    return Hierarchy(mesh=smesh, outer_axes=(axis + "_out", ),
+                     inner_axes=(axis + "_in", ), outer_size=n // s,
+                     inner_size=s)
+
+
+def clear_topology_caches():
+    """Drop memoized hierarchies/reshaped meshes so stale Mesh objects can
+    be collected (rides ``dist.destroy_process_group`` via
+    ``engine.clear_jit_caches``)."""
+    _factor_cached.cache_clear()
+    split_mesh.cache_clear()

@@ -27,6 +27,14 @@ comms_logger = CommsLogger()
 
 _COMM_CONFIGURED = False
 
+# Installed collectives engine (comm/collectives/) — None = every op takes
+# the flat backend path, bit-identical to the pre-engine facade.
+_engine = None
+# (variant, wire_bytes) of the most recent dispatched collective, consumed
+# by timed_op so the comms logger reports transported (post-quantization)
+# bytes and the variant name.
+_last_dispatch = None
+
 
 def is_initialized():
     return cdb is not None and cdb.initialized
@@ -37,11 +45,31 @@ def _assert_initialized():
         init_distributed()
 
 
+def set_collectives_engine(engine):
+    """Install (or with None, remove) the pluggable collectives engine that
+    ``_dispatch`` offers every eager collective to."""
+    global _engine
+    _engine = engine
+
+
+def get_collectives_engine():
+    return _engine
+
+
 def configure(config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None,
               debug=None):
-    """Configure comms logging (reference ``comm/comm.py`` configure)."""
+    """Configure comms logging + collectives engine (reference
+    ``comm/comm.py`` configure; the engine half is the TPU addition)."""
     if config is not None and getattr(config, "comms_config", None) is not None:
         comms_logger.configure(config.comms_config)
+    if config is not None and getattr(
+            config, "comm_optimizations_config", None) is not None:
+        co = config.comm_optimizations_config
+        if getattr(co, "enabled", False):
+            from .collectives import CollectivesEngine
+            set_collectives_engine(CollectivesEngine(co))
+        else:
+            set_collectives_engine(None)
     if enabled is not None:
         comms_logger.enabled = enabled
     if prof_all is not None:
@@ -60,11 +88,13 @@ def timed_op(func):
 
     @functools.wraps(func)
     def wrapper(*args, log_name=None, **kwargs):
+        global _last_dispatch
         name = log_name or func.__name__
         should_log = comms_logger.enabled and (
             comms_logger.prof_all or name in comms_logger.prof_ops)
         if not should_log:
             return func(*args, **kwargs)
+        _last_dispatch = None
         t0 = time.perf_counter()
         result = func(*args, **kwargs)
         if comms_logger.sync_timing:
@@ -81,7 +111,9 @@ def timed_op(func):
         msg_size = get_msg_size_from_args(x) if x is not None else 0
         group = bound.get("group")
         ws = group.size() if group is not None else (cdb.world_size() if cdb else 1)
-        comms_logger.append(func.__name__, name, latency, msg_size, ws)
+        variant, wire = _last_dispatch if _last_dispatch else (None, None)
+        comms_logger.append(func.__name__, name, latency, msg_size, ws,
+                            wire_size=wire, variant=variant)
         return result
 
     return wrapper
@@ -186,6 +218,11 @@ def init_distributed(dist_backend=None, auto_mpi_discovery=True,
     """
     global cdb
     if is_initialized():
+        # already up: still honor a (re)supplied config — otherwise a world
+        # initialized before deepspeed_tpu.initialize() would silently drop
+        # comms-logger settings and never install the collectives engine
+        if config is not None:
+            configure(config=config)
         return cdb
 
     ensure_runtime_initialized(auto_mpi_discovery=auto_mpi_discovery,
@@ -254,16 +291,40 @@ def get_local_rank():
 
 
 # ------------------------------------------------------------------ collectives
+def _dispatch(op_name, tensor, op=ReduceOp.SUM, group=None, axis=0):
+    """THE dispatch point: every eager collective (and the ``allgather_fn``/
+    ``reduce_scatter_fn``/``*_coalesced`` helpers riding the public ops) is
+    offered to the installed collectives engine first; None / no-hit falls
+    through to the flat MeshBackend path — bit-identical to the engine-less
+    facade."""
+    global _last_dispatch
+    eng = _engine
+    if eng is not None and eng.enabled:
+        g = group if group is not None else cdb.world_group
+        hit = eng.dispatch(op_name, tensor, g, reduce_op=op, axis=axis)
+        if hit is not None:
+            result, variant, wire = hit
+            _last_dispatch = (variant, wire)
+            return result
+    if op_name == "all_reduce":
+        return cdb.all_reduce(tensor, op=op, group=group)
+    if op_name == "all_gather":
+        return cdb.all_gather(tensor, group=group, axis=axis)
+    if op_name == "reduce_scatter":
+        return cdb.reduce_scatter(tensor, op=op, group=group, axis=axis)
+    raise ValueError(f"unknown collective {op_name!r}")
+
+
 @timed_op
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False):
     _assert_initialized()
-    return cdb.all_reduce(tensor, op=op, group=group)
+    return _dispatch("all_reduce", tensor, op=op, group=group)
 
 
 @timed_op
 def all_gather(tensor, group=None, axis=0, async_op=False):
     _assert_initialized()
-    return cdb.all_gather(tensor, group=group, axis=axis)
+    return _dispatch("all_gather", tensor, group=group, axis=axis)
 
 
 # torch.distributed-parity alias (reference has all_gather_into_tensor)
@@ -273,7 +334,7 @@ all_gather_into_tensor = all_gather
 @timed_op
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis=0, async_op=False):
     _assert_initialized()
-    return cdb.reduce_scatter(tensor, op=op, group=group, axis=axis)
+    return _dispatch("reduce_scatter", tensor, op=op, group=group, axis=axis)
 
 
 reduce_scatter_tensor = reduce_scatter
@@ -318,7 +379,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, async_op=False):
     """Reference ``reduce``: under SPMD the reduced value is computed
     everywhere (an all_reduce); ``dst`` has no special placement."""
     _assert_initialized()
-    return cdb.all_reduce(tensor, op=op, group=group)
+    return _dispatch("all_reduce", tensor, op=op, group=group)
 
 
 @timed_op
@@ -327,7 +388,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, axis=0,
     """Reference ``gather``: SPMD computes the gathered result everywhere
     (an all_gather); ``dst``/``gather_list`` have no special placement."""
     _assert_initialized()
-    return cdb.all_gather(tensor, group=group, axis=axis)
+    return _dispatch("all_gather", tensor, group=group, axis=axis)
 
 
 # reference inference_all_reduce: same collective, inference-tagged
@@ -336,7 +397,10 @@ inference_all_reduce = all_reduce
 
 def all_gather_coalesced(tensors, group=None, async_op=False):
     """Reference coalesced all-gather: one call per tensor (XLA already
-    fuses adjacent collectives under jit; eager coalescing buys nothing)."""
+    fuses adjacent collectives under jit; eager coalescing buys nothing).
+    Rides ``all_gather`` and therefore the engine dispatch point — a
+    coalesced list gets the same quantized/hierarchical variants per
+    tensor."""
     return [all_gather(t, group=group) for t in tensors]
 
 
@@ -347,9 +411,10 @@ def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group=None,
 
 def allgather_fn(output_tensor, input_tensor, group=None, async_op=False,
                  debug=None):
-    """Reference helper (picks the best all-gather impl): ours is always
-    ``all_gather``; the output-buffer arg has no meaning without torch's
-    in-place semantics."""
+    """Reference helper (picks the best all-gather impl): the pick happens
+    at the single ``_dispatch`` point inside ``all_gather`` — flat,
+    quantized, or hierarchical per the installed engine; the output-buffer
+    arg has no meaning without torch's in-place semantics."""
     return all_gather(input_tensor, group=group)
 
 
@@ -505,6 +570,7 @@ def log_summary(show_straggler=False):
 def destroy_process_group():
     global cdb
     cdb = None
+    set_collectives_engine(None)
     # Drop jitted-collective caches so stale Mesh objects and their XLA
     # executables can be garbage collected.
     from . import backend as _backend
@@ -512,3 +578,5 @@ def destroy_process_group():
                _backend._jit_reduce_scatter, _backend._jit_broadcast,
                _backend._jit_all_to_all):
         fn.cache_clear()
+    from .collectives import clear_jit_caches
+    clear_jit_caches()

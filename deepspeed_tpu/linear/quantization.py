@@ -21,7 +21,8 @@ class QuantizedParameter:
     """Host-side container: ``quantize`` once, ``dequantized()`` per use.
     2× (int8) / 2.7× (fp6) memory saving on frozen base weights."""
 
-    # canonical mantissa widths (must agree with zeropp._FP_FORMATS): fp8 =
+    # canonical mantissa widths (must agree with
+    # comm/collectives/quantized.py _FP_FORMATS): fp8 =
     # e4m3, fp6 = e3m2 (FP6-LLM), fp12 = e4m7.  The config's mantissa_bits
     # (default 3) applies to 8-bit; narrower formats use their canonical
     # layout or packed buffers would decode under the wrong bit split.

@@ -80,6 +80,33 @@ class CommsConfig(DeepSpeedConfigModel):
         return self.comms_logger.enabled
 
 
+class CommOptimizationsConfig(DeepSpeedConfigModel):
+    """``"comm_optimizations"`` section — the topology-aware quantized
+    collectives engine (``comm/collectives/``, docs/collectives.md).
+
+    Disabled (default) is bit-identical to the flat collectives.  Enabled,
+    the facade's eager collectives dispatch to hierarchical/quantized
+    variants, and the ZeRO gradient/param paths switch to quantized wire
+    traffic (qgZ/qwZ semantics) per the flags below."""
+    enabled: bool = False
+    # intra-node reduce-scatter → inter-node op on 1/N → intra-node
+    # all-gather; engages only when the group spans a topology hierarchy
+    hierarchical_allreduce: bool = True
+    # quantize param all-gather payloads (ZeRO++ qwZ analog)
+    quantized_weights: bool = False
+    # quantize gradient reduce-scatter payloads (ZeRO++ qgZ analog)
+    quantized_gradients: bool = False
+    # wire format: int8 | int4 | fp8 | fp6 | fp12
+    wire_dtype: str = "int8"
+    # elements per quantization scale group (lane-aligned down, min 128)
+    quantization_group_size: int = Field(2048, ge=128)
+    # devices per node for the hierarchy split; 0 = auto-detect from device
+    # metadata (TPU slice / process boundaries) or DS_TPU_INTRA_NODE_SIZE
+    intra_node_size: int = Field(0, ge=0)
+    # messages under this many bytes always take the flat path
+    min_message_size: int = Field(0, ge=0)
+
+
 class MonitorConfig(DeepSpeedConfigModel):
     """Reference ``monitor/config.py``: tensorboard/wandb/comet/csv."""
 
@@ -378,6 +405,14 @@ class DeepSpeedConfig:
         })
         self.comms_config = CommsConfig(**pd.get("comms_logger", {})
                                         and {"comms_logger": pd.get("comms_logger")})
+        self.comm_optimizations_config = CommOptimizationsConfig(
+            **pd.get("comm_optimizations", {}) or {})
+        from ..comm.collectives import WIRE_FORMATS
+        if self.comm_optimizations_config.wire_dtype not in WIRE_FORMATS:
+            raise DeepSpeedConfigError(
+                f"comm_optimizations.wire_dtype "
+                f"{self.comm_optimizations_config.wire_dtype!r} unknown "
+                f"(have {', '.join(WIRE_FORMATS)})")
         self.flops_profiler_config = FlopsProfilerConfig(
             **pd.get("flops_profiler", {}) or {})
         self.hybrid_engine = HybridEngineConfig(

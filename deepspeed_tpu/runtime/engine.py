@@ -294,7 +294,8 @@ class DeepSpeedEngine:
             # carry an hpz factoring this engine did not request
             hpz_mesh=(groups.get_mesh_state().hpz_mesh
                       if zp_size and zp_size > 1 else None),
-            mics=bool(zc.mics_shard_size and zc.mics_shard_size > 1))
+            mics=bool(zc.mics_shard_size and zc.mics_shard_size > 1),
+            comm_opts=config.comm_optimizations_config)
 
         # legacy curriculum learning (reference engine exposes a
         # CurriculumScheduler when "curriculum_learning" is configured)
@@ -1094,20 +1095,26 @@ class DeepSpeedEngine:
         apply_fn = self._effective_apply_fn()
         gas = self.gradient_accumulation_steps()
         zc = self._config.zero_config
-        if zc.zero_quantized_gradients:
+        co = self._config.comm_optimizations_config
+        co_on = getattr(co, "enabled", False)
+        if zc.zero_quantized_gradients or (co_on and co.quantized_gradients):
             # qgZ replaces the GSPMD gradient reduction with a quantized
-            # all-to-all reduce under manual SPMD (zeropp.py).
+            # all-to-all reduce under manual SPMD (zeropp.py) — reachable via
+            # the legacy ZeRO++ knob or the comm_optimizations block.
             from .zero.zeropp import build_manual_dp_micro
             return build_manual_dp_micro(self)
-        qw = zc.zero_quantized_weights and self.zero_stage >= 3
+        qw = (zc.zero_quantized_weights or
+              (co_on and co.quantized_weights)) and self.zero_stage >= 3
         if qw:
             # qwZ: int8 param all-gather (straight-through bwd)
             from .zero.zeropp import quantized_weight_gather
             inner = apply_fn
-            qw_fmt = zc.zero_quantized_weights_format
+            qw_fmt, qw_gs = self.plan.param_wire(
+                zc.zero_quantized_weights_format)
             apply_fn = lambda params, *inputs: inner(
                 quantized_weight_gather(params, self.plan,
-                                        wire_format=qw_fmt), *inputs)
+                                        wire_format=qw_fmt,
+                                        group_size=qw_gs), *inputs)
         dc = self._config.domino_config
         if dc.enabled:
             if self.progressive_layer_drop is not None:

@@ -102,6 +102,21 @@ def shard_spec(shape, mesh: Mesh, axes, min_size=1, base_spec=None):
     return P(*base)
 
 
+def zero_dim(spec, zero_axes):
+    """Locate the dim of a PartitionSpec carrying ZeRO axes.  Returns
+    ``(dim, axes_present)`` or ``(None, ())`` — the shared primitive behind
+    the qwZ/qgZ leaf walkers (``zeropp.py``) and the collectives engine's
+    per-leaf variant selection."""
+    for i, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry, )
+        present = tuple(a for a in names if a in zero_axes)
+        if present:
+            return i, present
+    return None, ()
+
+
 def path_str(kp):
     """jax key-path → 'a/b/c' string for rule matching."""
     parts = []
@@ -183,13 +198,16 @@ class ZeroPartitionPlan:
 
     def __init__(self, stage, mesh, zero_axes=("dp", ), min_partition_size=1,
                  offload_optimizer=False, offload_param=False, tp_rules=None,
-                 hpz_mesh=None, mics=False):
+                 hpz_mesh=None, mics=False, comm_opts=None):
         self.stage = stage
         self.mesh = mesh
         self.zero_axes = tuple(a for a in zero_axes if mesh.shape.get(a, 1) >= 1)
         self.min_partition_size = min_partition_size
         self.offload_optimizer = offload_optimizer
         self.offload_param = offload_param
+        # comm_optimizations config (duck-typed; see comm/collectives/) —
+        # steers the wire format of the quantized ZeRO hot paths
+        self.comm_opts = comm_opts
         # TP rules: path-suffix → PartitionSpec over the "tp" axis (AutoTP
         # analog, reference module_inject/auto_tp.py:273) — composed with the
         # ZeRO axes on every state tensor.
@@ -214,6 +232,37 @@ class ZeroPartitionPlan:
                 self.param_axes = self.state_axes = zp_axes
             elif stage >= 3:
                 self.param_mesh, self.param_axes = hpz_mesh, zp_axes
+
+    # wire formats ----------------------------------------------------------
+    # The quantized ZeRO hot paths (zeropp.py qwZ/qgZ) ask the plan what to
+    # put on the wire; ``comm_optimizations`` wins when it enabled the
+    # corresponding traffic class, else the ZeRO++ legacy knobs/defaults.
+    def _co_wire(self, flag):
+        co = self.comm_opts
+        if co is not None and getattr(co, "enabled", False) and \
+                getattr(co, flag, False):
+            return co.wire_dtype, co.quantization_group_size
+        return None
+
+    def grad_wire(self):
+        """(wire_format, scale_group_size) for quantized gradient reduce."""
+        from ...comm.collectives.quantized import DEFAULT_GROUP_SIZE
+        return self._co_wire("quantized_gradients") or \
+            ("int8", DEFAULT_GROUP_SIZE)
+
+    def param_wire(self, fallback_format="int8"):
+        """(wire_format, scale_group_size) for quantized param all-gather."""
+        from ...comm.collectives.quantized import DEFAULT_GROUP_SIZE
+        return self._co_wire("quantized_weights") or \
+            (fallback_format, DEFAULT_GROUP_SIZE)
+
+    def hierarchical_reduce(self):
+        """True when comm_optimizations asks gradient reduction to run the
+        2-hop (intra fp → inter quantized) scheme where the ZeRO group spans
+        a multi-axis hierarchy (dp×ep, hpZ's zp_outer×zp)."""
+        co = self.comm_opts
+        return bool(co is not None and getattr(co, "enabled", False)
+                    and getattr(co, "hierarchical_allreduce", False))
 
     # specs -----------------------------------------------------------------
     def _expand_rule(self, spec, shape, zero_axes, mesh):

@@ -18,10 +18,18 @@ TPU-native re-design of the reference's ZeRO++ stack (wiring at
   all-to-all crosses nodes at full fan-out; on a TPU torus the single
   mesh-axis all-to-all already rides ICI neighbor links, so the 1-hop scheme
   gets the same 4× volume reduction with ONE quantization error instead of
-  two.
+  two.  When the ZeRO group spans a genuine hierarchy (dp×ep, hpZ's
+  zp_outer×zp) and ``comm_optimizations.hierarchical_allreduce`` is on, the
+  reduction upgrades to the true 2-hop scheme from
+  ``comm/collectives/quantized.py``: full-precision reduce-scatter on the
+  intra axes, quantized all-to-all across the inter axes on 1/n of the data.
 * **hpZ** (secondary partition) is a *sharding policy*, not a collective:
   ``ZeroPartitionPlan(hpz_mesh=...)`` shards params over the intra-host "zp"
   mesh factor only (see ``partition.py``).
+
+The quantized collective primitives themselves live in
+``comm/collectives/quantized.py`` (shared with the eager ``dist.*`` engine
+and ``ds_bench``); this module owns the ZeRO-side orchestration.
 
 qgZ requires taking over the gradient reduction from GSPMD, so the engine
 switches its micro-step to a manual-SPMD (``shard_map``) variant — see
@@ -31,34 +39,24 @@ GSPMD keeps inserting the tensor-parallel collectives); sp/pp are rejected
 loudly (their collectives interleave with the reduction being replaced).
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ...ops.pallas.quantizer import dequantize_blockwise, quantize_blockwise
-
-DEFAULT_GROUP_SIZE = 2048
-
-
-def _zero_dim(spec, zero_axes):
-    """Locate the dim carrying ZeRO axes.  Returns (dim, axes_present) or
-    (None, ())."""
-    for i, entry in enumerate(spec):
-        if entry is None:
-            continue
-        names = entry if isinstance(entry, tuple) else (entry, )
-        present = tuple(a for a in names if a in zero_axes)
-        if present:
-            return i, present
-    return None, ()
+# canonical quantized-collective primitives (also the back-compat import
+# surface: tests and user code import these names from here)
+from ...comm.collectives.quantized import (DEFAULT_GROUP_SIZE,
+                                           all_to_all_quant_reduce,
+                                           hierarchical_quant_reduce_scatter,
+                                           qdq_all_gather_st,
+                                           quantized_all_gather)
+from .partition import zero_dim as _zero_dim
 
 
 def _entry_names(entry):
-    """Spec entry → tuple of axis names (shared normalize for the three
-    spec rewriters below)."""
+    """Spec entry → tuple of axis names (shared normalize for the spec
+    rewriters below)."""
     if entry is None:
         return ()
     return entry if isinstance(entry, tuple) else (entry, )
@@ -79,67 +77,6 @@ def _strip_axes(spec, dim, axes):
     return P(*new)
 
 
-# wire formats for qwZ payloads: name → (quantize, dequantize) closures.
-# "int8"/"int4" ride the blockwise integer kernels; "fp8"/"fp6"/"fp12" the FP
-# quantizer (reference csrc/fp_quantizer — fp6 packs 4 values → 3 bytes, so
-# the allgather volume drops to 3/8 of bf16).
-_FP_FORMATS = {"fp8": (8, 3), "fp6": (6, 2), "fp12": (12, 7)}
-
-
-def _wire_codec(wire_format, group_size):
-    if wire_format in ("int8", "int4"):
-        bits = 8 if wire_format == "int8" else 4
-        quant = lambda x: quantize_blockwise(x, num_bits=bits,
-                                             group_size=group_size,
-                                             use_pallas=False)
-        dequant = lambda q, s, m: dequantize_blockwise(q, s, m,
-                                                       use_pallas=False)
-        return quant, dequant
-    if wire_format in _FP_FORMATS:
-        from ...ops.fp_quantizer import dequantize_fp, quantize_fp
-        bits, man = _FP_FORMATS[wire_format]
-        quant = lambda x: quantize_fp(x, q_bits=bits, mantissa_bits=man,
-                                      group_size=group_size, use_pallas=False)
-        return quant, dequantize_fp
-    raise ValueError(f"unknown qwZ wire format {wire_format!r} "
-                     f"(have int8, int4, {', '.join(_FP_FORMATS)})")
-
-
-def quantized_all_gather(x, ax_names, dim, wire_format="int8",
-                         group_size=DEFAULT_GROUP_SIZE):
-    """Inside-shard_map: quantize-gather the local tile along mesh axes
-    ``ax_names``, reassembling the full dim in axis-index order (matches GSPMD
-    tiling order).  The wire payload is quantized values + one f32 scale per
-    ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu;
-    fp formats via csrc/fp_quantizer analog)."""
-    quant, dequant = _wire_codec(wire_format, group_size)
-    q, s, meta = quant(x)
-    qg = jax.lax.all_gather(q, ax_names)
-    sg = jax.lax.all_gather(s, ax_names)
-    parts = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
-    return jnp.concatenate(list(parts), axis=dim)
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def _qdq_all_gather_st(x, ax_names, dim, wire_format, group_size):
-    """Straight-through quantized gather: forward is the quantized gather;
-    backward is the exact VJP of a plain all-gather (reduce-scatter of the
-    cotangent) — the quantization rounding must not zero the gradient."""
-    return quantized_all_gather(x, ax_names, dim, wire_format, group_size)
-
-
-def _qdq_fwd(x, ax_names, dim, wire_format, group_size):
-    return _qdq_all_gather_st(x, ax_names, dim, wire_format, group_size), None
-
-
-def _qdq_bwd(ax_names, dim, wire_format, group_size, _, dy):
-    return (jax.lax.psum_scatter(dy, ax_names, scatter_dimension=dim,
-                                 tiled=True), )
-
-
-_qdq_all_gather_st.defvjp(_qdq_fwd, _qdq_bwd)
-
-
 def quantized_weight_gather(params, plan, wire_format="int8",
                             group_size=DEFAULT_GROUP_SIZE):
     """qwZ in GSPMD mode: explicitly gather every ZeRO-sharded param with a
@@ -158,37 +95,12 @@ def quantized_weight_gather(params, plan, wire_format="int8",
         out_spec = _strip_axes(spec, dim, axes)
         # positional call: custom_vjp rejects kwargs for nondiff argnums
         fn = shard_map(
-            lambda t: _qdq_all_gather_st(t, axes, dim, wire_format,
-                                         group_size),
+            lambda t: qdq_all_gather_st(t, axes, dim, wire_format,
+                                        group_size),
             mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
         return fn(x)
 
     return jax.tree_util.tree_map_with_path(gather_leaf, params)
-
-
-def all_to_all_quant_reduce(g, ax_names, dim, n, num_bits=8,
-                            group_size=DEFAULT_GROUP_SIZE):
-    """Inside-shard_map: quantized reduce-scatter of a (replicated) gradient:
-    split along ``dim`` into ``n`` partitions, int8 all-to-all so rank i
-    receives every rank's partition i, dequantize and average in fp32.
-    Returns this rank's partition (reference ``all_to_all_quant_reduce``,
-    runtime/comm/coalesced_collectives.py:31 — single-hop on ICI, see module
-    docstring)."""
-    chunks = jnp.stack(jnp.split(g, n, axis=dim))  # [n, ...chunk]
-
-    def q_one(c):
-        return quantize_blockwise(c, num_bits=num_bits, group_size=group_size,
-                                  use_pallas=False)[:2]
-
-    meta_shape = chunks.shape[1:]
-    _, _, meta = quantize_blockwise(chunks[0], num_bits=num_bits,
-                                    group_size=group_size, use_pallas=False)
-    q, s = jax.vmap(q_one)(chunks)
-    qx = jax.lax.all_to_all(q, ax_names, split_axis=0, concat_axis=0)
-    sx = jax.lax.all_to_all(s, ax_names, split_axis=0, concat_axis=0)
-    parts = jax.vmap(lambda qq, ss: dequantize_blockwise(
-        qq, ss, (meta_shape, jnp.float32, meta[2]), use_pallas=False))(qx, sx)
-    return jnp.sum(parts.astype(jnp.float32), axis=0) / n
 
 
 def build_manual_dp_micro(engine):
@@ -201,12 +113,15 @@ def build_manual_dp_micro(engine):
         per device:  local loss/grad on the local batch shard
         qwZ (opt.):  int8 param all-gather for stage-3 sharded params
         qgZ:         int8 all-to-all reduce-scatter into the master partition
+                     (2-hop hierarchical when the group spans dp×ep / hpZ
+                     axes and comm_optimizations asks for hierarchy)
 
     Returns ``micro(params, scale, inputs) -> (loss, grads)`` with grads in
     the master (ZeRO) sharding — drop-in for the engine's compiled micro fn.
     """
     plan = engine.plan
     zc = engine._config.zero_config
+    co = engine._config.comm_optimizations_config
     gas = engine.gradient_accumulation_steps()
     apply_fn = engine._effective_apply_fn()
     grad_dtype = engine.grad_accum_dtype
@@ -221,6 +136,18 @@ def build_manual_dp_micro(engine):
     # axis — GSPMD keeps inserting the tensor-parallel collectives inside
     # the body exactly as in the normal micro-step.
     manual_only = engine.mp_world_size > 1
+    if manual_only:
+        from ...utils import jax_compat
+        if jax_compat.is_legacy_shard_map():
+            # this jaxlib's SPMD partitioner CHECK-fails (native abort, takes
+            # the whole process) lowering partial-manual programs with
+            # collectives inside — refuse cleanly instead
+            raise ValueError(
+                "zero_quantized_gradients with tp > 1 needs the modern "
+                "jax.shard_map partial-manual lowering; this jax only has "
+                "the legacy experimental shard_map, whose partitioner "
+                "aborts on manual-subgroup sharding. Upgrade jax, or "
+                "disable zero_quantized_gradients / drop the tp axis")
     # With hpZ/MiCS the manual step runs over the reshaped hpz mesh, whose
     # (zp_outer, zp) axes tile the same device order as (dp, ep) on the
     # global mesh — full-dp specs are translated axis-for-axis.
@@ -244,7 +171,12 @@ def build_manual_dp_micro(engine):
         mesh = plan.mesh
         dp_axes = plan.zero_axes
         _translate = lambda spec: spec
-    qw = zc.zero_quantized_weights
+    qw = zc.zero_quantized_weights or (
+        getattr(co, "enabled", False) and getattr(co, "quantized_weights",
+                                                  False))
+    qw_fmt, qw_gs = plan.param_wire(zc.zero_quantized_weights_format)
+    qg_fmt, qg_gs = plan.grad_wire()
+    hier = plan.hierarchical_reduce()
 
     from .partition import path_str
     from ..utils import make_scaled_loss_fn
@@ -260,7 +192,57 @@ def build_manual_dp_micro(engine):
         return P(*[_collapse(tuple(a for a in _entry_names(e)
                                    if a in manual_axes)) for e in spec])
 
+    def _leaf_hier(spec):
+        """(dim, outer_axes, inner_axes) when this leaf's reduction should
+        run the 2-hop scheme, else None.  Mesh axis order is major→minor, so
+        the FIRST effective axis crosses the slower fabric."""
+        if not hier:
+            return None
+        dim, axes = _zero_dim(spec, dp_axes)
+        if dim is None:
+            return None
+        eff = tuple(a for a in axes if mesh.shape[a] > 1)
+        if len(eff) < 2:
+            return None
+        return dim, eff[:1], eff[1:]
+
+    def _hier_spec(spec):
+        """Reorder a hier leaf's zero-dim axes to the inner-major tiling the
+        2-hop reduce-scatter produces (see
+        ``hierarchical_quant_reduce_scatter``); the apply step reshards to
+        the canonical master layout at the gas boundary."""
+        info = _leaf_hier(spec)
+        if info is None:
+            return spec
+        dim, outer, inner = info
+        entry = _entry_names(spec[dim])
+        z = set(outer + inner)
+        new_z = iter(inner + outer)
+        new_entry = tuple(next(new_z) if a in z else a for a in entry)
+        out = list(spec)
+        out[dim] = _collapse(new_entry)
+        return P(*out)
+
     def micro(params, scale, inputs):
+        # specs must come from the GLOBAL shapes, captured here where params
+        # are still global arrays — inside the shard_map body the leaves are
+        # local shards (params) and spec inference from their shapes picks
+        # the wrong dim (e.g. a (16,16) param sharded to (2,16) looks
+        # dim-1-shardable); grads keep global shapes today (they come from
+        # the gathered full params) but get the same treatment so the body
+        # never depends on in-body shapes.
+        gather_specs = {}
+        reduce_specs = {}
+
+        def _record(kp, x):
+            p = path_str(kp)
+            gather_specs[p] = plan.param_spec(x.shape, p)
+            spec = _translate(plan.master_spec(x.shape, p))
+            if manual_only:
+                spec = _manual_spec(spec)
+            reduce_specs[p] = spec
+
+        jax.tree_util.tree_map_with_path(_record, params)
         param_specs = jax.tree_util.tree_map(_translate,
                                              plan.param_specs(params),
                                              is_leaf=lambda x: isinstance(
@@ -276,6 +258,9 @@ def build_manual_dp_micro(engine):
             master_specs = jax.tree_util.tree_map(
                 _manual_spec, master_specs,
                 is_leaf=lambda x: isinstance(x, P))
+        # hier leaves come out of the 2-hop reduce tiled inner-major
+        grad_out_specs = jax.tree_util.tree_map(
+            _hier_spec, master_specs, is_leaf=lambda x: isinstance(x, P))
         from ..utils import batch_input_specs
         batch_specs = batch_input_specs(inputs, dp_axes,
                                         engine._n_replicated_batch_tail)
@@ -283,12 +268,12 @@ def build_manual_dp_micro(engine):
         def body(params, inputs):
             # stage-3: reassemble full params from local shards (int8 when qwZ)
             def gather_leaf(kp, x):
-                spec = plan.param_spec(x.shape, path_str(kp))
+                spec = gather_specs[path_str(kp)]
                 dim, axes = _zero_dim(spec, plan.param_axes)
                 if dim is None:
                     return x
                 if qw:
-                    return quantized_all_gather(x, axes, dim)
+                    return quantized_all_gather(x, axes, dim, qw_fmt, qw_gs)
                 return jax.lax.all_gather(x, axes, axis=dim, tiled=True)
 
             full = jax.tree_util.tree_map_with_path(gather_leaf, params)
@@ -299,14 +284,29 @@ def build_manual_dp_micro(engine):
             def reduce_leaf(kp, g):
                 # translated spec lives in manual-mode axis space (dp_axes ∪
                 # zp), so searching dp_axes covers plain/hpZ/MiCS alike
-                spec = _translate(plan.master_spec(g.shape, path_str(kp)))
+                spec = reduce_specs[path_str(kp)]
                 dim, axes = _zero_dim(spec, dp_axes)
                 if dim is None:
                     return jax.lax.pmean(g, dp_axes).astype(grad_dtype)
-                n = 1
-                for a in axes:
-                    n *= mesh.shape[a]
-                out = all_to_all_quant_reduce(g, axes, dim, n)
+                info = _leaf_hier(spec)
+                if info is not None:
+                    _, outer, inner = info
+                    n_out = 1
+                    for a in outer:
+                        n_out *= mesh.shape[a]
+                    n_in = 1
+                    for a in inner:
+                        n_in *= mesh.shape[a]
+                    out = hierarchical_quant_reduce_scatter(
+                        g, inner, outer, dim, n_in, n_out,
+                        wire_format=qg_fmt, group_size=qg_gs)
+                else:
+                    n = 1
+                    for a in axes:
+                        n *= mesh.shape[a]
+                    out = all_to_all_quant_reduce(g, axes, dim, n,
+                                                  wire_format=qg_fmt,
+                                                  group_size=qg_gs)
                 # average over any remaining dp axes not in this dim
                 rest = tuple(a for a in dp_axes if a not in axes)
                 if rest:
@@ -317,7 +317,7 @@ def build_manual_dp_micro(engine):
             return loss, grads
 
         kw = dict(mesh=mesh, in_specs=(param_specs, batch_specs),
-                  out_specs=(P(), master_specs), check_vma=False)
+                  out_specs=(P(), grad_out_specs), check_vma=False)
         if manual_only:
             kw["axis_names"] = manual_axes  # tp stays auto (GSPMD)
         fn = shard_map(body, **kw)

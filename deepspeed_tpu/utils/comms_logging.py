@@ -2,6 +2,13 @@
 
 Tracks per-op counts/sizes/latencies and computes algorithmic/bus bandwidth
 (``get_bw`` logic mirrors the reference's msg-size → busbw factors).
+
+Bandwidth accounting is **wire-truthful**: when the collectives engine
+(``comm/collectives/``) runs a quantized or hierarchical variant, the op
+records the bytes that actually crossed the bottleneck (inter-node) link —
+quantized payload + per-group scales — not the logical fp tensor size, and
+the variant name is carried into the ``log_summary()`` rows as
+``op[variant]``.  Flat ops report wire == message size, as before.
 """
 
 import math
@@ -18,10 +25,13 @@ def get_msg_size_from_args(x):
 
 
 def calc_bw_log(comm_op, size, duration, n):
-    """Return (algbw, busbw) in Gbps. Factors follow nccl-tests conventions,
-    as the reference does (``comms_logging.py`` ``get_bw``)."""
+    """Return (algbw, busbw) in Gbps for ``size`` transported bytes.
+    Factors follow nccl-tests conventions, as the reference does
+    (``comms_logging.py`` ``get_bw``); a variant suffix (``all_reduce[hier]``)
+    keys off the base op name."""
     if duration <= 0:
         return 0.0, 0.0
+    comm_op = comm_op.split("[", 1)[0]
     tput = size / duration  # bytes/sec
     if comm_op in ("all_to_all", "all_to_all_single"):
         busbw = tput * ((n - 1) / n)
@@ -72,39 +82,54 @@ class CommsLogger:
     def stop_profiling_comms(self):
         self.prof_all = False
 
-    def append(self, raw_name, record_name, latency, msg_size, world_size):
-        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, world_size)
-        if record_name in self.comms_dict:
-            if msg_size in self.comms_dict[record_name]:
-                entry = self.comms_dict[record_name][msg_size]
+    def append(self, raw_name, record_name, latency, msg_size, world_size,
+               wire_size=None, variant=None):
+        """Record one collective.  ``msg_size`` is the logical tensor bytes;
+        ``wire_size`` the transported bytes (defaults to msg_size for flat
+        ops) — bandwidth is computed from the wire, because that is what the
+        links carried."""
+        wire = wire_size if wire_size is not None else msg_size
+        name = f"{record_name}[{variant}]" if variant else record_name
+        raw = f"{raw_name}[{variant}]" if variant else raw_name
+        algbw, busbw = calc_bw_log(raw, wire, latency, world_size)
+        if name in self.comms_dict:
+            if msg_size in self.comms_dict[name]:
+                entry = self.comms_dict[name][msg_size]
                 entry[0] += 1
                 entry[1].append(latency)
                 entry[2].append(algbw)
                 entry[3].append(busbw)
+                entry[4] = wire
             else:
-                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+                self.comms_dict[name][msg_size] = [1, [latency], [algbw],
+                                                   [busbw], wire]
         else:
-            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+            self.comms_dict[name] = {msg_size: [1, [latency], [algbw],
+                                                [busbw], wire]}
         if self.verbose:
             log_dist(
-                f"rank=? | comm op: {record_name} | time(ms): {latency*1000:.2f} | "
-                f"msg size: {msg_size} | algbw(Gbps): {algbw:.2f} | busbw(Gbps): {busbw:.2f}",
+                f"rank=? | comm op: {name} | time(ms): {latency*1000:.2f} | "
+                f"msg size: {msg_size} | wire size: {wire} | "
+                f"algbw(Gbps): {algbw:.2f} | busbw(Gbps): {busbw:.2f}",
                 ranks=[0])
 
     def log_all(self, print_log=True, show_straggler=False):
         from ..utils.logging import logger
-        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
-                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
-                 f"{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]
+        lines = [f"{'Comm. Op (variant)':<28}{'Message Size':<16}"
+                 f"{'Wire Size':<14}{'Count':<8}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<18}"
+                 f"{'tput_avg (Gbps)':<18}{'busbw_avg (Gbps)':<18}"]
         for record_name, sizes in sorted(self.comms_dict.items()):
             lines.append(record_name)
-            for msg_size, (count, latencies, algbws, busbws) in sorted(sizes.items()):
+            for msg_size, (count, latencies, algbws, busbws,
+                           wire) in sorted(sizes.items()):
                 total = sum(latencies) * 1000
                 avg = total / count
                 avg_alg = sum(algbws) / len(algbws)
                 avg_bus = sum(busbws) / len(busbws)
-                lines.append(f"{'':<20}{msg_size:<20}{count:<10}{total:<20.2f}"
-                             f"{avg:<20.2f}{avg_alg:<20.2f}{avg_bus:<20.2f}")
+                lines.append(f"{'':<28}{msg_size:<16}{wire:<14}{count:<8}"
+                             f"{total:<20.2f}{avg:<18.2f}{avg_alg:<18.2f}"
+                             f"{avg_bus:<18.2f}")
         out = "\n".join(lines)
         if print_log:
             logger.info(out)

@@ -1,0 +1,91 @@
+"""jax version-compat shims.
+
+This codebase targets the modern ``jax.shard_map`` API
+(``shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...,
+axis_names=...)``).  On the pinned 0.4.x jaxlib that entry point does not
+exist — the same machinery lives at ``jax.experimental.shard_map.shard_map``
+with the older kwarg spelling (``check_rep`` instead of ``check_vma``,
+``auto`` = the *complement* of ``axis_names``).  Without a shim, every
+eager collective in ``comm/backend.py`` (and the pipeline/sequence
+shard_map programs) dies with ``AttributeError: module 'jax' has no
+attribute 'shard_map'``.
+
+:func:`install` bridges the gap by publishing an adapter at
+``jax.shard_map`` when (and only when) the attribute is missing — on a
+modern jax it is a no-op, so the shim ages out automatically.
+"""
+
+import jax
+
+_installed = False
+
+
+def is_legacy_shard_map():
+    """True when the adapter (not a native ``jax.shard_map``) is serving.
+    Legacy jaxes also ship an SPMD partitioner that CHECK-fails
+    (``hlo_sharding_util.cc IsManualSubgroup``) on *partial*-manual programs
+    with collectives inside — callers that would emit one must refuse
+    cleanly instead of letting XLA abort the process."""
+    return _installed
+
+
+def inside_axis_context():
+    """True when called under an active named-axis trace (inside a
+    shard_map/pmap region).  Legacy jax has no ``get_abstract_mesh`` to
+    resolve the context mesh, so nested-region callers use this to refuse
+    cleanly instead of building a nested program the old partitioner
+    aborts on."""
+    try:
+        from jax._src import core as _core
+        return bool(_core.get_axis_env().axis_names())
+    except Exception:
+        return False
+
+
+def _adapt_shard_map(experimental_shard_map):
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, axis_names=None, check_rep=None,
+                  auto=None):
+        if auto is None:
+            if axis_names:
+                # modern axis_names = the MANUAL axes; legacy auto = the rest
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            else:
+                auto = frozenset()
+        if check_rep is None:
+            check_rep = bool(check_vma) if check_vma is not None else True
+        return experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                      out_specs=out_specs,
+                                      check_rep=check_rep, auto=auto)
+
+    return shard_map
+
+
+def install():
+    """Publish ``jax.shard_map`` on jaxes that predate it.  Returns True
+    when the adapter was installed, False when jax already has the API (or
+    has neither spelling)."""
+    try:
+        getattr(jax, "shard_map")
+        return False
+    except AttributeError:
+        pass
+    try:
+        from jax.experimental.shard_map import shard_map as _exp
+    except ImportError:
+        return False
+    global _installed
+    jax.shard_map = _adapt_shard_map(_exp)
+    _installed = True
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        # legacy jax cannot introspect "am I inside a manual shard_map
+        # region"; answer "no" (manual_axes=()) so callers fall back to the
+        # concrete global mesh — correct for every non-nested use
+        sentinel = type("_NoAbstractMesh", (), {"manual_axes": ()})()
+        jax.sharding.get_abstract_mesh = lambda: sentinel
+    if not hasattr(jax.lax, "axis_size"):
+        # pre-axis_size idiom: psum of a concrete 1 folds to the axis size
+        # at trace time
+        jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+    return True

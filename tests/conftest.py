@@ -31,6 +31,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Publish jax.shard_map on the pinned 0.4.x jaxlib BEFORE test modules
+# import (several do ``from jax import shard_map`` at module scope, ahead
+# of any deepspeed_tpu import that would install the shim itself).
+from deepspeed_tpu.utils import jax_compat as _jax_compat  # noqa: E402
+
+_jax_compat.install()
+
 # XLA compilation cache — PER-SESSION by default, cross-run only by opt-in.
 #
 # The disk cache matters even within a single pytest process: each test's

@@ -1,0 +1,271 @@
+"""Collectives-engine tests (comm/collectives/) on the virtual 8-device mesh.
+
+Covers the ISSUE-5 acceptance surface: topology factorization, hierarchical
+vs flat equivalence, int8/fp8 error bounds against fp32 references,
+per-block scale correctness, ReduceOp MIN/MAX/PRODUCT passthrough, bit-exact
+fallback when the engine is disabled, and wire-truthful comms logging.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.collectives import (CollectivesEngine,
+                                            CommOptimizations, factor_group,
+                                            quantized_wire_bytes, split_mesh)
+from deepspeed_tpu.comm.collectives import quantized as Q
+from deepspeed_tpu.utils import groups
+
+
+def _install(**kw):
+    dist.init_distributed()
+    eng = CollectivesEngine(CommOptimizations(enabled=True, **kw))
+    dist.set_collectives_engine(eng)
+    return eng
+
+
+# ---------------------------------------------------------------- topology
+def test_factor_group_single_axis_split():
+    dist.init_distributed()
+    g = dist.new_group(("dp", ))
+    # CPU virtual devices share one process — no auto hierarchy
+    assert factor_group(g) is None
+    h = factor_group(g, intra_node_size=2)
+    assert h is not None
+    assert h.outer_axes == ("dp_out", ) and h.inner_axes == ("dp_in", )
+    assert h.outer_size == 4 and h.inner_size == 2 and h.size == 8
+    assert h.mesh.shape["dp_out"] == 4 and h.mesh.shape["dp_in"] == 2
+    # device order preserved: _in varies fastest
+    flat = list(np.asarray(h.mesh.devices).flat)
+    assert [d.id for d in flat] == [d.id for d in
+                                    np.asarray(g.mesh.devices).flat]
+
+
+def test_factor_group_multi_axis_uses_axis_order():
+    groups.initialize_mesh(dp=4, tp=2)
+    dist.init_distributed()
+    g = dist.new_group(("dp", "tp"))
+    h = factor_group(g)
+    # mesh order is major→minor: first effective axis crosses the slow hop
+    assert h.outer_axes == ("dp", ) and h.inner_axes == ("tp", )
+    assert h.outer_size == 4 and h.inner_size == 2
+
+
+def test_factor_group_indivisible_split_refused():
+    dist.init_distributed()
+    g = dist.new_group(("dp", ))
+    assert factor_group(g, intra_node_size=3) is None  # 8 % 3 != 0
+    assert factor_group(g, intra_node_size=8) is None  # no outer left
+
+
+def test_split_mesh_env_override(monkeypatch):
+    dist.init_distributed()
+    g = dist.new_group(("dp", ))
+    monkeypatch.setenv("DS_TPU_INTRA_NODE_SIZE", "4")
+    h = factor_group(g)
+    assert h is not None and h.inner_size == 4 and h.outer_size == 2
+
+
+# ------------------------------------------------- hierarchical == flat
+def test_hierarchical_all_reduce_matches_flat():
+    _install()
+    x = jnp.arange(16, dtype=jnp.float32)
+    flat = dist.all_reduce(x)  # engine on, but no hierarchy → flat
+    dist.set_collectives_engine(
+        CollectivesEngine(CommOptimizations(enabled=True, intra_node_size=2)))
+    hier = dist.all_reduce(x)
+    # small-int sums are exact in fp32 under any association
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+
+def test_hierarchical_all_reduce_avg():
+    _install(intra_node_size=4)
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = dist.all_reduce(x, op=dist.ReduceOp.AVG)
+    dist.set_collectives_engine(None)
+    ref = dist.all_reduce(x, op=dist.ReduceOp.AVG)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_minmaxprod_passthrough_stays_flat_and_correct():
+    """Non-linear reduce ops must never ride the hierarchical/quantized
+    variants — and PRODUCT (gather+prod lowering) must work at all."""
+    eng = _install(intra_node_size=2, quantized_gradients=True)
+    x = jnp.arange(1, 9, dtype=jnp.float32)
+    g = dist.new_group(("dp", ))
+    assert eng.dispatch("all_reduce", x, g,
+                        reduce_op=dist.ReduceOp.MAX) is None
+    np.testing.assert_allclose(
+        np.asarray(dist.all_reduce(x, op=dist.ReduceOp.MAX)), 8.0)
+    np.testing.assert_allclose(
+        np.asarray(dist.all_reduce(x, op=dist.ReduceOp.MIN)), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(dist.all_reduce(x, op=dist.ReduceOp.PRODUCT)),
+        np.prod(np.arange(1, 9, dtype=np.float32)))
+
+
+# ---------------------------------------------------- quantized variants
+def test_quant_all_gather_error_bound_int8():
+    _install(quantized_weights=True, quantization_group_size=128)
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    out = dist.all_gather(x)
+    assert out.shape == x.shape
+    err = float(jnp.abs(out - x).max())
+    assert err <= float(jnp.abs(x).max()) / 127
+    assert err > 0  # it DID quantize (flat path would be exact)
+
+
+def test_quant_all_gather_error_bound_fp8():
+    _install(quantized_weights=True, wire_dtype="fp8",
+             quantization_group_size=128)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 32))
+    out = dist.all_gather(x)
+    # e4m3 relative grid error ≤ 2^-4 of the per-group absmax envelope
+    assert float(jnp.abs(out - x).max()) <= float(jnp.abs(x).max()) / 16
+
+
+def test_quant_reduce_scatter_matches_fp32_reference():
+    _install(quantized_gradients=True, quantization_group_size=128)
+    x = jax.random.normal(jax.random.PRNGKey(2), (1024, ))
+    out = dist.reduce_scatter(x)
+    dist.set_collectives_engine(None)
+    ref = dist.reduce_scatter(x)
+    tol = 8 * float(jnp.abs(x).max()) / 127  # n ranks × per-rank grid error
+    assert float(jnp.abs(out - ref).max()) <= tol
+
+
+def test_hier_quant_reduce_scatter_matches_fp32_reference():
+    _install(quantized_gradients=True, intra_node_size=2,
+             quantization_group_size=128)
+    x = jax.random.normal(jax.random.PRNGKey(3), (1024, ))
+    out = dist.reduce_scatter(x)
+    dist.set_collectives_engine(None)
+    ref = dist.reduce_scatter(x)
+    # global VALUE equality (mod quantization) regardless of tiling order
+    tol = 8 * float(jnp.abs(x).max()) / 127
+    assert float(jnp.abs(np.asarray(out) - np.asarray(ref)).max()) <= tol
+
+
+def test_per_block_scales():
+    """Per-group scales keep each block's relative error bounded — a global
+    scale would obliterate the small block next to the big one."""
+    gs = 128
+    x = jnp.concatenate([jnp.full((gs, ), 1e-3), jnp.full((gs, ), 1e3)])
+    q, s, meta = Q.wire_codec("int8", gs)[0](x)
+    valid = meta[2]  # kernel pads the group count to a row-block multiple
+    assert valid == 2
+    np.testing.assert_allclose(np.asarray(s)[:valid],
+                               np.array([1e-3, 1e3]) / 127, rtol=1e-5)
+    back = Q.wire_codec("int8", gs)[1](q, s, meta)
+    rel = np.abs(np.asarray(back) - np.asarray(x)) / np.asarray(x)
+    assert float(rel.max()) <= 1 / 127 + 1e-6
+
+
+def test_quantized_wire_bytes_math():
+    # 1024 fp32 elements = 4096B logical; int8 wire = 1024 payload + 8×4B
+    # scales (128-elem groups)
+    assert quantized_wire_bytes(1024, "int8", 128) == 1024 + 8 * 4
+    assert quantized_wire_bytes(1024, "fp6", 128) == 768 + 8 * 4
+    # group size is lane-aligned down: 200 → 128
+    assert quantized_wire_bytes(256, "int8", 200) == 256 + 2 * 4
+
+
+# ------------------------------------------------------------- fallbacks
+def test_disabled_engine_is_bit_exact():
+    dist.init_distributed()
+    x = jax.random.normal(jax.random.PRNGKey(4), (512, ))
+    ref_ar = dist.all_reduce(x)
+    ref_ag = dist.all_gather(x)
+    ref_rs = dist.reduce_scatter(x)
+    dist.set_collectives_engine(
+        CollectivesEngine(CommOptimizations(enabled=False,
+                                            quantized_gradients=True)))
+    np.testing.assert_array_equal(np.asarray(ref_ar),
+                                  np.asarray(dist.all_reduce(x)))
+    np.testing.assert_array_equal(np.asarray(ref_ag),
+                                  np.asarray(dist.all_gather(x)))
+    np.testing.assert_array_equal(np.asarray(ref_rs),
+                                  np.asarray(dist.reduce_scatter(x)))
+
+
+def test_ineligible_inputs_fall_through():
+    eng = _install(quantized_weights=True, quantized_gradients=True,
+                   intra_node_size=2, min_message_size=1 << 20)
+    g = dist.new_group(("dp", ))
+    # under min_message_size → flat
+    assert eng.dispatch("all_gather", jnp.ones((64, )), g) is None
+    eng.opts.min_message_size = 0
+    # integer dtype never quantizes
+    assert eng.dispatch("all_gather", jnp.ones((64, ), jnp.int32), g) is None
+    # indivisible shard → flat
+    assert eng.dispatch("reduce_scatter", jnp.ones((9, )), g) is None
+
+
+def test_coalesced_and_fn_helpers_ride_dispatch():
+    _install(quantized_weights=True, quantization_group_size=128)
+    x = jax.random.normal(jax.random.PRNGKey(5), (128, ))
+    outs = dist.all_gather_coalesced([x, 2 * x])
+    assert len(outs) == 2
+    assert float(jnp.abs(outs[0] - x).max()) > 0  # quantized round-trip
+    assert dist.allgather_fn(None, x) is not None
+
+
+def test_bad_wire_dtype_rejected():
+    with pytest.raises(ValueError, match="wire_dtype"):
+        CollectivesEngine(CommOptimizations(enabled=True, wire_dtype="int7"))
+
+
+# ------------------------------------------------------ config + logging
+def test_config_block_installs_engine():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "comm_optimizations": {"enabled": True,
+                                                  "quantized_gradients": True,
+                                                  "wire_dtype": "fp8"}})
+    dist.init_distributed(config=cfg)
+    eng = dist.get_collectives_engine()
+    assert eng is not None and eng.enabled
+    assert eng.opts.wire_dtype == "fp8"
+
+
+def test_config_applies_to_already_initialized_world():
+    """The reference workflow initializes dist first and hands the config to
+    deepspeed.initialize() later — the engine must still install."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    dist.init_distributed()
+    assert dist.get_collectives_engine() is None
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "comm_optimizations": {"enabled": True}})
+    dist.init_distributed(config=cfg)
+    assert dist.get_collectives_engine() is not None
+
+
+def test_config_bad_wire_dtype_rejected():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    with pytest.raises(DeepSpeedConfigError, match="wire_dtype"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "comm_optimizations": {"wire_dtype": "bf7"}})
+
+
+def test_comms_logger_reports_wire_bytes_and_variant():
+    from deepspeed_tpu.comm.comm import comms_logger
+    _install(quantized_gradients=True, quantization_group_size=128)
+    comms_logger.comms_dict = {}
+    comms_logger.enabled = True
+    x = jnp.ones((1024, ), jnp.float32)
+    dist.reduce_scatter(x)
+    comms_logger.enabled = False
+    recs = comms_logger.comms_dict
+    assert "reduce_scatter[q_int8]" in recs, recs.keys()
+    (msg_size, entry), = recs["reduce_scatter[q_int8]"].items()
+    assert msg_size == 4096  # logical fp32 bytes
+    wire = entry[4]
+    assert wire == quantized_wire_bytes(1024, "int8", 128)
+    assert wire < msg_size
+    dist.log_summary()  # renders with the wire column without raising
+    comms_logger.comms_dict = {}

@@ -7,11 +7,56 @@ from deepspeed_tpu.benchmarks.comm_bench import run
 
 
 def test_sweep_all_ops():
+    from deepspeed_tpu.benchmarks.comm_bench import ALL_OPS
     rows = run(axis="dp", minsize=12, maxsize=12, iters=2, warmup=1,
                print_fn=lambda *a: None)
-    assert len(rows) == 5  # one size, all five ops
-    for op, size, lat, algbw, busbw in rows:
-        assert size >= 4096 and lat > 0 and algbw > 0 and busbw > 0
+    assert len(rows) == len(ALL_OPS)  # one size, every op incl. engine ops
+    for op, size, wire, lat, algbw, busbw in rows:
+        assert size >= 4096 and wire > 0 and lat > 0 and algbw > 0 \
+            and busbw > 0
+
+
+def test_quantized_ops_report_reduced_wire_bytes():
+    """The acceptance bar: quantized all-gather / reduce-scatter move fewer
+    wire bytes than their flat fp32 siblings (int8 payload + scales < 4B/el),
+    and the hierarchical variants shrink the inter-node payload further."""
+    rows = {op: (size, wire)
+            for op, size, wire, *_ in run(
+                axis="dp", minsize=16, maxsize=16, iters=2, warmup=1,
+                print_fn=lambda *a: None)}
+    for flat, quant in (("all_gather", "quant_all_gather"),
+                        ("reduce_scatter", "quant_reduce_scatter")):
+        assert rows[quant][1] < rows[flat][1], (flat, quant, rows)
+    assert rows["hier_quant_reduce_scatter"][1] < \
+        rows["quant_reduce_scatter"][1]
+    assert rows["hier_all_reduce"][1] < rows["all_reduce"][1]
+    # flat ops: wire == logical bytes
+    assert rows["all_reduce"][0] == rows["all_reduce"][1]
+
+
+def test_json_output(tmp_path):
+    import json
+    out = tmp_path / "bench.json"
+    run(ops=("all_reduce", "quant_reduce_scatter"), axis="dp", minsize=12,
+        maxsize=12, iters=1, warmup=1, print_fn=lambda *a: None,
+        json_path=str(out))
+    payload = json.loads(out.read_text())
+    assert payload["axis"] == "dp" and payload["mesh"]["dp"] == 8
+    assert len(payload["rows"]) == 2
+    for row in payload["rows"]:
+        assert set(row) >= {"op", "bytes", "wire_bytes", "latency_us",
+                            "algbw_gbps", "busbw_gbps"}
+
+
+def test_hier_ops_skipped_on_unsplittable_axis():
+    """A size-2 axis has no non-trivial (outer, inner) split — the hier rows
+    must be skipped, not reported as fake hierarchy measurements."""
+    rows = run(ops=("hier_all_reduce", ), axis="tp", mesh_spec="dp=4,tp=2",
+               minsize=12, maxsize=12, iters=1, warmup=1,
+               print_fn=lambda *a: None)
+    assert rows == []
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
 
 
 def test_explicit_mesh_axis():

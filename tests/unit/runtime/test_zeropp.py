@@ -110,23 +110,30 @@ def test_quantized_weight_gather_grads_straight_through():
 
 
 # ---------------------------------------------------------- training parity
+# persistence threshold 0 in the quantized runs: at the default (1e5
+# elements) every tensor of this tiny model stays replicated, the qwZ/qgZ
+# leaf walkers find no ZeRO-sharded dim, and the "parity" would be trivially
+# exact without ever quantizing a byte.
 def test_qwz_tracks_plain_zero3():
     ref = _run(3)
-    qwz = _run(3, {"zero_quantized_weights": True})
+    qwz = _run(3, {"zero_quantized_weights": True,
+                   "stage3_param_persistence_threshold": 0})
     assert qwz[-1] < qwz[0] * 0.8, f"qwZ diverged: {qwz}"
     assert abs(qwz[-1] - ref[-1]) < 0.25 * abs(ref[0]), (ref, qwz)
 
 
 def test_qgz_tracks_plain_zero2():
     ref = _run(2)
-    qgz = _run(2, {"zero_quantized_gradients": True})
+    qgz = _run(2, {"zero_quantized_gradients": True,
+                   "stage3_param_persistence_threshold": 0})
     assert qgz[-1] < qgz[0] * 0.8, f"qgZ diverged: {qgz}"
     assert abs(qgz[-1] - ref[-1]) < 0.25 * abs(ref[0]), (ref, qgz)
 
 
 def test_qgz_with_qwz_stage3():
     losses = _run(3, {"zero_quantized_gradients": True,
-                      "zero_quantized_weights": True})
+                      "zero_quantized_weights": True,
+                      "stage3_param_persistence_threshold": 0})
     assert losses[-1] < losses[0] * 0.8, losses
 
 
@@ -195,6 +202,43 @@ def test_qgz_with_mics():
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+def test_hierarchical_qgz_over_hpz_mesh(monkeypatch):
+    """comm_optimizations + hpZ: the manual micro's gradient reduce runs the
+    2-hop scheme (fp psum_scatter over intra-host "zp", quantized a2a over
+    "zp_outer") from comm/collectives/quantized.py — trajectory must track
+    plain stage 3 within quantization tolerance, and the hierarchical
+    primitive must actually fire."""
+    from deepspeed_tpu.runtime.zero import zeropp
+    fired = []
+    orig = zeropp.hierarchical_quant_reduce_scatter
+    monkeypatch.setattr(
+        zeropp, "hierarchical_quant_reduce_scatter",
+        lambda *a, **k: fired.append(1) or orig(*a, **k))
+
+    def run(extra):
+        params = make_simple_mlp_params(HIDDEN)
+        cfg = _config(3, {"zero_hpz_partition_size": 4,
+                          "stage3_param_persistence_threshold": 0})
+        cfg.update(extra)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=simple_mlp_apply, model_parameters=params, config=cfg)
+        data = batches(random_dataset(64, HIDDEN),
+                       4 * engine.dp_world_size)
+        losses = _train(engine, data, steps=10)
+        groups.reset_mesh()
+        deepspeed_tpu.comm.destroy_process_group()
+        return losses
+
+    ref = run({})
+    assert not fired
+    hier = run({"comm_optimizations": {"enabled": True,
+                                       "quantized_gradients": True,
+                                       "quantization_group_size": 128}})
+    assert fired, "2-hop reduce never engaged on the zp_outer×zp group"
+    assert hier[-1] < hier[0] * 0.8, f"hier qgZ diverged: {hier}"
+    assert abs(hier[-1] - ref[-1]) < 0.25 * abs(ref[0]), (ref, hier)
+
+
 def test_premade_mesh_mismatch_raises():
     groups.initialize_mesh(dp=8)
     with pytest.raises(ValueError, match="zero_partition_size"):
@@ -209,6 +253,11 @@ def test_qgz_on_dp_tp_mesh():
     PARTIAL-manual mode (manual over dp, "tp" left auto so GSPMD keeps
     inserting the tensor-parallel collectives).  Round-2 limit: pure-DP
     meshes only."""
+    from deepspeed_tpu.utils import jax_compat
+    if jax_compat.is_legacy_shard_map():
+        pytest.skip("legacy experimental shard_map: partial-manual lowering "
+                    "aborts in this jaxlib's partitioner (guarded by a "
+                    "clean ValueError — see test_qgz_tp_rejected_on_legacy)")
     from deepspeed_tpu.models import llama
     cfg = llama.llama_tiny(dtype="float32", remat=False)
     losses = {}
@@ -238,6 +287,32 @@ def test_qgz_on_dp_tp_mesh():
     assert qgz[-1] < qgz[0] * 0.9, f"qgZ×tp diverged: {qgz}"
     # int8-quantized gradient traffic tracks the exact trajectory
     assert abs(qgz[-1] - ref[-1]) < 0.25 * abs(ref[0]), (ref, qgz)
+
+
+def test_qgz_tp_rejected_on_legacy_shard_map():
+    """On jaxes without native jax.shard_map, the partial-manual qgZ×tp
+    path must refuse with guidance (the legacy partitioner would otherwise
+    CHECK-fail and abort the whole process)."""
+    from deepspeed_tpu.utils import jax_compat
+    if not jax_compat.is_legacy_shard_map():
+        pytest.skip("modern shard_map: partial-manual qgZ×tp is supported")
+    from deepspeed_tpu.models import llama
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, tp_rules=llama.tp_rules(cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2,
+                                      "zero_quantized_gradients": True},
+                "mesh": {"tp": 2, "dp": -1}})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    with pytest.raises(ValueError, match="partial-manual"):
+        engine.initialize_parameters(0, ids, ids)
+        engine(ids, ids)
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
 
 
 def test_qgz_rejects_sp_mesh():

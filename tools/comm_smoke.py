@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""comm_optimizations smoke test: a tiny ZeRO-2 train with the quantized
+collectives engine ON must track the flat baseline to loss parity.
+
+What it does (tiny MLP, 8 virtual CPU devices, ~20s):
+
+1. trains ``steps`` ZeRO-2 steps with the default flat collectives and
+   records the loss trajectory;
+2. repeats the IDENTICAL run (same seed, params, data, optimizer) with the
+   ``comm_optimizations`` block enabled — int8 quantized gradient
+   reduce-scatter (qgZ-style manual-SPMD micro) + hierarchical dispatch —
+   and records that trajectory;
+3. asserts (a) the quantized run converges (final < 0.8 × first), (b) the
+   final losses agree within ``tolerance`` (ISSUE-5 acceptance: 1e-2), and
+   (c) the quantized wire payload for the gradient volume is genuinely
+   smaller than the fp32 payload.
+
+Run:  python tools/comm_smoke.py
+Exit: 0 on PASS, 1 on any deviation.
+
+``tests/unit/comm/test_comm_smoke.py`` drives :func:`run_smoke` in-process
+(bench-gate convention: loaded via importlib, no subprocess).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HIDDEN = 16
+TOLERANCE = 1e-2
+
+COMM_OPTS = {
+    "enabled": True,
+    "quantized_gradients": True,
+    "hierarchical_allreduce": True,
+    "wire_dtype": "int8",
+    "quantization_group_size": 128,
+}
+
+
+def _one_run(comm_optimizations, steps, lr):
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.utils import groups
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": rng.standard_normal((HIDDEN, HIDDEN)).astype("float32") * 0.3,
+        "w2": rng.standard_normal((HIDDEN, HIDDEN)).astype("float32") * 0.3,
+        "b": np.zeros((HIDDEN, ), "float32"),
+    }
+
+    def apply_fn(p, x, y):
+        import jax.numpy as jnp
+        h = jnp.tanh(x @ p["w1"] + p["b"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    # SGD, not adam: adam's per-element normalization (first step ≈ sign
+    # descent) hides small relative gradient errors, which would make this
+    # smoke pass even if quantization were catastrophically wrong.  SGD
+    # propagates the int8 grid error into the trajectory proportionally —
+    # the parity bound actually measures something.
+    # persistence threshold 0: at the default (1e5 elements) every tensor of
+    # this tiny model would stay replicated, the reduction would take the
+    # full-precision pmean path, and the "parity" would be vacuous
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "sgd", "params": {"lr": lr}},
+        "zero_optimization": {"stage": 2,
+                              "stage3_param_persistence_threshold": 0},
+    }
+    if comm_optimizations:
+        config["comm_optimizations"] = comm_optimizations
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params, config=config)
+    xs = rng.standard_normal((4 * engine.dp_world_size, HIDDEN)
+                             ).astype("float32")
+    ys = np.tanh(xs * 0.5).astype("float32")
+    losses = []
+    for _ in range(steps):
+        loss = engine(xs, ys)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
+    return losses
+
+
+def run_smoke(steps=8, lr=0.2, tolerance=TOLERANCE):
+    """Run flat vs comm_optimizations ZeRO-2 and compare.  Returns a dict
+    with both trajectories, the deltas, the wire-bytes comparison, and a
+    ``pass`` verdict — the CLI and the unit test both key off it."""
+    from deepspeed_tpu.comm.collectives import quantized_wire_bytes
+
+    flat = _one_run(None, steps, lr)
+    quant = _one_run(COMM_OPTS, steps, lr)
+    final_delta = abs(flat[-1] - quant[-1])
+    grad_elems = HIDDEN * HIDDEN
+    wire_fp32 = grad_elems * 4
+    wire_q = quantized_wire_bytes(grad_elems, COMM_OPTS["wire_dtype"],
+                                  COMM_OPTS["quantization_group_size"])
+    result = {
+        "flat_losses": flat,
+        "quant_losses": quant,
+        "final_delta": final_delta,
+        "tolerance": tolerance,
+        "converged": quant[-1] < quant[0] * 0.8,
+        "wire_bytes_fp32_per_grad": wire_fp32,
+        "wire_bytes_quant_per_grad": wire_q,
+        "wire_reduced": wire_q < wire_fp32,
+    }
+    result["pass"] = bool(result["converged"]
+                          and final_delta <= tolerance
+                          and result["wire_reduced"])
+    return result
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.path.insert(0, REPO)
+
+    r = run_smoke()
+    print(f"flat  losses: {['%.5f' % x for x in r['flat_losses']]}")
+    print(f"quant losses: {['%.5f' % x for x in r['quant_losses']]}")
+    print(f"final delta {r['final_delta']:.2e} (tolerance {r['tolerance']})"
+          f" | converged={r['converged']}")
+    print(f"gradient wire bytes: fp32={r['wire_bytes_fp32_per_grad']} "
+          f"int8+scales={r['wire_bytes_quant_per_grad']} "
+          f"(reduced={r['wire_reduced']})")
+    if not r["pass"]:
+        print("FAIL: comm_optimizations run deviates from the flat baseline")
+        return 1
+    print("PASS: quantized-engine ZeRO-2 reaches loss parity with reduced "
+          "wire bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
