@@ -167,6 +167,15 @@ def run_bench(on_tpu: bool) -> dict:
             if os.environ.get("BENCH_GRAD_DTYPE"):  # on-chip sweep knob
                 bench_cfg["data_types"] = {
                     "grad_accum_dtype": os.environ["BENCH_GRAD_DTYPE"]}
+            if os.environ.get("BENCH_TRACE", "0") != "0":
+                # archive step traces next to the BENCH_*.json record so a
+                # headline number can be decomposed with trace_report.py
+                # (fence OFF: tracing must not change what is measured)
+                trace_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    ".bench_runs", f"trace_{backend}")
+                bench_cfg["telemetry"] = {"enabled": True,
+                                          "trace_dir": trace_dir}
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=model, config=bench_cfg)
 
@@ -260,6 +269,9 @@ def run_bench(on_tpu: bool) -> dict:
             _logt(f"measured {done}/{steps} steps "
                   f"(chunk {per_step*1e3:.0f}ms/step, best "
                   f"{best*1e3:.0f}ms)")
+    from deepspeed_tpu import telemetry as _tel
+    if _tel.enabled:
+        _tel.shutdown()   # flush trace.json/steps.jsonl now, not at atexit
     return rec
 
 

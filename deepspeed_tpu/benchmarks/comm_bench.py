@@ -21,6 +21,7 @@ latency, algbw, busbw.  Bandwidths are computed from WIRE bytes.
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -157,11 +158,25 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
     return size_bytes, wire_bytes, lat, algbw, busbw
 
 
+# engine-variant op → (facade op, comms-logging variant tag) so traced
+# sweeps use the same ``op[variant]`` vocabulary as training traces
+_TRACE_VARIANTS = {
+    "hier_all_reduce": ("all_reduce", "hier"),
+    "quant_all_gather": ("all_gather", f"q_{WIRE_FORMAT}"),
+    "quant_reduce_scatter": ("reduce_scatter", f"q_{WIRE_FORMAT}"),
+    "hier_quant_reduce_scatter": ("reduce_scatter", f"hier_q_{WIRE_FORMAT}"),
+}
+
+
 def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
-        iters=20, warmup=3, print_fn=print, intra=0, json_path=None):
+        iters=20, warmup=3, print_fn=print, intra=0, json_path=None,
+        trace_dir=None):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
     (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps); with
-    ``json_path``, also writes them as machine-readable JSON."""
+    ``json_path``, also writes them as machine-readable JSON; with
+    ``trace_dir``, archives telemetry artifacts (chrome trace + per-variant
+    comm attribution) alongside the sweep output so a BENCH_*.json row can
+    be traced back to what actually ran."""
     from ..utils import groups
     if mesh_spec:
         kw = {}
@@ -175,6 +190,10 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         raise SystemExit(
             f"axis {axis!r} has size {mesh.shape.get(axis, 1)} on mesh "
             f"{dict(mesh.shape)} — nothing to benchmark (pass --mesh)")
+    recorder = None
+    if trace_dir:
+        from ..telemetry import TraceRecorder
+        recorder = TraceRecorder(trace_dir, rank=0)
     rows = []
     print_fn(f"# mesh={dict(mesh.shape)} axis={axis} dtype=fp32 "
              f"wire={WIRE_FORMAT}")
@@ -183,14 +202,24 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
     for op in ops:
         for p in range(minsize, maxsize + 1, 2):
             try:
-                size, wire, lat, algbw, busbw = _bench_one(
-                    op, axis, 1 << p, mesh, iters, warmup, intra=intra)
+                if recorder is not None:
+                    with recorder.span(f"{op}/{1 << p}", cat="bench"):
+                        size, wire, lat, algbw, busbw = _bench_one(
+                            op, axis, 1 << p, mesh, iters, warmup,
+                            intra=intra)
+                else:
+                    size, wire, lat, algbw, busbw = _bench_one(
+                        op, axis, 1 << p, mesh, iters, warmup, intra=intra)
             except UnsplittableAxis as e:
                 # hier_* on an unsplittable axis: note and keep sweeping the
                 # other ops (any other error still fails the bench loudly)
                 print_fn(f"# {op}: skipped ({e})")
                 break
             rows.append((op, size, wire, lat, algbw, busbw))
+            if recorder is not None:
+                base, variant = _TRACE_VARIANTS.get(op, (op, None))
+                recorder.comm_event(base, variant, size, wire, lat,
+                                    world_size=mesh.shape[axis])
             print_fn(f"{op:<28}{size:>12}{wire:>12}{lat * 1e6:>14.1f}"
                      f"{algbw:>12.2f}{busbw:>12.2f}")
     if json_path:
@@ -208,6 +237,16 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         with open(json_path, "w") as fh:
             json.dump(payload, fh, indent=2)
         print_fn(f"# wrote {len(rows)} rows to {json_path}")
+    if recorder is not None:
+        summary_path = os.path.join(recorder.trace_dir, "comm_summary.json")
+        with open(summary_path, "w") as fh:
+            json.dump({"mesh": {k: int(v)
+                                for k, v in dict(mesh.shape).items()},
+                       "axis": axis, "ops": recorder.comm_summary()},
+                      fh, indent=2)
+        recorder.close()
+        print_fn(f"# archived trace + comm attribution under "
+                 f"{recorder.trace_dir}")
     return rows
 
 
@@ -232,11 +271,15 @@ def cli_main(argv=None):
                     "auto-detect, falling back to an even split)")
     ap.add_argument("--json", default=None, metavar="PATH",
                     help="also write machine-readable rows to PATH")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="archive telemetry artifacts (chrome trace + "
+                    "per-variant comm attribution) under DIR alongside "
+                    "the --json rows")
     args = ap.parse_args(argv)
     run(ops=(args.op, ) if args.op else ALL_OPS, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
         iters=args.iters, warmup=args.warmup, intra=args.intra,
-        json_path=args.json)
+        json_path=args.json, trace_dir=args.trace)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ... import telemetry as _telemetry
 from ..reduce_op import ReduceOp
 from . import quantized as Q
 from .config import CommOptimizations
@@ -167,12 +168,22 @@ class CollectivesEngine:
         if not self.enabled or group is None or not self._eligible(x):
             return None
         if op_name == "all_reduce":
-            return self._all_reduce(x, group, reduce_op)
-        if op_name == "all_gather":
-            return self._all_gather(x, group, axis)
-        if op_name == "reduce_scatter":
-            return self._reduce_scatter(x, group, reduce_op, axis)
-        return None
+            hit = self._all_reduce(x, group, reduce_op)
+        elif op_name == "all_gather":
+            hit = self._all_gather(x, group, axis)
+        elif op_name == "reduce_scatter":
+            hit = self._reduce_scatter(x, group, reduce_op, axis)
+        else:
+            hit = None
+        if _telemetry.enabled:
+            # per-variant pick counters: the autotuner's view of how often
+            # each optimized path actually engages vs falls back flat
+            variant = hit[1] if hit is not None else "flat_fallback"
+            c = _telemetry.counter(f"comm/dispatch/{op_name}/{variant}",
+                                   help="collectives-engine variant picks")
+            if c is not None:
+                c.inc()
+        return hit
 
     def _all_reduce(self, x, group, op):
         if op not in _LINEAR_OPS:

@@ -19,6 +19,7 @@ import numpy as np
 
 from .backend import MeshBackend, ProcessGroup
 from .reduce_op import ReduceOp
+from .. import telemetry as _telemetry
 from ..utils.comms_logging import CommsLogger, get_msg_size_from_args
 from ..utils.logging import logger
 
@@ -92,14 +93,18 @@ def timed_op(func):
         name = log_name or func.__name__
         should_log = comms_logger.enabled and (
             comms_logger.prof_all or name in comms_logger.prof_ops)
-        if not should_log:
+        tel_on = _telemetry.enabled
+        if not should_log and not tel_on:
             return func(*args, **kwargs)
         _last_dispatch = None
         t0 = time.perf_counter()
         result = func(*args, **kwargs)
-        if comms_logger.sync_timing:
+        if comms_logger.sync_timing or (
+                tel_on and _telemetry.get_recorder() is not None
+                and _telemetry.get_recorder().fence):
             # opt-in: precise completion latency at the cost of serializing
-            # the async pipeline (round-1 review item 9 — no longer default)
+            # the async pipeline (round-1 review item 9 — no longer default;
+            # telemetry fence mode wants the same truth for exposed-comm)
             try:
                 result.block_until_ready()
             except Exception:
@@ -112,8 +117,14 @@ def timed_op(func):
         group = bound.get("group")
         ws = group.size() if group is not None else (cdb.world_size() if cdb else 1)
         variant, wire = _last_dispatch if _last_dispatch else (None, None)
-        comms_logger.append(func.__name__, name, latency, msg_size, ws,
-                            wire_size=wire, variant=variant)
+        if should_log:
+            comms_logger.append(func.__name__, name, latency, msg_size, ws,
+                                wire_size=wire, variant=variant)
+        if tel_on:
+            # same wire-truthful record, joined into the step trace — the
+            # exposed-comm-fraction and per-variant latency feed
+            _telemetry.record_comm_event(name, variant, msg_size, wire,
+                                         latency, ws)
         return result
 
     return wrapper
@@ -298,6 +309,10 @@ def _dispatch(op_name, tensor, op=ReduceOp.SUM, group=None, axis=0):
     through to the flat MeshBackend path — bit-identical to the engine-less
     facade."""
     global _last_dispatch
+    # reset HERE, not only in timed_op: a variant hit recorded by an
+    # unlogged op must never be attributed to a later flat/fallback op —
+    # that mislabels the op AND double-counts the quantized wire bytes
+    _last_dispatch = None
     eng = _engine
     if eng is not None and eng.enabled:
         g = group if group is not None else cdb.world_group

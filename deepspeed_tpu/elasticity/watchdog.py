@@ -22,6 +22,7 @@ import json
 import os
 import time
 
+from .. import telemetry as _telemetry
 from ..utils.fault_injection import fault_point
 from ..utils.logging import logger
 
@@ -41,14 +42,25 @@ class HeartbeatWriter:
         self.rank = int(rank)
         os.makedirs(self.directory, exist_ok=True)
         self._path = _rank_file(self.directory, self.rank)
+        self._last_beat_ts = None
 
     def beat(self, step):
         if fault_point("heartbeat.beat", rank=self.rank, step=step):
             return False  # injected stall: the worker "hangs"
+        now = time.time()
+        if self._last_beat_ts is not None and _telemetry.enabled:
+            # the worker-side liveness series: how long since the previous
+            # beat (≈ optimizer-step cadence; a growing gauge is a stall
+            # the agent has not killed yet)
+            _telemetry.gauge("elastic/heartbeat_interval_seconds",
+                             help="time between this worker's heartbeats"
+                             ).set(now - self._last_beat_ts)
+            _telemetry.gauge("elastic/heartbeat_step").set(float(step))
+        self._last_beat_ts = now
         tmp = self._path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump({"ts": time.time(), "step": int(step),
+                json.dump({"ts": now, "step": int(step),
                            "pid": os.getpid()}, f)
             os.replace(tmp, self._path)
             return True
@@ -119,10 +131,19 @@ class HeartbeatMonitor:
         now = time.time() if now is None else now
         beats = self.last_beats()
         if not beats:
-            return now - self._epoch > self.stall_timeout
-        oldest = min(max(p.get("ts", 0.0), self._epoch)
-                     for p in beats.values())
-        return now - oldest > self.stall_timeout
+            age = now - self._epoch
+        else:
+            oldest = min(max(p.get("ts", 0.0), self._epoch)
+                         for p in beats.values())
+            age = now - oldest
+        if _telemetry.enabled:
+            # agent-side view: age of the OLDEST beat — the number the
+            # stall verdict is made from, exported so dashboards can alarm
+            # before the kill threshold
+            _telemetry.gauge("elastic/heartbeat_age_seconds",
+                             help="age of the oldest rank's heartbeat"
+                             ).set(age)
+        return age > self.stall_timeout
 
     def stall_report(self, now=None):
         now = time.time() if now is None else now

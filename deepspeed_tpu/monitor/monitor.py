@@ -13,9 +13,33 @@ class Monitor:
     def __init__(self, config):
         self.config = config
         self.enabled = getattr(config, "enabled", False)
+        self._warned_non_scalar = set()
 
     def write_events(self, event_list):
         raise NotImplementedError
+
+    def _scalarize(self, name, value):
+        """Coerce an event value to float, or None with a LOUD warning (once
+        per event name) — a stray tensor/string in an event list must not
+        raise mid-train and kill the run it is observing."""
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            pass
+        try:
+            import numpy as np
+            arr = np.asarray(value)
+            if arr.size == 1:
+                return float(arr.reshape(()))
+        except Exception:
+            pass
+        if name not in self._warned_non_scalar:
+            self._warned_non_scalar.add(name)
+            logger.warning(
+                "monitor: event %r has non-scalar value %r (%s); dropping "
+                "it (and further values for this name silently)", name,
+                value, type(value).__name__)
+        return None
 
 
 class TensorBoardMonitor(Monitor):
@@ -23,20 +47,40 @@ class TensorBoardMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
         self.summary_writer = None
+        self._writer_failed = False
         if self.enabled:
             try:
-                from torch.utils.tensorboard import SummaryWriter
-                out = os.path.join(config.output_path or "./runs", config.job_name)
-                self.summary_writer = SummaryWriter(log_dir=out)
+                from torch.utils.tensorboard import SummaryWriter  # noqa: F401
             except ImportError:
                 logger.warning("tensorboard not available; disabling TB monitor")
                 self.enabled = False
 
+    def _ensure_writer(self):
+        """Create the SummaryWriter (and its output directories) on first
+        write, not at construction — a bad/unwritable ``output_path`` then
+        degrades this backend instead of crashing engine bring-up."""
+        if self.summary_writer is not None or self._writer_failed:
+            return self.summary_writer
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            out = os.path.join(self.config.output_path or "./runs",
+                               self.config.job_name)
+            os.makedirs(out, exist_ok=True)
+            self.summary_writer = SummaryWriter(log_dir=out)
+        except (ImportError, OSError) as e:
+            logger.warning("tensorboard writer unavailable (%s: %s); "
+                           "disabling TB monitor", type(e).__name__, e)
+            self._writer_failed = True
+            self.enabled = False
+        return self.summary_writer
+
     def write_events(self, event_list, flush=True):
-        if self.summary_writer is None:
+        if not self.enabled or self._ensure_writer() is None:
             return
         for name, value, step in event_list:
-            self.summary_writer.add_scalar(name, value, step)
+            value = self._scalarize(name, value)
+            if value is not None:
+                self.summary_writer.add_scalar(name, value, step)
         if flush:
             self.summary_writer.flush()
 
@@ -59,7 +103,9 @@ class WandbMonitor(Monitor):
         if not self.enabled:
             return
         for name, value, step in event_list:
-            self._wandb.log({name: value}, step=step)
+            value = self._scalarize(name, value)
+            if value is not None:
+                self._wandb.log({name: value}, step=step)
 
 
 class CometMonitor(Monitor):
@@ -91,23 +137,39 @@ class CometMonitor(Monitor):
         if self.experiment is None:
             return
         for name, value, step in event_list:
-            self.experiment.log_metric(name, value, step=step)
+            value = self._scalarize(name, value)
+            if value is not None:
+                self.experiment.log_metric(name, value, step=step)
 
 
 class csv_monitor(Monitor):
 
     def __init__(self, config):
         super().__init__(config)
+        self._dir_ready = False
         if self.enabled:
             self.output_path = os.path.join(config.output_path or "./csv_logs",
                                             config.job_name)
-            os.makedirs(self.output_path, exist_ok=True)
             self._files = {}
 
     def write_events(self, event_list):
         if not self.enabled:
             return
+        if not self._dir_ready:
+            # first write, not __init__: an unwritable output_path degrades
+            # this backend with a warning instead of crashing bring-up
+            try:
+                os.makedirs(self.output_path, exist_ok=True)
+            except OSError as e:
+                logger.warning("csv monitor output_path %r unusable (%s); "
+                               "disabling", self.output_path, e)
+                self.enabled = False
+                return
+            self._dir_ready = True
         for name, value, step in event_list:
+            value = self._scalarize(name, value)
+            if value is None:
+                continue
             fname = os.path.join(self.output_path,
                                  name.replace("/", "_") + ".csv")
             new = not os.path.exists(fname)
@@ -115,7 +177,7 @@ class csv_monitor(Monitor):
                 w = csv.writer(f)
                 if new:
                     w.writerow(["step", name])
-                w.writerow([step, float(value)])
+                w.writerow([step, value])
 
 
 #: event-name prefix for the resilience subsystem's telemetry (skipped

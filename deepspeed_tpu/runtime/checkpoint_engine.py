@@ -23,6 +23,7 @@ import zlib
 import jax
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..utils.fault_injection import fault_point
 from ..utils.logging import log_dist, logger
 
@@ -103,6 +104,18 @@ def verify_checkpoint_tag(root):
     indistinguishable, so callers prefer any verified tag over it), or
     ``"corrupt"`` (manifest present but unreadable / files missing or
     mismatched)."""
+    if _telemetry.enabled:
+        t0 = time.perf_counter()
+        try:
+            return _verify_checkpoint_tag(root)
+        finally:
+            _telemetry.observe("checkpoint/verify_seconds",
+                               time.perf_counter() - t0,
+                               help="manifest CRC-walk duration")
+    return _verify_checkpoint_tag(root)
+
+
+def _verify_checkpoint_tag(root):
     if not os.path.isdir(root):
         return "corrupt", "tag directory missing"
     mpath = os.path.join(root, MANIFEST_NAME)
@@ -304,6 +317,7 @@ class _AsyncSaveHandle:
     def wait(self):
         if self._done:
             return
+        t0 = time.perf_counter()
         errors = []
         try:
             for c in self._ckptrs:
@@ -342,6 +356,10 @@ class _AsyncSaveHandle:
                                       protect=str(self._tag))
         finally:
             self._done = True  # a failed commit must not wedge retries
+            if _telemetry.enabled:
+                _telemetry.observe("checkpoint/async_commit_seconds",
+                                   time.perf_counter() - t0,
+                                   help="async save wait-to-durable time")
 
     @property
     def done(self):
@@ -391,6 +409,26 @@ def restore_data_state(engine, state):
 
 def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
                            save_latest=True, async_save=False):
+    if _telemetry.enabled:
+        t0 = time.perf_counter()
+        with _telemetry.span("checkpoint_save", cat="checkpoint",
+                             tag=str(tag), async_save=bool(async_save)):
+            out = _save_engine_checkpoint(engine, save_dir, tag,
+                                          client_state, save_latest,
+                                          async_save)
+        # async: this times the staging (device_get + dispatch), the commit
+        # is timed by _AsyncSaveHandle.wait
+        _telemetry.observe("checkpoint/save_seconds",
+                           time.perf_counter() - t0,
+                           help="checkpoint save (sync) / staging (async)")
+        _telemetry.counter("checkpoint/saves").inc()
+        return out
+    return _save_engine_checkpoint(engine, save_dir, tag, client_state,
+                                   save_latest, async_save)
+
+
+def _save_engine_checkpoint(engine, save_dir, tag, client_state,
+                            save_latest, async_save):
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     root = os.path.abspath(os.path.join(save_dir, str(tag)))
@@ -573,6 +611,10 @@ def _fallback_event(engine, load_dir, bad_tag, good_tag):
     if monitor is not None and getattr(monitor, "enabled", False):
         monitor.write_resilience_events(
             [("ckpt_fallback", 1.0)], step=engine.global_samples)
+    if _telemetry.enabled:
+        _telemetry.counter("checkpoint/rollbacks",
+                           help="loads that fell back to an older valid "
+                           "tag").inc()
     logger.error("checkpoint rollback: %s/%s → %s", load_dir, bad_tag,
                  good_tag)
 
@@ -581,6 +623,28 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
                            load_optimizer_states=True,
                            load_lr_scheduler_states=True,
                            load_module_only=False):
+    if _telemetry.enabled:
+        t0 = time.perf_counter()
+        with _telemetry.span("checkpoint_load", cat="checkpoint",
+                             tag=str(tag)):
+            out = _load_engine_checkpoint(engine, load_dir, tag,
+                                          load_optimizer_states,
+                                          load_lr_scheduler_states,
+                                          load_module_only)
+        _telemetry.observe("checkpoint/load_seconds",
+                           time.perf_counter() - t0,
+                           help="checkpoint load incl. tag verification")
+        return out
+    return _load_engine_checkpoint(engine, load_dir, tag,
+                                   load_optimizer_states,
+                                   load_lr_scheduler_states,
+                                   load_module_only)
+
+
+def _load_engine_checkpoint(engine, load_dir, tag,
+                            load_optimizer_states,
+                            load_lr_scheduler_states,
+                            load_module_only):
     load_dir = os.path.abspath(load_dir)
     tag = _resolve_load_tag(engine, load_dir, tag)
     if tag is None:

@@ -288,6 +288,34 @@ class ResilienceConfig(DeepSpeedConfigModel):
     watchdog: WatchdogConfig = WatchdogConfig()
 
 
+class TelemetryMetricsConfig(DeepSpeedConfigModel):
+    """Live-metrics half of the telemetry block: registry + sinks."""
+    enabled: bool = True
+    # 0 = no HTTP endpoint (telemetry.prometheus_text() still renders)
+    prometheus_port: int = Field(0, ge=0)
+    # export/serve only on process 0 (the aggregation rank); False = every
+    # rank exports its own series
+    rank0_only: bool = True
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``"telemetry"`` JSON section — see docs/observability.md.  Off by
+    default = zero overhead: every emit site guards on the module-level
+    ``deepspeed_tpu.telemetry.enabled`` flag, so the step path makes no
+    telemetry allocations and losses are bit-identical to a build without
+    the subsystem."""
+    enabled: bool = False
+    trace_dir: str = "telemetry"   # chrome trace + per-step JSONL land here
+    trace_steps: int = Field(0, ge=0)  # stop step records after N; 0 = all
+    # block on the accelerator at phase boundaries: CPU-accurate phase
+    # attribution at the cost of serializing async dispatch
+    fence: bool = False
+    # wrap spans/steps in jax.profiler annotations so xplane captures
+    # (engine.start_device_trace) carry the phase names
+    device_profiler: bool = False
+    metrics: TelemetryMetricsConfig = TelemetryMetricsConfig()
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -431,6 +459,8 @@ class DeepSpeedConfig:
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}) or {})
         self.resilience_config = ResilienceConfig(
             **pd.get("resilience", {}) or {})
+        self.telemetry_config = TelemetryConfig(
+            **pd.get("telemetry", {}) or {})
 
         self.gradient_accumulation_dtype = self.data_types_config.grad_accum_dtype
 

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
+from .. import telemetry as _telemetry
 from ..accelerator import get_accelerator
 from ..utils import groups
 from ..utils.logging import log_dist, logger
@@ -352,6 +353,26 @@ class DeepSpeedEngine:
         # ---------------------------------------------------------- monitor
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
+
+        # --------------------------------------------------------- telemetry
+        # (docs/observability.md) — enabling it wires the structured-event
+        # spine: step spans + JSONL records, comm attribution, metrics
+        # registry with the monitor as a sink.  Reading loss/grad-norm for
+        # the step record costs one device sync per boundary, same as the
+        # finite-grad guard; disabled (default) every emit site below is a
+        # single module-attribute check.
+        self._tel_step_tokens = 0
+        tc = config.telemetry_config
+        if tc.enabled:
+            _telemetry.configure(tc, monitor=self.monitor,
+                                 rank=jax.process_index())
+            _telemetry.metadata("mesh", {k: int(v) for k, v in
+                                         dict(self.mesh.shape).items()})
+            _telemetry.metadata("zero_partition_plan", self.plan.describe())
+            _telemetry.metadata("config_hash", config.config_hash())
+            _telemetry.gauge(
+                "train/zero_stage",
+                help="configured ZeRO stage").set(self.zero_stage)
 
         # -------------------------------------------------------- resilience
         rs = config.resilience_config
@@ -1284,6 +1305,14 @@ class DeepSpeedEngine:
         if not self.training:
             return self._eval_forward(inputs, kwargs)
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if _telemetry.enabled:
+            _telemetry.begin_step(self.global_steps)
+            _telemetry.begin_span(_telemetry.SPAN_FORWARD)
+            shape = np.shape(inputs[0]) if inputs else ()
+            # batch×seq tokens this micro-batch, for tokens/s in the record
+            self._tel_step_tokens += (int(np.prod(shape[:2]))
+                                      if len(shape) >= 2
+                                      else int(shape[0]) if shape else 0)
         if self.progressive_layer_drop is not None:
             inputs = (*inputs,
                       np.float32(self.progressive_layer_drop.get_theta()),
@@ -1300,6 +1329,8 @@ class DeepSpeedEngine:
                 lambda g: jnp.full_like(g, jnp.nan), grads)
         self._stashed_grads = grads
         self._micro_losses.append(loss)  # device scalar; synced only on report
+        if _telemetry.enabled:
+            _telemetry.end_span(_telemetry.SPAN_FORWARD)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._maybe_profile_flops(inputs)
         return loss
@@ -1375,6 +1406,8 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called without a prior forward() "
                                "in training mode")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if _telemetry.enabled:
+            _telemetry.begin_span(_telemetry.SPAN_BACKWARD)
         offloaded = getattr(self, "_host_offloaded", None)
         if offloaded and "grad_acc" in offloaded:
             # grads offloaded mid-accumulation: restore BEFORE the None
@@ -1383,12 +1416,18 @@ class DeepSpeedEngine:
             self.grad_acc = jax.tree_util.tree_map(jax.device_put, host,
                                                    shardings)
             del offloaded["grad_acc"]
+        if _telemetry.enabled:
+            # the fold that triggers the (GSPMD-lowered) DP grad reduction —
+            # device-side reduce time lands inside this span under fence mode
+            _telemetry.begin_span(_telemetry.SPAN_GRAD_REDUCE)
         if self.grad_acc is None:
             self.grad_acc = self._stashed_grads
         else:
             if not hasattr(self, "_acc_fn"):
                 self._acc_fn = self._accumulate_fn()
             self.grad_acc = self._acc_fn(self.grad_acc, self._stashed_grads)
+        if _telemetry.enabled:
+            _telemetry.end_span(_telemetry.SPAN_GRAD_REDUCE)
         self._stashed_grads = None
         if (self._nvme_swapper is not None and self._state_on_nvme
                 and self.is_gradient_accumulation_boundary()):
@@ -1396,6 +1435,8 @@ class DeepSpeedEngine:
             # the backward compute tail (reference swap-in overlap,
             # stage3.py:1926)
             self._nvme_start_swap_in()
+        if _telemetry.enabled:
+            _telemetry.end_span(_telemetry.SPAN_BACKWARD)
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -1408,6 +1449,8 @@ class DeepSpeedEngine:
                     not getattr(self, "_host_offloaded", None):
                 raise RuntimeError("step() at a grad-accum boundary without "
                                    "any backward() since the last boundary")
+            if _telemetry.enabled:
+                _telemetry.begin_span(_telemetry.SPAN_OPTIMIZER)
             host_gnorm = self._try_host_offload_step()
             if host_gnorm is not None:
                 skipped = jnp.zeros((), jnp.bool_)
@@ -1429,6 +1472,8 @@ class DeepSpeedEngine:
                 if self._nvme_swapper is not None:
                     # updated state back to disk (async; overlaps next fwd)
                     self._nvme_swap_out()
+            if _telemetry.enabled:
+                _telemetry.end_span(_telemetry.SPAN_OPTIMIZER)
             if self._finite_guard.enabled:
                 self._account_guarded_step(skipped, gnorm)
             self.global_steps += 1
@@ -1455,6 +1500,8 @@ class DeepSpeedEngine:
                 self._last_loss = self._micro_losses
                 self._micro_losses = []
             self._report_step_metrics(gnorm)
+            if _telemetry.enabled:
+                self._telemetry_step_end(skipped, gnorm)
             if self._heartbeat is not None:
                 # liveness signal for the elastic agent's watchdog: one
                 # atomic file write per optimizer step
@@ -1490,6 +1537,58 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown_enabled:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+
+    def _telemetry_step_end(self, skipped, gnorm):
+        """Close the telemetry step window with the boundary's numbers and
+        refresh the live-metrics registry.  Reading loss/grad-norm/skip
+        forces one device sync per boundary — the documented cost of
+        telemetry ON (mirrors the finite-grad guard)."""
+        metrics = {}
+        ll = self._last_loss
+        try:
+            if ll is not None:
+                metrics["loss"] = (float(np.mean([float(l) for l in ll]))
+                                   if isinstance(ll, list) else float(ll))
+            metrics["grad_norm"] = float(jax.device_get(gnorm))
+            metrics["skipped"] = float(jax.device_get(skipped))
+        except Exception as e:   # telemetry must never kill a step
+            logger.warning("telemetry: step metric read failed (%s)", e)
+        if self._config.fp16_enabled:
+            metrics["loss_scale"] = self.cur_scale
+        metrics["samples"] = self.train_batch_size()
+        tokens = self._tel_step_tokens
+        self._tel_step_tokens = 0
+        if tokens:
+            metrics["tokens"] = tokens
+        metrics["lr"] = self.get_lr()[0]
+        record = _telemetry.end_step(metrics=metrics)
+        reg = _telemetry.get_registry()
+        if reg is not None:
+            reg.counter("train/steps",
+                        help="optimizer steps completed").inc()
+            if metrics.get("skipped"):
+                reg.counter("train/skipped_steps",
+                            help="boundary updates skipped (overflow/"
+                            "finite-grad guard)").inc()
+            if "loss" in metrics:
+                reg.gauge("train/loss").set(metrics["loss"])
+            if "grad_norm" in metrics:
+                reg.gauge("train/grad_norm").set(metrics["grad_norm"])
+            if record is not None:
+                wall_s = record["wall_ms"] / 1e3
+                reg.histogram("train/step_seconds",
+                              help="optimizer-step wall time").observe(
+                                  wall_s)
+                reg.gauge("train/exposed_comm_fraction",
+                          help="host-exposed comm time / step wall time"
+                          ).set(record["comm"]["exposed_comm_fraction"])
+                if tokens and wall_s > 0:
+                    reg.gauge(
+                        "train/tokens_per_sec_per_chip",
+                        help="tokens/s/chip over the last step").set(
+                            tokens / wall_s / max(1, jax.device_count()))
+        if self.global_steps % self._config.steps_per_print == 0:
+            _telemetry.export_metrics(step=self.global_samples)
 
     def train_batch(self, data_iter=None):
         """Convenience full-batch step (forward+backward+step × GAS)."""

@@ -232,6 +232,31 @@ class ZeroPartitionPlan:
                 self.param_axes = self.state_axes = zp_axes
             elif stage >= 3:
                 self.param_mesh, self.param_axes = hpz_mesh, zp_axes
+        from ... import telemetry as _telemetry
+        if _telemetry.enabled:
+            # re-plans (elastic rescale, hpZ factoring changes) land in the
+            # trace as metadata; the engine also emits this at bring-up
+            _telemetry.metadata("zero_partition_plan", self.describe())
+
+    def describe(self):
+        """JSON-safe summary of the sharding policy — trace metadata and
+        the autotuner's record of what configuration produced a trace."""
+        co = self.comm_opts
+        return {
+            "stage": self.stage,
+            "zero_axes": list(self.zero_axes),
+            "param_axes": list(self.param_axes),
+            "state_axes": list(self.state_axes),
+            "min_partition_size": int(self.min_partition_size),
+            "offload_optimizer": bool(self.offload_optimizer),
+            "offload_param": bool(self.offload_param),
+            "tp_rules": len(self.tp_rules),
+            "hierarchical_reduce": self.hierarchical_reduce(),
+            "grad_wire": list(self.grad_wire()),
+            "param_wire": list(self.param_wire()),
+            "comm_optimizations_enabled": bool(
+                co is not None and getattr(co, "enabled", False)),
+        }
 
     # wire formats ----------------------------------------------------------
     # The quantized ZeRO hot paths (zeropp.py qwZ/qgZ) ask the plan what to

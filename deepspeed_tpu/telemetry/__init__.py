@@ -1,0 +1,186 @@
+"""deepspeed_tpu.telemetry — the unified observability spine.
+
+One structured-event model for everything the stack can measure:
+
+* **step traces** (:mod:`.trace`): per-step spans (forward / backward /
+  grad-reduce / optimizer / checkpoint) → Chrome-trace JSON + per-step
+  JSONL records;
+* **comm attribution** (:mod:`.comm_attribution`): wire-truthful bytes from
+  ``utils/comms_logging`` joined with span timing → per-``op[variant]``
+  latency, effective wire bandwidth, exposed-comm-fraction;
+* **live metrics** (:mod:`.metrics`): counters/gauges/histograms with the
+  ``monitor/`` backends as sinks plus a Prometheus text endpoint.
+
+Disabled (the default) means **zero overhead**: every emit site in the hot
+path guards on the module-level :data:`enabled` flag —
+
+    from deepspeed_tpu import telemetry
+    if telemetry.enabled:
+        telemetry.record_comm_event(...)
+
+one attribute read, no allocations, no dict churn.  ``configure()`` (called
+by the engine when the ``telemetry`` config block enables it) flips the
+flag and builds the recorder/registry; ``shutdown()`` flushes and flips it
+back.  This module must stay import-light: ``comm/comm.py`` imports it at
+module scope.
+"""
+
+from .comm_attribution import CommAttribution  # noqa: F401  (re-export)
+from .metrics import (MetricsRegistry, MonitorSink,  # noqa: F401
+                      PrometheusEndpoint, render_prometheus)
+from .trace import (PHASES, SPAN_BACKWARD, SPAN_CHECKPOINT,  # noqa: F401
+                    SPAN_FORWARD, SPAN_GRAD_REDUCE, SPAN_OPTIMIZER,
+                    STEPS_FILE, TRACE_FILE, TraceRecorder)
+
+#: THE flag every emit site guards on.  Only configure()/shutdown() write it.
+enabled = False
+
+_recorder = None
+_registry = None
+_sinks = []
+_endpoint = None
+_rank = 0
+
+
+def get_recorder():
+    """The active :class:`TraceRecorder`, or None (metrics-only mode)."""
+    return _recorder
+
+
+def get_registry():
+    """The active :class:`MetricsRegistry`, or None when disabled."""
+    return _registry
+
+
+def configure(cfg, monitor=None, rank=0):
+    """Enable telemetry from a ``TelemetryConfig``-shaped object (duck-typed:
+    ``trace_dir``/``trace_steps``/``fence``/``device_profiler`` plus a
+    ``metrics`` sub-object).  Reconfiguring tears the previous instance down
+    first.  Returns (recorder, registry)."""
+    global enabled, _recorder, _registry, _sinks, _endpoint, _rank
+    shutdown()
+    _rank = int(rank)
+    trace_dir = getattr(cfg, "trace_dir", "") or "telemetry"
+    _recorder = TraceRecorder(
+        trace_dir,
+        fence=getattr(cfg, "fence", False),
+        device_annotations=getattr(cfg, "device_profiler", False),
+        trace_steps=getattr(cfg, "trace_steps", 0),
+        rank=_rank)
+    _registry = MetricsRegistry()
+    _sinks = []
+    mc = getattr(cfg, "metrics", None)
+    metrics_on = getattr(mc, "enabled", True) if mc is not None else True
+    rank0_only = getattr(mc, "rank0_only", True) if mc is not None else True
+    exporting = metrics_on and (not rank0_only or _rank == 0)
+    if exporting and monitor is not None and \
+            getattr(monitor, "enabled", False):
+        _sinks.append(MonitorSink(monitor))
+    port = getattr(mc, "prometheus_port", 0) if mc is not None else 0
+    if exporting and port:
+        try:
+            _endpoint = PrometheusEndpoint(
+                _registry, port, labels={"rank": _rank}).start()
+        except OSError as e:
+            from ..utils.logging import logger
+            logger.warning("telemetry: Prometheus endpoint on port %s "
+                           "unavailable (%s); text rendering still works",
+                           port, e)
+            _endpoint = None
+    enabled = True
+    return _recorder, _registry
+
+
+def shutdown():
+    """Flush traces, stop the endpoint, drop back to zero-overhead mode."""
+    global enabled, _recorder, _registry, _sinks, _endpoint
+    enabled = False
+    if _endpoint is not None:
+        _endpoint.stop()
+        _endpoint = None
+    if _recorder is not None:
+        _recorder.close()
+        _recorder = None
+    _registry = None
+    _sinks = []
+
+
+# --------------------------------------------------------------- emit helpers
+# All assume the caller already checked ``telemetry.enabled`` (the zero-
+# overhead contract) but stay safe to call mid-shutdown.
+
+def begin_step(step):
+    if _recorder is not None:
+        _recorder.begin_step(step)
+
+
+def end_step(metrics=None):
+    """Returns the just-written step record (dict) or None."""
+    if _recorder is not None:
+        return _recorder.end_step(metrics=metrics)
+    return None
+
+
+def begin_span(name, cat="compute", **args):
+    if _recorder is not None:
+        _recorder.begin_span(name, cat=cat, **args)
+
+
+def end_span(name=None):
+    if _recorder is not None:
+        _recorder.end_span(name)
+
+
+def span(name, cat="compute", **args):
+    """Context-manager span for call sites with natural with-scoping
+    (checkpoint engine, tools); the engine hot path uses begin/end."""
+    if _recorder is not None:
+        return _recorder.span(name, cat=cat, **args)
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def record_comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
+                      world_size=1):
+    if _recorder is not None:
+        _recorder.comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
+                             world_size)
+
+
+def metadata(name, payload):
+    if _recorder is not None:
+        _recorder.metadata(name, payload)
+
+
+def counter(name, help=""):
+    return _registry.counter(name, help=help) if _registry is not None \
+        else None
+
+
+def gauge(name, help=""):
+    return _registry.gauge(name, help=help) if _registry is not None \
+        else None
+
+
+def observe(name, value, help="", buckets=None):
+    """Histogram observation (checkpoint/save durations etc.)."""
+    if _registry is None:
+        return
+    from .metrics import DEFAULT_BUCKETS
+    h = _registry.histogram(name, help=help,
+                            buckets=buckets or DEFAULT_BUCKETS)
+    h.observe(value)
+
+
+def export_metrics(step=0):
+    """Push the registry through the configured sinks (engine calls this at
+    its ``steps_per_print`` cadence on the exporting rank)."""
+    if _registry is not None and _sinks:
+        _registry.export(_sinks, step=step)
+
+
+def prometheus_text():
+    """Render the live registry in Prometheus exposition format."""
+    if _registry is None:
+        return ""
+    return render_prometheus(_registry, labels={"rank": _rank})

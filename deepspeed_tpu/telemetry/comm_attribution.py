@@ -1,0 +1,98 @@
+"""Comm attribution — joining wire-truthful comm records with step timing.
+
+``utils/comms_logging`` knows what each collective *transported* (logical
+message bytes, post-quantization wire bytes, the ``op[variant]`` name);
+the :class:`~deepspeed_tpu.telemetry.trace.TraceRecorder` knows *when* each
+step ran.  This module is the join: per-step, per-``op[variant]`` latency,
+effective wire bandwidth, and the **exposed-comm-fraction** estimate — the
+number the backward-overlap scheduler and the comm autotuner (ROADMAP items
+1 and 5) will optimize.
+
+Semantics of "exposed": the host-observed latency of an eager collective is
+time the dispatching thread actually waited — with ``telemetry.fence`` (or
+``comms_logger.sync_timing``) it is the true blocked wall time; without it,
+it is the dispatch cost and any backpressure XLA applied.  Communication
+issued *inside* a compiled step is scheduled by XLA and shows up in the
+compute phases instead — it is hidden by construction, which is exactly
+what makes ``exposed_comm_fraction`` the overlap-efficiency metric: a
+perfect overlap schedule drives it to 0.
+"""
+
+
+def variant_key(op, variant=None):
+    """Canonical record key: ``all_reduce`` or ``all_reduce[q_int8]``."""
+    return f"{op}[{variant}]" if variant else str(op)
+
+
+def split_variant_key(key):
+    """Inverse of :func:`variant_key` → ``(base_op, variant_or_None)``."""
+    if "[" in key and key.endswith("]"):
+        base, variant = key[:-1].split("[", 1)
+        return base, variant
+    return key, None
+
+
+def effective_gbps(wire_bytes, seconds):
+    """Wire bandwidth in Gbit/s from transported bytes (0 when unmeasured)."""
+    if seconds <= 0:
+        return 0.0
+    return wire_bytes * 8.0 / seconds / 1e9
+
+
+def exposed_fraction(exposed_seconds, window_seconds):
+    """Exposed-comm fraction of a step window, clamped into [0, 1] (a
+    latency sum can exceed the window when ops overlap each other)."""
+    if window_seconds <= 0:
+        return 0.0
+    return max(0.0, min(1.0, exposed_seconds / window_seconds))
+
+
+class CommAttribution:
+    """Accumulates per-``op[variant]`` comm records over one window (a step,
+    or a whole run) and summarizes latency / wire bandwidth."""
+
+    def __init__(self):
+        self._records = {}
+
+    def record(self, op, variant, msg_bytes, wire_bytes, latency_s,
+               world_size=1):
+        key = variant_key(op, variant)
+        r = self._records.get(key)
+        if r is None:
+            r = self._records[key] = {
+                "count": 0, "total_s": 0.0, "msg_bytes": 0, "wire_bytes": 0,
+                "world_size": int(world_size),
+            }
+        r["count"] += 1
+        r["total_s"] += float(latency_s)
+        r["msg_bytes"] += int(msg_bytes)
+        r["wire_bytes"] += int(wire_bytes if wire_bytes is not None
+                               else msg_bytes)
+        r["world_size"] = int(world_size)
+
+    @property
+    def empty(self):
+        return not self._records
+
+    def total_seconds(self):
+        return sum(r["total_s"] for r in self._records.values())
+
+    def summary(self):
+        """{key: {count, total_ms, avg_ms, msg_bytes, wire_bytes, gbps}} —
+        each record counted exactly once; a run that falls back from a
+        quantized variant to flat mid-run contributes its flat calls to the
+        flat row and its quantized calls to the ``[q_*]`` row, never both."""
+        out = {}
+        for key, r in sorted(self._records.items()):
+            out[key] = {
+                "count": r["count"],
+                "total_ms": r["total_s"] * 1e3,
+                "avg_ms": r["total_s"] * 1e3 / max(1, r["count"]),
+                "msg_bytes": r["msg_bytes"],
+                "wire_bytes": r["wire_bytes"],
+                "gbps": effective_gbps(r["wire_bytes"], r["total_s"]),
+            }
+        return out
+
+    def reset(self):
+        self._records = {}

@@ -1,0 +1,299 @@
+"""Metrics registry: counters / gauges / histograms with pluggable sinks.
+
+The registry is the live-metrics half of the telemetry spine.  Emit sites
+(engine step metrics, checkpoint durations, watchdog heartbeats, collective
+variant picks) update instruments in memory; sinks export snapshots:
+
+* :class:`MonitorSink` — fans a snapshot out through the existing
+  ``monitor/`` backends (TensorBoard / W&B / CSV / Comet), making them
+  sinks of the unified registry instead of a parallel event path;
+* :func:`render_prometheus` — Prometheus text exposition format, served
+  live by :class:`PrometheusEndpoint` (a tiny stdlib HTTP server) or
+  scraped from the returned string;
+* :meth:`MetricsRegistry.snapshot` / :meth:`merge` — the rank-0
+  aggregation path: non-zero ranks snapshot, ship the dict (e.g. over
+  ``dist.send_obj``), and rank 0 merges before exporting, so dashboards see
+  one job-level series instead of world_size disjoint ones.
+
+Instrument names use ``/`` as the namespace separator (monitor-style);
+Prometheus rendering sanitizes them to ``_``.
+"""
+
+import math
+import re
+import threading
+
+from ..utils.logging import logger
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0, 300.0)
+
+
+class Counter:
+    __slots__ = ("name", "help", "value")
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, n=1.0):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "help", "value")
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = float(v)
+
+    def inc(self, n=1.0):
+        self.value += n
+
+    def dec(self, n=1.0):
+        self.value -= n
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations ≤ its upper bound; +Inf is implicit = count)."""
+
+    __slots__ = ("name", "help", "buckets", "counts", "count", "sum")
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * len(self.buckets)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        for i, bound in enumerate(self.buckets):
+            if v <= bound:
+                self.counts[i] += 1
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+
+    def __init__(self):
+        self._instruments = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name, cls, help, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help=help, **kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{inst.kind}, requested {cls.kind}")
+            return inst
+
+    def counter(self, name, help=""):
+        return self._get(name, Counter, help)
+
+    def gauge(self, name, help=""):
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        return self._get(name, Histogram, help, buckets=buckets)
+
+    def instruments(self):
+        with self._lock:
+            return list(self._instruments.values())
+
+    def __len__(self):
+        return len(self._instruments)
+
+    # -------------------------------------------------- snapshot / aggregate
+    def snapshot(self):
+        """Plain-dict snapshot, pickle/JSON-safe — the wire format of the
+        rank-0 aggregation path."""
+        out = {}
+        for inst in self.instruments():
+            if inst.kind == "histogram":
+                out[inst.name] = {"kind": "histogram",
+                                  "buckets": list(inst.buckets),
+                                  "counts": list(inst.counts),
+                                  "count": inst.count, "sum": inst.sum}
+            else:
+                out[inst.name] = {"kind": inst.kind, "value": inst.value}
+        return out
+
+    def merge(self, snapshot):
+        """Fold another rank's :meth:`snapshot` into this registry:
+        counters and histograms sum; gauges keep the max (the conservative
+        job-level read for ages/backlogs)."""
+        for name, rec in snapshot.items():
+            kind = rec.get("kind")
+            if kind == "counter":
+                self.counter(name).inc(rec.get("value", 0.0))
+            elif kind == "gauge":
+                g = self.gauge(name)
+                g.set(max(g.value, rec.get("value", 0.0)))
+            elif kind == "histogram":
+                h = self.histogram(name,
+                                   buckets=rec.get("buckets",
+                                                   DEFAULT_BUCKETS))
+                if list(h.buckets) == [float(b) for b in
+                                       rec.get("buckets", [])]:
+                    for i, c in enumerate(rec.get("counts", [])):
+                        h.counts[i] += int(c)
+                else:
+                    logger.warning("telemetry: bucket mismatch merging "
+                                   "histogram %r; folding count/sum only",
+                                   name)
+                h.count += int(rec.get("count", 0))
+                h.sum += float(rec.get("sum", 0.0))
+
+    def export(self, sinks, step=0):
+        """Push the current snapshot to each sink; a failing sink warns and
+        is skipped — metrics export must never kill a training step."""
+        for sink in sinks:
+            try:
+                sink.write(self, step)
+            except Exception as e:
+                logger.warning("telemetry: sink %s failed (%s: %s)",
+                               type(sink).__name__, type(e).__name__, e)
+
+
+class MonitorSink:
+    """Adapter: the ``monitor/`` backends (TB / W&B / CSV / Comet) become
+    sinks of the unified registry.  Histograms export as ``_mean`` +
+    ``_count`` scalars (the backends are scalar streams)."""
+
+    def __init__(self, monitor, prefix="Telemetry/"):
+        self.monitor = monitor
+        self.prefix = prefix
+
+    def write(self, registry, step):
+        if self.monitor is None or not getattr(self.monitor, "enabled",
+                                               False):
+            return
+        events = []
+        for inst in registry.instruments():
+            name = self.prefix + inst.name
+            if inst.kind == "histogram":
+                events.append((name + "_mean", inst.mean, step))
+                events.append((name + "_count", float(inst.count), step))
+            else:
+                events.append((name, float(inst.value), step))
+        if events:
+            self.monitor.write_events(events)
+
+
+# ------------------------------------------------------------- prometheus
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name):
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt(v):
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def render_prometheus(registry, labels=None):
+    """Prometheus text exposition (version 0.0.4) of the registry."""
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{_prom_name(k)}="{v}"'
+                         for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    lines = []
+    for inst in sorted(registry.instruments(), key=lambda i: i.name):
+        name = _prom_name(inst.name)
+        if inst.help:
+            lines.append(f"# HELP {name} {inst.help}")
+        lines.append(f"# TYPE {name} {inst.kind}")
+        if inst.kind == "histogram":
+            for bound, c in zip(inst.buckets, inst.counts):
+                le = (f'{{le="{_fmt(bound)}"' +
+                      (("," + label_str[1:]) if label_str else "}"))
+                lines.append(f"{name}_bucket{le} {c}")
+            inf_label = ('{le="+Inf"' +
+                         (("," + label_str[1:]) if label_str else "}"))
+            lines.append(f"{name}_bucket{inf_label} {inst.count}")
+            lines.append(f"{name}_sum{label_str} {_fmt(inst.sum)}")
+            lines.append(f"{name}_count{label_str} {inst.count}")
+        else:
+            lines.append(f"{name}{label_str} {_fmt(inst.value)}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusEndpoint:
+    """Threaded stdlib HTTP server exposing ``/metrics``.  Start on rank 0
+    only — the registry it serves is the post-:meth:`~MetricsRegistry.merge`
+    aggregate."""
+
+    def __init__(self, registry, port, host="0.0.0.0", labels=None):
+        self.registry = registry
+        self.port = int(port)
+        self.host = host
+        self.labels = labels or {}
+        self._server = None
+        self._thread = None
+
+    def start(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        registry, labels = self.registry, self.labels
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.rstrip("/") not in ("", "/metrics",
+                                                 "/healthz"):
+                    self.send_error(404)
+                    return
+                body = render_prometheus(registry, labels).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # keep training logs clean
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="ds-tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("telemetry: Prometheus endpoint on :%d/metrics",
+                    self.port)
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None

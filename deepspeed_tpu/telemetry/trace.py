@@ -1,0 +1,342 @@
+"""Structured step traces: spans → Chrome-trace JSON + per-step JSONL.
+
+The :class:`TraceRecorder` is the event spine every subsystem emits into
+(engine phases, collectives, checkpoint engine, watchdog).  Two outputs:
+
+* ``trace.json`` — Chrome trace-event format (load in ``chrome://tracing``
+  or https://ui.perfetto.dev): one complete-event (``"ph": "X"``) per span,
+  comm ops on their own track, written on :meth:`close` (and at interpreter
+  exit as a backstop);
+* ``steps.jsonl`` — one compact JSON record per optimizer step, appended as
+  the step ends: wall time, per-phase breakdown, per-``op[variant]`` comm
+  attribution with the exposed-comm-fraction estimate, and engine metrics
+  (loss, grad norm, throughput).  This is what ``tools/trace_report.py``
+  and the future autotuner ingest.
+
+Timing is host wall time (``time.perf_counter``).  With ``fence=True`` the
+recorder blocks on the accelerator at phase boundaries, so phase times are
+CPU-accurate attributions instead of async-dispatch shadows — the same
+trade ``comms_logger.sync_timing`` makes, documented in
+docs/observability.md.  With ``device_annotations=True`` spans additionally
+wrap ``jax.profiler`` annotations so an xplane capture
+(``engine.start_device_trace``) carries the phase names into the
+device-time view.
+"""
+
+import atexit
+import json
+import os
+import sys
+import time
+
+from ..utils.logging import logger
+from .comm_attribution import CommAttribution, exposed_fraction
+
+# canonical phase names — the engine emits exactly these, and
+# tools/trace_report.py columns key off them
+SPAN_FORWARD = "forward"
+SPAN_BACKWARD = "backward"
+SPAN_GRAD_REDUCE = "grad_reduce"
+SPAN_OPTIMIZER = "optimizer"
+SPAN_CHECKPOINT = "checkpoint"
+
+PHASES = (SPAN_FORWARD, SPAN_BACKWARD, SPAN_GRAD_REDUCE, SPAN_OPTIMIZER,
+          SPAN_CHECKPOINT)
+
+TRACE_FILE = "trace.json"
+STEPS_FILE = "steps.jsonl"
+
+#: chrome-trace keys every complete event must carry (schema contract the
+#: unit tests and trace_report validate against)
+CHROME_EVENT_KEYS = ("name", "ph", "ts", "dur", "pid", "tid")
+
+_COMM_TID = 1  # comm ops render on their own track under each pid
+
+
+def _sync_device():
+    """Block until the accelerator drains (fence mode)."""
+    from ..accelerator import get_accelerator
+    get_accelerator().synchronize()
+
+
+class _SpanHandle:
+    """Context manager for one span; also usable via explicit begin/end."""
+
+    __slots__ = ("_rec", "name", "cat", "args", "_t0", "_annotation")
+
+    def __init__(self, rec, name, cat, args):
+        self._rec = rec
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = None
+        self._annotation = None
+
+    def __enter__(self):
+        self._rec._begin(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._rec._end(self)
+        return False
+
+
+class TraceRecorder:
+
+    def __init__(self, trace_dir, fence=False, device_annotations=False,
+                 trace_steps=0, rank=0, max_events=200_000,
+                 sync_fn=_sync_device):
+        self.trace_dir = os.path.abspath(trace_dir)
+        self.fence = bool(fence)
+        self.device_annotations = bool(device_annotations)
+        self.trace_steps = int(trace_steps)  # 0 = unbounded
+        self.rank = int(rank)
+        self.max_events = int(max_events)
+        self._sync = sync_fn
+        self._epoch = time.perf_counter()
+        self._events = []            # chrome complete events
+        self._meta = {}              # metadata blobs (zero plan, config, …)
+        self._dropped = 0
+        self._stack = []             # open _SpanHandle frames
+        self._steps_file = None
+        self._closed = False
+        # per-step state
+        self._step = None
+        self._step_t0 = None
+        self._step_annotation = None
+        self._phase_s = {}
+        self._step_comm = CommAttribution()
+        self._run_comm = CommAttribution()
+        self.steps_recorded = 0
+        os.makedirs(self.trace_dir, exist_ok=True)
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------- internals
+    def _now_us(self):
+        return (time.perf_counter() - self._epoch) * 1e6
+
+    def _emit(self, name, cat, ts_us, dur_us, tid=0, args=None):
+        if len(self._events) >= self.max_events:
+            self._dropped += 1
+            return
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": ts_us,
+              "dur": dur_us, "pid": self.rank, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    @property
+    def recording(self):
+        """False once the trace_steps budget is spent — emit sites stay
+        cheap because the engine stops opening steps."""
+        return not self._closed and (
+            self.trace_steps <= 0 or self.steps_recorded < self.trace_steps)
+
+    # ----------------------------------------------------------------- spans
+    def span(self, name, cat="compute", **args):
+        """``with recorder.span("forward"): ...`` — spans nest; every span
+        feeds the per-step phase breakdown by name, so a nested phase
+        (``grad_reduce`` inside ``backward``) reports its own time AND is
+        contained in its parent's — phase columns are attributions, not a
+        partition of the wall time."""
+        return _SpanHandle(self, name, cat, args or None)
+
+    def begin_span(self, name, cat="compute", **args):
+        """Explicit-begin variant for linear call sites (engine hot path);
+        pair with :meth:`end_span`."""
+        h = _SpanHandle(self, name, cat, args or None)
+        self._begin(h)
+        return h
+
+    def end_span(self, name=None):
+        """Close the innermost open span (``name`` asserts intent; a
+        mismatch is logged, never raised — telemetry must not kill a
+        step)."""
+        if not self._stack:
+            logger.warning("telemetry: end_span(%r) with no open span", name)
+            return
+        h = self._stack[-1]
+        if name is not None and h.name != name:
+            logger.warning("telemetry: end_span(%r) closes open span %r",
+                           name, h.name)
+        self._end(h)
+
+    def _begin(self, h):
+        if self.fence:
+            self._sync()
+        if self.device_annotations:
+            try:
+                import jax
+                h._annotation = jax.profiler.TraceAnnotation(h.name)
+                h._annotation.__enter__()
+            except Exception:
+                h._annotation = None
+        self._stack.append(h)
+        h._t0 = time.perf_counter()
+
+    def _end(self, h):
+        if self.fence:
+            self._sync()
+        t1 = time.perf_counter()
+        if h._annotation is not None:
+            try:
+                h._annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+            h._annotation = None
+        try:
+            depth = self._stack.index(h)
+        except ValueError:
+            return  # already closed
+        # close anything left open underneath (exception unwound past it)
+        del self._stack[depth:]
+        dur = t1 - h._t0
+        self._emit(h.name, h.cat, (h._t0 - self._epoch) * 1e6, dur * 1e6,
+                   args=h.args)
+        if self._step is not None:
+            self._phase_s[h.name] = self._phase_s.get(h.name, 0.0) + dur
+
+    # ----------------------------------------------------------------- steps
+    def begin_step(self, step):
+        """Open the per-step record window.  Idempotent for the same step
+        index (forward() calls it once per micro-batch)."""
+        if self._step == step or not self.recording:
+            return
+        if self._step is not None:
+            self.end_step()   # unterminated previous window: flush it
+        self._step = step
+        self._step_t0 = time.perf_counter()
+        self._phase_s = {}
+        self._step_comm.reset()
+        if self.device_annotations:
+            try:
+                import jax
+                self._step_annotation = jax.profiler.StepTraceAnnotation(
+                    "train_step", step_num=step)
+                self._step_annotation.__enter__()
+            except Exception:
+                self._step_annotation = None
+
+    def end_step(self, metrics=None):
+        """Close the step window: emit the chrome step event and append one
+        JSONL record.  ``metrics`` is a flat dict of engine numbers (loss,
+        grad_norm, throughput, …) copied into the record verbatim."""
+        if self._step is None:
+            return
+        if self.fence:
+            self._sync()
+        if self._step_annotation is not None:
+            try:
+                self._step_annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._step_annotation = None
+        wall_s = time.perf_counter() - self._step_t0
+        step = self._step
+        self._step = None
+        self._emit(f"step {step}", "step",
+                   (self._step_t0 - self._epoch) * 1e6, wall_s * 1e6,
+                   tid=2, args={"step": step})
+        exposed_s = self._step_comm.total_seconds()
+        record = {
+            "step": step,
+            "wall_ms": wall_s * 1e3,
+            "phases": {k: v * 1e3 for k, v in sorted(self._phase_s.items())},
+            "comm": {
+                "total_ms": exposed_s * 1e3,
+                "exposed_ms": exposed_s * 1e3,
+                "exposed_comm_fraction": exposed_fraction(exposed_s, wall_s),
+                "ops": self._step_comm.summary(),
+            },
+        }
+        if metrics:
+            record["metrics"] = {k: v for k, v in metrics.items()
+                                 if v is not None}
+        self._append_step_record(record)
+        self.steps_recorded += 1
+        return record
+
+    def _append_step_record(self, record):
+        try:
+            if self._steps_file is None:
+                self._steps_file = open(
+                    os.path.join(self.trace_dir, STEPS_FILE), "a")
+            self._steps_file.write(json.dumps(record) + "\n")
+            self._steps_file.flush()
+        except (OSError, ValueError, TypeError) as e:
+            logger.warning("telemetry: step record write failed (%s)", e)
+
+    # ------------------------------------------------------------ comm + meta
+    def comm_event(self, op, variant, msg_bytes, wire_bytes, latency_s,
+                   world_size=1):
+        """One eager collective: chrome event on the comm track + join into
+        the per-step (and whole-run) attribution."""
+        if self._closed:
+            return
+        name = f"{op}[{variant}]" if variant else op
+        t1 = time.perf_counter()
+        self._emit(name, "comm", (t1 - latency_s - self._epoch) * 1e6,
+                   latency_s * 1e6, tid=_COMM_TID,
+                   args={"msg_bytes": int(msg_bytes),
+                         "wire_bytes": int(wire_bytes if wire_bytes
+                                           is not None else msg_bytes)})
+        self._run_comm.record(op, variant, msg_bytes, wire_bytes, latency_s,
+                              world_size)
+        if self._step is not None:
+            self._step_comm.record(op, variant, msg_bytes, wire_bytes,
+                                   latency_s, world_size)
+
+    def metadata(self, name, payload):
+        """Attach a structured metadata blob (zero plan, mesh, config hash);
+        lands under ``otherData`` in the chrome trace."""
+        try:
+            json.dumps(payload)
+        except (TypeError, ValueError):
+            payload = repr(payload)
+        self._meta[str(name)] = payload
+
+    def comm_summary(self):
+        """Whole-run per-``op[variant]`` attribution (``ds_bench --trace``
+        and the smoke tool read this)."""
+        return self._run_comm.summary()
+
+    # ---------------------------------------------------------------- output
+    def chrome_trace(self):
+        other = dict(self._meta)
+        other["rank"] = self.rank
+        if self._dropped:
+            other["dropped_events"] = self._dropped
+        return {"traceEvents": list(self._events),
+                "displayTimeUnit": "ms",
+                "otherData": other}
+
+    def write_chrome_trace(self, path=None):
+        path = path or os.path.join(self.trace_dir, TRACE_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+    def close(self):
+        """Flush both outputs.  Safe to call twice (atexit backstop)."""
+        if self._closed:
+            return
+        if self._step is not None:
+            self.end_step()
+        self._closed = True
+        atexit.unregister(self.close)  # bound-method equality: this entry
+        if self._dropped and not sys.is_finalizing():
+            logger.warning("telemetry: dropped %d trace events past the "
+                           "max_events=%d cap", self._dropped,
+                           self.max_events)
+        try:
+            self.write_chrome_trace()
+        except OSError as e:
+            logger.warning("telemetry: chrome trace write failed (%s)", e)
+        if self._steps_file is not None:
+            try:
+                self._steps_file.close()
+            except OSError:
+                pass
+            self._steps_file = None

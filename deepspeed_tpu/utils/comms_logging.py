@@ -87,7 +87,11 @@ class CommsLogger:
         """Record one collective.  ``msg_size`` is the logical tensor bytes;
         ``wire_size`` the transported bytes (defaults to msg_size for flat
         ops) — bandwidth is computed from the wire, because that is what the
-        links carried."""
+        links carried.  Entry slot 4 holds the TOTAL transported bytes for
+        the row (it used to be overwritten with the latest call's wire —
+        which double-counted quantized bytes into flat totals when an op
+        fell back from a quantized variant to flat mid-run and a stale wire
+        was re-attributed; totals now sum each call exactly once)."""
         wire = wire_size if wire_size is not None else msg_size
         name = f"{record_name}[{variant}]" if variant else record_name
         raw = f"{raw_name}[{variant}]" if variant else raw_name
@@ -99,7 +103,7 @@ class CommsLogger:
                 entry[1].append(latency)
                 entry[2].append(algbw)
                 entry[3].append(busbw)
-                entry[4] = wire
+                entry[4] += wire
             else:
                 self.comms_dict[name][msg_size] = [1, [latency], [algbw],
                                                    [busbw], wire]
@@ -122,11 +126,12 @@ class CommsLogger:
         for record_name, sizes in sorted(self.comms_dict.items()):
             lines.append(record_name)
             for msg_size, (count, latencies, algbws, busbws,
-                           wire) in sorted(sizes.items()):
+                           wire_total) in sorted(sizes.items()):
                 total = sum(latencies) * 1000
                 avg = total / count
                 avg_alg = sum(algbws) / len(algbws)
                 avg_bus = sum(busbws) / len(busbws)
+                wire = wire_total // count  # per-call transported bytes
                 lines.append(f"{'':<28}{msg_size:<16}{wire:<14}{count:<8}"
                              f"{total:<20.2f}{avg:<18.2f}{avg_alg:<18.2f}"
                              f"{avg_bus:<18.2f}")
@@ -134,3 +139,66 @@ class CommsLogger:
         if print_log:
             logger.info(out)
         return self.comms_dict
+
+    def get_summary_dict(self):
+        """Machine-readable counterpart of :meth:`log_all` — what the
+        telemetry tooling (``tools/trace_report.py``) and the future comm
+        autotuner ingest instead of scraping the printed table.
+
+        Returns::
+
+            {"ops": {"all_reduce[q_int8]": {"base_op", "variant",
+                 "count", "total_latency_ms", "avg_latency_ms",
+                 "total_msg_bytes", "total_wire_bytes",
+                 "algbw_gbps_avg", "busbw_gbps_avg",
+                 "msg_sizes": {bytes: {...per-size row...}}}, ...},
+             "totals": {"all_reduce": {"count", "total_latency_ms",
+                 "total_wire_bytes", "variants": [...]}, ...}}
+
+        ``totals`` aggregates across variants by base op, each recorded
+        call counted exactly once — an op that fell back from a quantized
+        variant to flat mid-run contributes each call to exactly one
+        variant row and once to its base-op total (no double-counting)."""
+        ops = {}
+        totals = {}
+        for name, sizes in sorted(self.comms_dict.items()):
+            if "[" in name and name.endswith("]"):
+                base, variant = name[:-1].split("[", 1)
+            else:
+                base, variant = name, None
+            op = {"base_op": base, "variant": variant, "count": 0,
+                  "total_latency_ms": 0.0, "total_msg_bytes": 0,
+                  "total_wire_bytes": 0, "algbw_gbps_avg": 0.0,
+                  "busbw_gbps_avg": 0.0, "msg_sizes": {}}
+            alg_all, bus_all = [], []
+            for msg_size, (count, latencies, algbws, busbws,
+                           wire_total) in sorted(sizes.items()):
+                total_ms = sum(latencies) * 1000
+                op["msg_sizes"][int(msg_size)] = {
+                    "count": count,
+                    "total_latency_ms": total_ms,
+                    "avg_latency_ms": total_ms / count,
+                    "wire_bytes_per_call": wire_total // count,
+                    "algbw_gbps_avg": sum(algbws) / len(algbws),
+                    "busbw_gbps_avg": sum(busbws) / len(busbws),
+                }
+                op["count"] += count
+                op["total_latency_ms"] += total_ms
+                op["total_msg_bytes"] += int(msg_size) * count
+                op["total_wire_bytes"] += int(wire_total)
+                alg_all += algbws
+                bus_all += busbws
+            if alg_all:
+                op["algbw_gbps_avg"] = sum(alg_all) / len(alg_all)
+                op["busbw_gbps_avg"] = sum(bus_all) / len(bus_all)
+            ops[name] = op
+            t = totals.setdefault(base, {"count": 0, "total_latency_ms": 0.0,
+                                         "total_msg_bytes": 0,
+                                         "total_wire_bytes": 0,
+                                         "variants": []})
+            t["count"] += op["count"]
+            t["total_latency_ms"] += op["total_latency_ms"]
+            t["total_msg_bytes"] += op["total_msg_bytes"]
+            t["total_wire_bytes"] += op["total_wire_bytes"]
+            t["variants"].append(variant or "flat")
+        return {"ops": ops, "totals": totals}

@@ -1,13 +1,23 @@
 """Timers — analog of reference ``deepspeed/utils/timer.py``.
 
-``SynchronizedWallClockTimer`` (reference ``timer.py:44``) with jax
-block_until_ready in place of CUDA events; ``ThroughputTimer`` (reference
-``timer.py:199``) reports samples/sec and TFLOPS.
+``SynchronizedWallClockTimer`` (reference ``timer.py:44``) with the
+accelerator abstraction's ``synchronize()`` in place of CUDA events;
+``ThroughputTimer`` (reference ``timer.py:199``) reports samples/sec with
+an optional smoothing window.
 """
 
 import time
+from collections import deque
 
 from .logging import log_dist
+
+
+def _device_synchronize():
+    """Device sync via the accelerator abstraction — the ONE place timers
+    touch the device, so non-jax accelerators (or tests stubbing the
+    accelerator) get correct synchronized timing for free."""
+    from ..accelerator import get_accelerator
+    get_accelerator().synchronize()
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
 FORWARD_GLOBAL_TIMER = "fwd"
@@ -50,19 +60,23 @@ class SynchronizedWallClockTimer:
                 self.records.append(elapsed * 1000.0)
             self.started_ = False
 
-        def _sync(self):
-            from ..accelerator import get_accelerator
-            get_accelerator().synchronize()
+        _sync = staticmethod(_device_synchronize)
 
         def elapsed(self, reset=True):
-            started = self.started_
-            if started:
-                self.stop(record=False)
+            """Accumulated seconds.  ``reset=False`` is a pure READ: a
+            running timer keeps running and nothing is folded or restarted
+            (previously the running segment was stopped into ``elapsed_``
+            and the timer restarted, so back-to-back reads mutated state
+            and dropped the sync/record options of the original start).
+            ``reset=True`` zeroes the accumulation; a running timer restarts
+            its segment at now."""
             elapsed = self.elapsed_
+            if self.started_:
+                elapsed += time.perf_counter() - self.start_time
             if reset:
-                self.reset()
-            if started:
-                self.start()
+                self.elapsed_ = 0.0
+                if self.started_:
+                    self.start_time = time.perf_counter()
             return elapsed
 
         def reset(self):
@@ -75,6 +89,10 @@ class SynchronizedWallClockTimer:
 
     def __init__(self):
         self.timers = {}
+
+    #: reference ``SynchronizedWallClockTimer.synchronize`` — device sync
+    #: through the accelerator abstraction
+    synchronize = staticmethod(_device_synchronize)
 
     def __call__(self, name):
         if name not in self.timers:
@@ -136,10 +154,15 @@ class NoopTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS reporting (reference ``timer.py:199``)."""
+    """Samples/sec + TFLOPS reporting (reference ``timer.py:199``).
+
+    ``smoothing_window``: with N > 0, :meth:`avg_samples_per_sec` averages
+    over the last N steps instead of the whole run — the number a live
+    dashboard wants (a data-loader hiccup 10k steps ago should not haunt
+    the reported throughput forever)."""
 
     def __init__(self, config, batch_size, start_step=2, steps_per_output=None,
-                 monitor_memory=False, logging_fn=None):
+                 monitor_memory=False, logging_fn=None, smoothing_window=None):
         self.config = config
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
@@ -153,6 +176,10 @@ class ThroughputTimer:
         self.step_elapsed_time = 0.0
         self.started = False
         self.start_time = 0.0
+        self.smoothing_window = smoothing_window
+        self._recent = (deque(maxlen=int(smoothing_window))
+                        if smoothing_window and smoothing_window > 0
+                        else None)
 
     @property
     def enabled(self):
@@ -179,6 +206,8 @@ class ThroughputTimer:
             if self.global_step_count >= self.start_step:
                 self.total_elapsed_time += duration
                 self.step_elapsed_time += duration
+                if self._recent is not None:
+                    self._recent.append(duration)
                 if report_speed and self.steps_per_output and \
                         self.global_step_count % self.steps_per_output == 0:
                     self.logging(
@@ -193,6 +222,8 @@ class ThroughputTimer:
                 self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self):
+        if self._recent:
+            return self.batch_size * len(self._recent) / sum(self._recent)
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
             samples = self.batch_size * (self.global_step_count - self.start_step + 1)
             return samples / self.total_elapsed_time

@@ -61,7 +61,15 @@ _jax_compat.install()
 import tempfile  # noqa: E402
 
 _cache_opt_in = os.environ.get("DS_TPU_TEST_CACHE")
-if _cache_opt_in:
+if os.environ.get("DS_TPU_TEST_NO_DISK_CACHE"):
+    # Debugging escape hatch: no disk cache at all — no executable ever
+    # takes the (broken-on-this-jaxlib) deserialization path.  Slower
+    # suite-wide; use to rule the cache in/out when chasing native crashes.
+    _cache_dir = None
+
+    def pytest_sessionfinish(session, exitstatus):
+        pass
+elif _cache_opt_in:
     import jaxlib
 
     _cache_dir = os.path.join(_cache_opt_in,
@@ -88,9 +96,10 @@ else:
         import shutil
         shutil.rmtree(_cache_dir, ignore_errors=True)
 
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+if _cache_dir is not None:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,30 @@
+"""Package-local harness tweak: no XLA disk compile cache for these tests.
+
+On this jax/jaxlib (0.4.3x CPU) executables that come back through the
+compilation-cache DEserialization path mishandle donated buffers — the
+known class behind the cross-run cache poisoning (see tests/conftest.py).
+It bites within a single process too: this package recreates near-identical
+engines over and over (save → restore → step), so the in-memory jit cache
+misses while the disk cache serves deserialized executables, and the
+post-restore compiled apply intermittently segfaults/aborts the whole
+pytest process (~50% of runs of this directory; 5/5 clean without the
+cache, at the same wall time — these tests spend their budget on I/O and
+tiny compiles, not on dedupable HLO).
+
+Scope is this package only: the rest of the suite keeps the disk cache and
+its ~40% wall-time win.
+"""
+
+import jax
+import pytest
+
+
+@pytest.fixture(scope="package", autouse=True)
+def _no_disk_compile_cache():
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if prev is None:
+        yield
+        return
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)

@@ -1,0 +1,108 @@
+"""Metrics registry: instruments, sinks (monitor backends, Prometheus text
++ HTTP endpoint), and the rank-0 snapshot/merge aggregation path."""
+
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry.metrics import (Histogram, MetricsRegistry,
+                                             MonitorSink, PrometheusEndpoint,
+                                             render_prometheus)
+
+
+def test_instruments_basic():
+    reg = MetricsRegistry()
+    c = reg.counter("train/steps")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    g = reg.gauge("train/loss")
+    g.set(1.5)
+    assert g.value == 1.5
+    h = reg.histogram("ckpt/save_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    assert h.count == 2 and h.counts == [1, 1]  # cumulative: 0.05 ≤ both
+    assert h.mean == pytest.approx(2.525)
+    # same name returns the same instrument; kind mismatch is loud
+    assert reg.counter("train/steps") is c
+    with pytest.raises(TypeError):
+        reg.gauge("train/steps")
+
+
+def test_monitor_sink_feeds_csv_backend(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import MonitorConfig
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "job"})
+    master = MonitorMaster(cfg)
+    reg = MetricsRegistry()
+    reg.gauge("train/loss").set(0.5)
+    reg.histogram("ckpt/save_seconds").observe(2.0)
+    reg.export([MonitorSink(master)], step=7)
+    out = tmp_path / "job"
+    assert (out / "Telemetry_train_loss.csv").exists()
+    assert "7,0.5" in (out / "Telemetry_train_loss.csv").read_text()
+    # histograms land as scalar _mean/_count series
+    assert (out / "Telemetry_ckpt_save_seconds_mean.csv").exists()
+
+
+def test_failing_sink_is_skipped():
+    class Boom:
+        def write(self, registry, step):
+            raise RuntimeError("sink down")
+
+    reg = MetricsRegistry()
+    reg.counter("a").inc()
+    reg.export([Boom()], step=0)  # must not raise
+
+
+def test_render_prometheus_format():
+    reg = MetricsRegistry()
+    reg.counter("train/steps", help="steps done").inc(4)
+    reg.gauge("train/exposed_comm_fraction").set(0.25)
+    h = reg.histogram("step_seconds", buckets=(0.5, 1.0))
+    h.observe(0.7)
+    text = render_prometheus(reg, labels={"rank": 0})
+    assert "# TYPE train_steps counter" in text
+    assert 'train_steps{rank="0"} 4.0' in text
+    assert "# HELP train_steps steps done" in text
+    assert 'step_seconds_bucket{le="0.5",rank="0"} 0' in text
+    assert 'step_seconds_bucket{le="+Inf",rank="0"} 1' in text
+    assert 'step_seconds_count{rank="0"} 1' in text
+    # names sanitized: "/" → "_", nothing else leaks through
+    assert "train/steps" not in text
+
+
+def test_prometheus_endpoint_serves_http():
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(2)
+    ep = PrometheusEndpoint(reg, port=0, host="127.0.0.1").start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ep.port}/metrics", timeout=10).read().decode()
+        assert "train_steps" in body
+        # live view: later updates visible to the next scrape
+        reg.counter("train/steps").inc()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ep.port}/metrics", timeout=10).read().decode()
+        assert "train_steps 3.0" in body
+    finally:
+        ep.stop()
+
+
+def test_snapshot_merge_rank0_aggregation():
+    """Counters/histograms sum across ranks, gauges keep the max — the
+    conservative job-level read for ages/backlogs."""
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    for reg, n in ((r0, 2), (r1, 3)):
+        reg.counter("train/steps").inc(n)
+        reg.gauge("elastic/heartbeat_age_seconds").set(float(n))
+        reg.histogram("step_seconds", buckets=(1.0, 2.0)).observe(n * 0.5)
+    r0.merge(r1.snapshot())
+    assert r0.counter("train/steps").value == 5
+    assert r0.gauge("elastic/heartbeat_age_seconds").value == 3.0
+    h = r0.histogram("step_seconds", buckets=(1.0, 2.0))
+    assert h.count == 2 and h.sum == pytest.approx(2.5)
+    assert h.counts == [1, 2]  # 1.0 ≤ 1.0; both ≤ 2.0

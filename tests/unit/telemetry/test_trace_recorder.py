@@ -1,0 +1,180 @@
+"""TraceRecorder: span nesting, disabled-mode zero-emission, chrome-trace
+schema validity, step records + exposed-comm-fraction, fence mode."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry.trace import (CHROME_EVENT_KEYS, STEPS_FILE,
+                                           TRACE_FILE, TraceRecorder)
+
+
+_live = []
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    yield
+    telemetry.shutdown()
+    # close stragglers NOW — an atexit-time close would write into pytest's
+    # torn-down tmp dirs and closed log streams
+    while _live:
+        _live.pop().close()
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("sync_fn", lambda: None)  # no device in these tests
+    rec = TraceRecorder(str(tmp_path), **kw)
+    _live.append(rec)
+    return rec
+
+
+def test_span_nesting_and_phase_attribution(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    with rec.span("backward"):
+        with rec.span("grad_reduce"):
+            pass
+    record = rec.end_step()
+    # nested span contributes its own phase AND its chrome event
+    assert set(record["phases"]) == {"backward", "grad_reduce"}
+    assert record["phases"]["grad_reduce"] <= record["phases"]["backward"]
+    names = [e["name"] for e in rec.chrome_trace()["traceEvents"]]
+    assert names.count("backward") == 1 and names.count("grad_reduce") == 1
+
+
+def test_begin_end_span_api_tolerates_mismatch(tmp_path, caplog):
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.begin_span("forward")
+    rec.end_span("forward")
+    rec.end_span("forward")  # unbalanced: warns, never raises
+    rec.end_step()
+    assert rec.steps_recorded == 1
+
+
+def test_chrome_trace_schema_valid(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.begin_step(3)
+    with rec.span("forward"):
+        pass
+    rec.comm_event("all_reduce", "q_int8", 4096, 1100, 0.002, 8)
+    rec.end_step(metrics={"loss": 1.0})
+    path = rec.write_chrome_trace()
+    trace = json.loads(open(path).read())   # json.loads: schema contract
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    for ev in trace["traceEvents"]:
+        for key in CHROME_EVENT_KEYS:
+            assert key in ev, (key, ev)
+        assert ev["ph"] == "X"
+    # comm events ride their own track with byte args
+    comm = [e for e in trace["traceEvents"]
+            if e["name"] == "all_reduce[q_int8]"]
+    assert comm and comm[0]["args"]["wire_bytes"] == 1100
+
+
+def test_step_record_stream_and_fraction(tmp_path):
+    rec = _recorder(tmp_path)
+    for step in range(3):
+        rec.begin_step(step)
+        with rec.span("forward"):
+            pass
+        rec.comm_event("reduce_scatter", None, 1 << 20, None, 0.001, 8)
+        rec.end_step()
+    rec.close()
+    lines = open(os.path.join(str(tmp_path), STEPS_FILE)).read().splitlines()
+    assert len(lines) == 3
+    for line in lines:
+        r = json.loads(line)
+        assert 0.0 <= r["comm"]["exposed_comm_fraction"] <= 1.0
+        assert r["comm"]["ops"]["reduce_scatter"]["count"] == 1
+    # per-step attribution resets between steps (count 1 each, not 1..3)
+
+
+def test_trace_steps_budget(tmp_path):
+    rec = _recorder(tmp_path, trace_steps=2)
+    for step in range(5):
+        rec.begin_step(step)
+        rec.end_step()
+    assert rec.steps_recorded == 2
+    assert not rec.recording
+
+
+def test_fence_mode_syncs_at_boundaries(tmp_path):
+    syncs = []
+    rec = TraceRecorder(str(tmp_path), fence=True,
+                        sync_fn=lambda: syncs.append(1))
+    _live.append(rec)
+    rec.begin_step(0)
+    with rec.span("forward"):
+        pass
+    rec.end_step()
+    assert len(syncs) >= 2  # span begin + end (+ step end)
+
+
+def test_disabled_mode_zero_emission(tmp_path, monkeypatch):
+    """With telemetry disabled the module emit helpers are inert: no
+    recorder, no files, span() hands back a nullcontext."""
+    monkeypatch.chdir(tmp_path)
+    assert not telemetry.enabled
+    assert telemetry.get_recorder() is None
+    assert telemetry.get_registry() is None
+    telemetry.begin_step(0)
+    telemetry.begin_span("forward")
+    telemetry.end_span("forward")
+    telemetry.record_comm_event("all_reduce", None, 4096, None, 0.001)
+    assert telemetry.end_step() is None
+    with telemetry.span("anything"):
+        pass
+    assert telemetry.counter("x") is None
+    telemetry.observe("y", 1.0)
+    assert telemetry.prometheus_text() == ""
+    assert os.listdir(str(tmp_path)) == []  # nothing written anywhere
+
+
+def test_configure_shutdown_roundtrip(tmp_path):
+    class MC:
+        enabled = True
+        prometheus_port = 0
+        rank0_only = True
+
+    class Cfg:
+        trace_dir = str(tmp_path)
+        trace_steps = 0
+        fence = False
+        device_profiler = False
+        metrics = MC()
+
+    rec, reg = telemetry.configure(Cfg())
+    assert telemetry.enabled and rec is telemetry.get_recorder()
+    telemetry.begin_step(0)
+    telemetry.end_step()
+    telemetry.shutdown()
+    assert not telemetry.enabled
+    # shutdown flushed the chrome trace
+    assert os.path.exists(os.path.join(str(tmp_path), TRACE_FILE))
+
+
+def test_unterminated_step_flushed_by_next_begin(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.begin_step(0)   # idempotent for the same step
+    rec.begin_step(1)   # flushes step 0
+    rec.end_step()
+    rec.close()
+    steps = [json.loads(l)["step"] for l in
+             open(os.path.join(str(tmp_path), STEPS_FILE))]
+    assert steps == [0, 1]
+
+
+def test_max_events_cap_drops_not_grows(tmp_path):
+    rec = _recorder(tmp_path, max_events=4)
+    for i in range(10):
+        with rec.span(f"s{i}"):
+            pass
+    trace = rec.chrome_trace()
+    assert len(trace["traceEvents"]) == 4
+    assert trace["otherData"]["dropped_events"] == 6

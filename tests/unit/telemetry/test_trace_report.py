@@ -1,0 +1,112 @@
+"""tools/trace_report.py: golden-output test on a canned JSONL fixture
+(importlib convention, same as test_bench_gate.py → bench.py)."""
+
+import importlib.util
+import json
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "trace_report", os.path.join(os.path.dirname(__file__), "..", "..",
+                                 "..", "tools", "trace_report.py"))
+trace_report = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(trace_report)
+
+
+FIXTURE = [
+    {"step": 0, "wall_ms": 100.0,
+     "phases": {"forward": 50.0, "backward": 30.0, "grad_reduce": 10.0,
+                "optimizer": 15.0},
+     "comm": {"total_ms": 20.0, "exposed_ms": 20.0,
+              "exposed_comm_fraction": 0.2,
+              "ops": {"all_reduce": {"count": 2, "total_ms": 8.0,
+                                     "avg_ms": 4.0, "msg_bytes": 2097152,
+                                     "wire_bytes": 2097152, "gbps": 2.097},
+                      "reduce_scatter[q_int8]": {
+                          "count": 2, "total_ms": 12.0, "avg_ms": 6.0,
+                          "msg_bytes": 4194304, "wire_bytes": 1114112,
+                          "gbps": 0.743}}},
+     "metrics": {"loss": 2.0, "tokens": 8192}},
+    {"step": 1, "wall_ms": 60.0,
+     "phases": {"forward": 25.0, "backward": 20.0, "grad_reduce": 5.0,
+                "optimizer": 10.0},
+     "comm": {"total_ms": 6.0, "exposed_ms": 6.0,
+              "exposed_comm_fraction": 0.1,
+              "ops": {"reduce_scatter[q_int8]": {
+                  "count": 2, "total_ms": 6.0, "avg_ms": 3.0,
+                  "msg_bytes": 4194304, "wire_bytes": 1114112,
+                  "gbps": 1.486}}},
+     "metrics": {"loss": 1.5, "tokens": 8192}},
+]
+
+GOLDEN = """\
+== per-step breakdown (ms) ==
+  step   wall_ms     forward    backward grad_reduce   optimizer   comm_ms  exposed_frac
+     0    100.00       50.00       30.00       10.00       15.00     20.00         0.200
+     1     60.00       25.00       20.00        5.00       10.00      6.00         0.100
+
+== run summary (2 steps) ==
+mean step wall: 80.00 ms | exposed comm: 13.00 ms | exposed-comm-fraction: 0.163
+tokens/s (all chips): 102400
+  backward            25.00 ms  (31.2%)
+  forward             37.50 ms  (46.9%)
+  grad_reduce          7.50 ms  ( 9.4%)
+  optimizer           12.50 ms  (15.6%)
+
+== collectives by op[variant] ==
+op[variant]                         count    avg_ms      wire  eff_Gbps
+all_reduce                              2     4.000    2.0MiB      2.10
+reduce_scatter[q_int8]                  4     4.500    2.1MiB      0.99"""
+
+
+def _write_fixture(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in FIXTURE))
+    return str(path)
+
+
+def test_golden_report(tmp_path):
+    path = _write_fixture(tmp_path)
+    steps = trace_report.load_steps(path)
+    summary = trace_report.summarize(steps)
+    lines = []
+    trace_report.render_report(steps, summary, print_fn=lines.append)
+    assert "\n".join(lines).rstrip() == GOLDEN
+
+
+def test_summary_numbers(tmp_path):
+    steps = trace_report.load_steps(_write_fixture(tmp_path))
+    s = trace_report.summarize(steps)
+    assert s["steps"] == 2
+    assert s["wall_ms_mean"] == 80.0
+    assert s["exposed_comm_fraction_mean"] == (26.0 / 160.0)
+    # per-variant rows merged across steps, each call counted once
+    rs = s["comm_ops"]["reduce_scatter[q_int8]"]
+    assert rs["count"] == 4 and rs["total_ms"] == 18.0
+    assert rs["wire_bytes"] == 2 * 1114112
+    assert s["comm_ops"]["all_reduce"]["count"] == 2
+
+
+def test_load_steps_skips_torn_lines(tmp_path, capsys):
+    path = tmp_path / "steps.jsonl"
+    path.write_text(json.dumps(FIXTURE[0]) + "\n" + '{"step": 1, "wall')
+    steps = trace_report.load_steps(str(path))
+    assert len(steps) == 1  # torn tail skipped, not fatal
+
+
+def test_cli_json_mode_and_chrome_validation(tmp_path, capsys):
+    _write_fixture(tmp_path)
+    (tmp_path / "trace.json").write_text(json.dumps({
+        "traceEvents": [{"name": "forward", "ph": "X", "ts": 0.0,
+                         "dur": 5.0, "pid": 0, "tid": 0}]}))
+    rc = trace_report.main([str(tmp_path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["chrome_trace"]["valid"]
+    assert out["steps"] == 2
+
+    # an event missing required keys is reported invalid
+    (tmp_path / "trace.json").write_text(json.dumps({
+        "traceEvents": [{"name": "forward"}]}))
+    ok, detail = trace_report.validate_chrome_trace(
+        str(tmp_path / "trace.json"))
+    assert not ok and "missing keys" in detail

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Step-time breakdown report over telemetry output.
+
+Ingests the per-step JSONL record stream (``steps.jsonl``) the
+``telemetry`` subsystem emits — optionally cross-checking the Chrome trace
+(``trace.json``) — and prints:
+
+1. a per-step table: wall time, phase breakdown (forward / backward /
+   grad_reduce / optimizer / checkpoint), host-exposed comm time and the
+   **exposed-comm-fraction** (exposed comm / step wall — the number the
+   backward-overlap scheduler and the comm autotuner optimize toward 0);
+2. an aggregate per-``op[variant]`` collective table: count, avg latency,
+   transported (wire) bytes, effective wire bandwidth — quantized/
+   hierarchical variants (``q_int8``, ``hier``, ``hier_q_*``) report
+   side-by-side with flat ops so a config's comm trajectory is one read.
+
+Usage:
+    python tools/trace_report.py <trace_dir | steps.jsonl> [--json] [--last N]
+
+``--json`` emits the machine-readable summary (the autotuner's input)
+instead of the tables.  Pure stdlib; no jax import — runs anywhere the
+trace files land.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+PHASE_COLUMNS = ("forward", "backward", "grad_reduce", "optimizer",
+                 "checkpoint")
+
+
+def load_steps(path):
+    """Parse step records from a ``steps.jsonl`` file or a directory
+    containing one.  Malformed lines are skipped with a note on stderr
+    (a run killed mid-write leaves a torn last line)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "steps.jsonl")
+    if not os.path.exists(path):
+        # e.g. a ds_bench --trace dir: collectives only, no train steps
+        print(f"# no step record stream at {path}", file=sys.stderr)
+        return []
+    steps, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if "step" in rec and "wall_ms" in rec:
+                steps.append(rec)
+    if bad:
+        print(f"# skipped {bad} malformed line(s) in {path}",
+              file=sys.stderr)
+    return steps
+
+
+def validate_chrome_trace(trace_path):
+    """Schema check of the Chrome trace: parses + required event keys.
+    Returns (ok, detail)."""
+    try:
+        with open(trace_path) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable: {e}"
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return False, "no traceEvents list"
+    required = ("name", "ph", "ts", "pid", "tid")
+    for i, ev in enumerate(events):
+        missing = [k for k in required if k not in ev]
+        if missing:
+            return False, f"event {i} missing keys {missing}"
+    return True, f"{len(events)} events"
+
+
+def summarize(steps):
+    """Aggregate a run: mean wall/phases, merged comm attribution, and the
+    exposed-comm-fraction series."""
+    n = len(steps)
+    phases = {}
+    comm_ops = {}
+    wall_total = 0.0
+    exposed_total = 0.0
+    tokens_total = 0
+    for rec in steps:
+        wall_total += rec.get("wall_ms", 0.0)
+        for name, ms in rec.get("phases", {}).items():
+            phases[name] = phases.get(name, 0.0) + ms
+        comm = rec.get("comm", {})
+        exposed_total += comm.get("exposed_ms", 0.0)
+        for key, row in comm.get("ops", {}).items():
+            agg = comm_ops.setdefault(key, {"count": 0, "total_ms": 0.0,
+                                            "msg_bytes": 0, "wire_bytes": 0})
+            agg["count"] += row.get("count", 0)
+            agg["total_ms"] += row.get("total_ms", 0.0)
+            agg["msg_bytes"] += row.get("msg_bytes", 0)
+            agg["wire_bytes"] += row.get("wire_bytes", 0)
+        tokens_total += rec.get("metrics", {}).get("tokens", 0)
+    for agg in comm_ops.values():
+        agg["avg_ms"] = agg["total_ms"] / max(1, agg["count"])
+        agg["gbps"] = (agg["wire_bytes"] * 8 / (agg["total_ms"] / 1e3) / 1e9
+                       if agg["total_ms"] > 0 else 0.0)
+    return {
+        "steps": n,
+        "wall_ms_mean": wall_total / n if n else 0.0,
+        "phases_ms_mean": {k: v / n for k, v in sorted(phases.items())},
+        "exposed_ms_mean": exposed_total / n if n else 0.0,
+        "exposed_comm_fraction_mean": (exposed_total / wall_total
+                                       if wall_total > 0 else 0.0),
+        "hidden_ms_mean": max(0.0, (wall_total - exposed_total) / n)
+        if n else 0.0,
+        "comm_ops": comm_ops,
+        "tokens_total": tokens_total,
+        "tokens_per_sec": (tokens_total / (wall_total / 1e3)
+                           if wall_total > 0 and tokens_total else 0.0),
+    }
+
+
+def _fmt_bytes(b):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if b < 1024 or unit == "GiB":
+            return f"{b:.0f}{unit}" if unit == "B" else f"{b:.1f}{unit}"
+        b /= 1024.0
+
+
+def render_report(steps, summary, last=None, print_fn=print):
+    """The human tables.  Deterministic for a given input (golden-output
+    tested)."""
+    shown = steps[-last:] if last else steps
+    cols = [p for p in PHASE_COLUMNS
+            if any(p in r.get("phases", {}) for r in shown)]
+    header = f"{'step':>6}{'wall_ms':>10}"
+    for p in cols:
+        header += f"{p:>12}"
+    header += f"{'comm_ms':>10}{'exposed_frac':>14}"
+    if shown:
+        print_fn("== per-step breakdown (ms) ==")
+        print_fn(header)
+        for rec in shown:
+            comm = rec.get("comm", {})
+            line = f"{rec['step']:>6}{rec['wall_ms']:>10.2f}"
+            for p in cols:
+                line += f"{rec.get('phases', {}).get(p, 0.0):>12.2f}"
+            line += (f"{comm.get('exposed_ms', 0.0):>10.2f}"
+                     f"{comm.get('exposed_comm_fraction', 0.0):>14.3f}")
+            print_fn(line)
+        print_fn("")
+        print_fn(f"== run summary ({summary['steps']} steps) ==")
+        print_fn(f"mean step wall: {summary['wall_ms_mean']:.2f} ms | "
+                 f"exposed comm: {summary['exposed_ms_mean']:.2f} ms | "
+                 f"exposed-comm-fraction: "
+                 f"{summary['exposed_comm_fraction_mean']:.3f}")
+        if summary["tokens_per_sec"]:
+            print_fn(f"tokens/s (all chips): {summary['tokens_per_sec']:.0f}")
+        for name, ms in summary["phases_ms_mean"].items():
+            frac = (ms / summary["wall_ms_mean"]
+                    if summary["wall_ms_mean"] > 0 else 0.0)
+            print_fn(f"  {name:<14} {ms:>10.2f} ms  ({frac:>5.1%})")
+        print_fn("")
+    print_fn("== collectives by op[variant] ==")
+    print_fn(f"{'op[variant]':<34}{'count':>7}{'avg_ms':>10}"
+             f"{'wire':>10}{'eff_Gbps':>10}")
+    if not summary["comm_ops"]:
+        print_fn("  (no eager collectives recorded — all comm ran inside "
+                 "compiled steps, i.e. fully hidden)")
+    for key, agg in sorted(summary["comm_ops"].items()):
+        print_fn(f"{key:<34}{agg['count']:>7}{agg['avg_ms']:>10.3f}"
+                 f"{_fmt_bytes(agg['wire_bytes']):>10}{agg['gbps']:>10.2f}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="trace_report",
+        description="step-time breakdown from telemetry steps.jsonl")
+    ap.add_argument("path", help="telemetry trace dir or steps.jsonl file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead of "
+                    "tables")
+    ap.add_argument("--last", type=int, default=None, metavar="N",
+                    help="only show the last N steps in the per-step table")
+    args = ap.parse_args(argv)
+
+    steps = load_steps(args.path)
+    summary = summarize(steps)
+    if not steps:
+        # steps-less trace (ds_bench --trace): report from the archived
+        # comm attribution alone instead of bailing
+        comm_path = (os.path.join(args.path, "comm_summary.json")
+                     if os.path.isdir(args.path) else
+                     os.path.join(os.path.dirname(args.path),
+                                  "comm_summary.json"))
+        if not os.path.exists(comm_path):
+            print("no step records found", file=sys.stderr)
+            return 1
+        with open(comm_path) as f:
+            summary["comm_ops"] = json.load(f).get("ops", {})
+
+    trace_path = (os.path.join(args.path, "trace.json")
+                  if os.path.isdir(args.path) else
+                  os.path.join(os.path.dirname(args.path), "trace.json"))
+    if os.path.exists(trace_path):
+        ok, detail = validate_chrome_trace(trace_path)
+        summary["chrome_trace"] = {"valid": ok, "detail": detail}
+
+    if args.json:
+        print(json.dumps(summary, indent=2))
+        return 0
+    render_report(steps, summary, last=args.last)
+    ct = summary.get("chrome_trace")
+    if ct:
+        state = "valid" if ct["valid"] else f"INVALID ({ct['detail']})"
+        print(f"\nchrome trace: {state} — load trace.json in "
+              "https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
