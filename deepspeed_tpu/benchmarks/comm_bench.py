@@ -37,6 +37,23 @@ WIRE_FORMAT = "int8"
 GROUP_SIZE = 2048
 
 
+def _timed(f, args, iters, warmup):
+    """Average per-call latency of ``f(*args)`` after ``warmup`` calls —
+    the one timing loop both sweeps share (block_until_ready fences the
+    async dispatch; safe with warmup=0)."""
+    import jax
+    out = None
+    for _ in range(warmup):
+        out = f(*args)
+    if out is not None:
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
 class UnsplittableAxis(ValueError):
     """The axis has no non-trivial (outer, inner) factorization — hier_*
     ops are skipped for it, every other error still fails the bench."""
@@ -144,18 +161,184 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
     else:
         raise ValueError(op)
 
-    for _ in range(warmup):
-        out = f(x)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(x)
-    jax.block_until_ready(out)
-    lat = (time.perf_counter() - t0) / iters
+    lat = _timed(f, (x, ), iters, warmup)
 
     from ..utils.comms_logging import calc_bw_log
     algbw, busbw = calc_bw_log(bw_op, wire_bytes, lat, n)
     return size_bytes, wire_bytes, lat, algbw, busbw
+
+
+# ------------------------------------------------------------ overlap sweep
+# Bucketed grad-reduce candidates (bucket size × wire dtype): how much of
+# the gradient-reduction time can hide under backward compute at each
+# bucket granularity?  Feeds the overlap scheduler's bucket_mb choice (see
+# docs/overlap.md) the way the op sweep feeds wire_dtype.
+
+OVERLAP_BUCKET_MBS = (1.0, 4.0, 16.0)
+OVERLAP_WIRES = ("fp32", "int8")
+OVERLAP_LAYERS = 8
+
+
+def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
+                       iters, warmup, recorder=None):
+    """Measure one (bucket_mb, wire_dtype) candidate.
+
+    Synthetic backward: a chain of matmul segments (the remaining backward
+    compute) + per-layer gradient leaves reduced over ``axis``.  Three
+    compiled programs — compute-only, comm-only (per bucket, so the trace
+    carries real per-bucket costs), and the bucketed overlapped step where
+    bucket *k*'s reduce is fenced to segment *k* of the compute chain via
+    ``optimization_barrier`` (grads "materialize" as backward progresses).
+    Overlap efficiency = hidden / total comm time, where
+    ``hidden = comm − exposed`` and ``exposed = step − compute``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..comm.collectives import quantized as Q
+    from ..runtime.zero.overlap import partition_buckets
+
+    n = mesh.shape[axis]
+    elems = total_bytes // 4 // layers
+    elems = max(n * GROUP_SIZE, elems // (n * GROUP_SIZE) * (n * GROUP_SIZE))
+    grads = [jnp.linspace(-1.0, 1.0, elems, dtype=jnp.float32)
+             for _ in range(layers)]
+    H = 256
+    x = jnp.ones((8, H), jnp.float32)
+    w = jnp.eye(H, dtype=jnp.float32) * 0.999
+
+    buckets = partition_buckets(
+        [(f"layer_{i}", g) for i, g in enumerate(grads)],
+        int(bucket_mb * (1 << 20)))
+
+    def reduce_leaf(g):
+        if wire == "fp32":
+            return jax.lax.psum_scatter(g, axis, scatter_dimension=0,
+                                        tiled=True)
+        return Q.all_to_all_quant_reduce(g, (axis, ), 0, n,
+                                         wire_format=wire,
+                                         group_size=GROUP_SIZE)
+
+    def sm(fn, out_specs):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P(), P(), P()), out_specs=out_specs,
+            check_vma=False))
+
+    def compute_only(x, w, grads):
+        cur = x
+        for _ in range(len(buckets)):
+            cur = cur @ w
+        return cur
+
+    def overlapped(x, w, grads):
+        cur = x
+        outs = [None] * len(grads)
+        for b in buckets:
+            cur = cur @ w
+            tied = jax.lax.optimization_barrier(
+                tuple(grads[i] for i in b.indices) + (cur, ))
+            cur = tied[-1]
+            for j, i in enumerate(b.indices):
+                outs[i] = reduce_leaf(tied[j])
+        return cur, tuple(outs)
+
+    def monolithic(x, w, grads):
+        cur = x
+        for _ in range(len(buckets)):
+            cur = cur @ w
+        tied = jax.lax.optimization_barrier(tuple(grads) + (cur, ))
+        return tied[-1], tuple(reduce_leaf(g) for g in tied[:-1])
+
+    out_grads = P(axis)  # both hops scatter the reduced shard over axis
+    args = (x, w, tuple(grads))
+    t_compute = _timed(sm(compute_only, P()), args, iters, warmup)
+    t_step = _timed(sm(overlapped, (P(), tuple(out_grads for _ in grads))),
+                    args, iters, warmup)
+    t_mono = _timed(sm(monolithic, (P(), tuple(out_grads for _ in grads))),
+                    args, iters, warmup)
+    # comm-only, per bucket — the trace carries real per-bucket costs
+    t_comm = 0.0
+    for b in buckets:
+        idx = b.indices
+
+        def bucket_fn(x, w, grads, _idx=idx):
+            return tuple(reduce_leaf(grads[i]) for i in _idx)
+
+        fn = sm(bucket_fn, tuple(out_grads for _ in idx))
+        if recorder is not None:
+            with recorder.bucket_span(b.index, nbytes=b.nbytes):
+                t_b = _timed(fn, args, iters, warmup)
+        else:
+            t_b = _timed(fn, args, iters, warmup)
+        t_comm += t_b
+
+    if wire == "fp32":
+        wire_bytes = elems * 4 * layers
+    else:
+        wire_bytes = Q.quantized_wire_bytes(elems, wire, GROUP_SIZE) * layers
+    exposed = max(0.0, t_step - t_compute)
+    hidden = min(t_comm, max(0.0, t_comm - exposed))
+    return {
+        "op": "overlap",
+        "bucket_mb": float(bucket_mb),
+        "wire_dtype": wire,
+        "buckets": len(buckets),
+        "bytes": int(elems * 4 * layers),
+        "wire_bytes": int(wire_bytes),
+        "layers": int(layers),
+        "compute_ms": t_compute * 1e3,
+        "comm_ms": t_comm * 1e3,
+        "step_ms": t_step * 1e3,
+        "monolithic_ms": t_mono * 1e3,
+        "hidden_ms": hidden * 1e3,
+        "exposed_ms": exposed * 1e3,
+        "exposed_comm_frac": (exposed / t_step if t_step > 0 else 0.0),
+        "overlap_efficiency": (hidden / t_comm if t_comm > 0 else 1.0),
+    }
+
+
+def run_overlap_sweep(axis="dp", mesh=None, bucket_mbs=OVERLAP_BUCKET_MBS,
+                      wires=OVERLAP_WIRES, total_mb=8.0,
+                      layers=OVERLAP_LAYERS, iters=10, warmup=2,
+                      print_fn=print, recorder=None):
+    """bucket_mb × wire_dtype sweep of the bucketed grad-reduce scheduler.
+    Returns candidate dicts (the ``--json`` rows / comm_summary ``overlap``
+    section)."""
+    from ..utils import groups
+    if mesh is None:
+        mesh = groups.get_mesh_state().mesh
+    print_fn(f"# overlap sweep: mesh={dict(mesh.shape)} axis={axis} "
+             f"total={total_mb}MiB layers={layers}")
+    print_fn(f"{'bucket_mb':>10}{'wire':>8}{'buckets':>9}{'compute_ms':>12}"
+             f"{'comm_ms':>10}{'step_ms':>10}{'mono_ms':>10}"
+             f"{'exposed_frac':>14}{'overlap_eff':>13}")
+    out = []
+    for wire in wires:
+        for mb in bucket_mbs:
+            c = _overlap_candidate(mesh, axis, mb, wire,
+                                   int(total_mb * (1 << 20)), layers,
+                                   iters, warmup, recorder=recorder)
+            out.append(c)
+            if recorder is not None:
+                # exposed/hidden split rides the standard comm-event spine
+                variant = f"overlap_{wire}_b{mb:g}"
+                recorder.comm_event("reduce_scatter", variant, c["bytes"],
+                                    c["wire_bytes"], c["exposed_ms"] / 1e3,
+                                    world_size=mesh.shape[axis])
+                recorder.comm_event("reduce_scatter", variant, 0,
+                                    0, c["hidden_ms"] / 1e3,
+                                    world_size=mesh.shape[axis],
+                                    exposed=False)
+            print_fn(f"{mb:>10g}{wire:>8}{c['buckets']:>9}"
+                     f"{c['compute_ms']:>12.3f}{c['comm_ms']:>10.3f}"
+                     f"{c['step_ms']:>10.3f}{c['monolithic_ms']:>10.3f}"
+                     f"{c['exposed_comm_frac']:>14.3f}"
+                     f"{c['overlap_efficiency']:>13.3f}")
+    best = max(out, key=lambda c: c["overlap_efficiency"])
+    print_fn(f"# best: bucket_mb={best['bucket_mb']:g} "
+             f"wire={best['wire_dtype']} "
+             f"overlap_efficiency={best['overlap_efficiency']:.3f}")
+    return out
 
 
 # engine-variant op → (facade op, comms-logging variant tag) so traced
@@ -170,7 +353,8 @@ _TRACE_VARIANTS = {
 
 def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         iters=20, warmup=3, print_fn=print, intra=0, json_path=None,
-        trace_dir=None):
+        trace_dir=None, overlap=False, overlap_total_mb=8.0,
+        overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
     (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps); with
     ``json_path``, also writes them as machine-readable JSON; with
@@ -222,28 +406,44 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
                                     world_size=mesh.shape[axis])
             print_fn(f"{op:<28}{size:>12}{wire:>12}{lat * 1e6:>14.1f}"
                      f"{algbw:>12.2f}{busbw:>12.2f}")
+    overlap_rows = []
+    if overlap:
+        overlap_rows = run_overlap_sweep(
+            axis=axis, mesh=mesh, bucket_mbs=overlap_bucket_mbs,
+            wires=overlap_wires, total_mb=overlap_total_mb,
+            iters=max(2, iters // 2), warmup=warmup, print_fn=print_fn,
+            recorder=recorder)
     if json_path:
+        # uniform row schema: overlap fields present on every row so
+        # BENCH_* aggregation (tools/fold_sweeps.py) never key-errors
+        json_rows = [{"op": op, "bytes": int(size), "wire_bytes": int(wire),
+                      "latency_us": lat * 1e6, "algbw_gbps": algbw,
+                      "busbw_gbps": busbw, "bucket_mb": None,
+                      "overlap_efficiency": None, "exposed_comm_frac": None}
+                     for op, size, wire, lat, algbw, busbw in rows]
+        for c in overlap_rows:
+            json_rows.append(dict(c, latency_us=c["step_ms"] * 1e3,
+                                  algbw_gbps=None, busbw_gbps=None))
         payload = {
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "axis": axis,
             "dtype": "fp32",
             "wire_format": WIRE_FORMAT,
             "quantization_group_size": GROUP_SIZE,
-            "rows": [{"op": op, "bytes": int(size), "wire_bytes": int(wire),
-                      "latency_us": lat * 1e6, "algbw_gbps": algbw,
-                      "busbw_gbps": busbw}
-                     for op, size, wire, lat, algbw, busbw in rows],
+            "rows": json_rows,
         }
         with open(json_path, "w") as fh:
             json.dump(payload, fh, indent=2)
-        print_fn(f"# wrote {len(rows)} rows to {json_path}")
+        print_fn(f"# wrote {len(json_rows)} rows to {json_path}")
     if recorder is not None:
         summary_path = os.path.join(recorder.trace_dir, "comm_summary.json")
+        summary = {"mesh": {k: int(v)
+                            for k, v in dict(mesh.shape).items()},
+                   "axis": axis, "ops": recorder.comm_summary()}
+        if overlap_rows:
+            summary["overlap"] = overlap_rows
         with open(summary_path, "w") as fh:
-            json.dump({"mesh": {k: int(v)
-                                for k, v in dict(mesh.shape).items()},
-                       "axis": axis, "ops": recorder.comm_summary()},
-                      fh, indent=2)
+            json.dump(summary, fh, indent=2)
         recorder.close()
         print_fn(f"# archived trace + comm attribution under "
                  f"{recorder.trace_dir}")
@@ -275,11 +475,31 @@ def cli_main(argv=None):
                     help="archive telemetry artifacts (chrome trace + "
                     "per-variant comm attribution) under DIR alongside "
                     "the --json rows")
+    ap.add_argument("--overlap", action="store_true",
+                    help="also sweep the bucketed grad-reduce overlap "
+                    "scheduler (bucket_mb × wire dtype; docs/overlap.md)")
+    ap.add_argument("--overlap-total-mb", type=float, default=8.0,
+                    help="total gradient payload for the overlap sweep")
+    ap.add_argument("--overlap-buckets", default=None, metavar="MB,MB,…",
+                    help="comma-separated bucket_mb candidates "
+                    "(default 1,4,16)")
+    ap.add_argument("--overlap-wires", default=None, metavar="W,W",
+                    help="comma-separated wire dtypes for the overlap "
+                    "sweep (default fp32,int8)")
     args = ap.parse_args(argv)
-    run(ops=(args.op, ) if args.op else ALL_OPS, axis=args.axis,
+    # --overlap alone sweeps just the scheduler; add --op to also run the
+    # collective op sweep in the same invocation
+    default_ops = () if args.overlap else ALL_OPS
+    run(ops=(args.op, ) if args.op else default_ops, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
         iters=args.iters, warmup=args.warmup, intra=args.intra,
-        json_path=args.json, trace_dir=args.trace)
+        json_path=args.json, trace_dir=args.trace, overlap=args.overlap,
+        overlap_total_mb=args.overlap_total_mb,
+        overlap_bucket_mbs=(tuple(float(x) for x in
+                                  args.overlap_buckets.split(","))
+                            if args.overlap_buckets else OVERLAP_BUCKET_MBS),
+        overlap_wires=(tuple(args.overlap_wires.split(","))
+                       if args.overlap_wires else OVERLAP_WIRES))
 
 
 if __name__ == "__main__":

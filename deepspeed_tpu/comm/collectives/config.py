@@ -7,9 +7,21 @@ tests, tools) that must not drag the full runtime config machinery in.  The
 engine itself is duck-typed — either object works.
 """
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from .quantized import DEFAULT_GROUP_SIZE
+
+
+@dataclass
+class Overlap:
+    """Bucketed backward-pass gradient-reduction scheduler knobs (see
+    ``runtime/zero/overlap.py`` / docs/overlap.md).  Own enable gate:
+    bucketing changes when reduces run, not what they carry."""
+    enabled: bool = False
+    # bucket size bound in MiB of gradient payload (fractional ok)
+    bucket_mb: float = 32.0
+    # manual qgZ path: max buckets with the inter-node hop outstanding
+    max_inflight: int = 2
 
 
 @dataclass
@@ -34,3 +46,5 @@ class CommOptimizations:
     # tensors smaller than this many bytes always take the flat path
     # (latency-bound regime — quantize/hierarchy overhead beats the savings)
     min_message_size: int = 0
+    # bucketed backward-pass gradient-reduction scheduler
+    overlap: Overlap = field(default_factory=Overlap)

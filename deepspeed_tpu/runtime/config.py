@@ -80,6 +80,24 @@ class CommsConfig(DeepSpeedConfigModel):
         return self.comms_logger.enabled
 
 
+class OverlapConfig(DeepSpeedConfigModel):
+    """``"comm_optimizations.overlap"`` — the bucketed backward-pass
+    gradient-reduction scheduler (``runtime/zero/overlap.py``,
+    docs/overlap.md).  Disabled (default) is bit-identical: the micro-step
+    compiles to exactly the unbucketed program.  Enabled, the gradient
+    reduce is split into ``bucket_mb``-bounded buckets dispatched inside
+    the backward graph as each layer's gradients materialize, so XLA (or
+    the manual qgZ pipeline) can hide the reduce under remaining backward
+    compute."""
+    enabled: bool = False
+    # bucket size bound in MiB of gradient payload; fractional values are
+    # allowed (tiny models need sub-MiB bounds to form >1 bucket)
+    bucket_mb: float = Field(32.0, gt=0)
+    # manual (qgZ) path only: how many buckets may have their inter-node
+    # hop outstanding at once; the GSPMD path leaves scheduling to XLA
+    max_inflight: int = Field(2, ge=1)
+
+
 class CommOptimizationsConfig(DeepSpeedConfigModel):
     """``"comm_optimizations"`` section — the topology-aware quantized
     collectives engine (``comm/collectives/``, docs/collectives.md).
@@ -87,7 +105,10 @@ class CommOptimizationsConfig(DeepSpeedConfigModel):
     Disabled (default) is bit-identical to the flat collectives.  Enabled,
     the facade's eager collectives dispatch to hierarchical/quantized
     variants, and the ZeRO gradient/param paths switch to quantized wire
-    traffic (qgZ/qwZ semantics) per the flags below."""
+    traffic (qgZ/qwZ semantics) per the flags below.  The nested
+    ``overlap`` block has its own ``enabled`` gate (the scheduler changes
+    *when* reduces run, not what they carry, so it composes with either
+    the flat or the quantized path)."""
     enabled: bool = False
     # intra-node reduce-scatter → inter-node op on 1/N → intra-node
     # all-gather; engages only when the group spans a topology hierarchy
@@ -105,6 +126,8 @@ class CommOptimizationsConfig(DeepSpeedConfigModel):
     intra_node_size: int = Field(0, ge=0)
     # messages under this many bytes always take the flat path
     min_message_size: int = Field(0, ge=0)
+    # bucketed backward-pass gradient-reduction scheduler (own enable gate)
+    overlap: OverlapConfig = OverlapConfig()
 
 
 class MonitorConfig(DeepSpeedConfigModel):
@@ -441,6 +464,14 @@ class DeepSpeedConfig:
                 f"comm_optimizations.wire_dtype "
                 f"{self.comm_optimizations_config.wire_dtype!r} unknown "
                 f"(have {', '.join(WIRE_FORMATS)})")
+        # reference-compat: ``zero_optimization.overlap_comm: true`` (the
+        # DeepSpeed knob for overlapping gradient reduction with backward)
+        # arms the bucketed overlap scheduler unless the user pinned the
+        # overlap block explicitly
+        _ov_user = ((pd.get("comm_optimizations") or {}).get("overlap")
+                    or {})
+        if self.zero_config.overlap_comm and "enabled" not in _ov_user:
+            self.comm_optimizations_config.overlap.enabled = True
         self.flops_profiler_config = FlopsProfilerConfig(
             **pd.get("flops_profiler", {}) or {})
         self.hybrid_engine = HybridEngineConfig(

@@ -1111,6 +1111,18 @@ class DeepSpeedEngine:
     def _micro_step_fn(self):
         """Build (loss, grads) = value_and_grad over compute params."""
         if self._onebit_opt is not None:
+            from .zero.overlap import overlap_opts
+            if overlap_opts(self._config.comm_optimizations_config) \
+                    is not None:
+                # LOUD: the 1-bit micro manages its own gradient exchange
+                # (error-compensated compressed all-reduce) — a user who
+                # armed overlap (or overlap_comm) must not believe the
+                # bucket scheduler is hiding anything here
+                logger.warning(
+                    "comm_optimizations.overlap is ignored with 1-bit "
+                    "optimizers: their micro-step consumes unreduced "
+                    "per-worker grads and runs its own compressed "
+                    "exchange (docs/overlap.md limits)")
             # 1-bit optimizers consume *unreduced* per-worker grads
             return self._onebit_opt.build_micro(self)
         apply_fn = self._effective_apply_fn()
@@ -1149,6 +1161,30 @@ class DeepSpeedEngine:
             apply_fn = split_microstreams(apply_fn, dc.n_streams)
         from .utils import make_scaled_loss_fn
         loss_fn = make_scaled_loss_fn(apply_fn, gas)
+
+        from .zero.overlap import overlap_opts
+        ov = overlap_opts(co)
+        if ov is not None:
+            # bucketed overlap scheduler (GSPMD flavor): per-bucket
+            # custom_vjp markers emit the gradient sharding constraints —
+            # and thus XLA's reduce-scatters — inside the backward graph,
+            # where the latency-hiding scheduler can slide them under the
+            # remaining backward compute (docs/overlap.md)
+            from .zero.overlap import (bucket_bytes_of, describe_buckets,
+                                       mark_tree, tree_buckets)
+            bucket_bytes = bucket_bytes_of(ov)
+            inner_loss_fn = loss_fn
+
+            def loss_fn(params, scale, inputs):
+                buckets, _, _ = tree_buckets(params, bucket_bytes)
+                if _telemetry.enabled and \
+                        not getattr(self, "_overlap_meta_emitted", False):
+                    self._overlap_meta_emitted = True
+                    _telemetry.metadata("overlap_buckets",
+                                        describe_buckets(buckets))
+                marked = mark_tree(params, self.plan.grad_shardings(params),
+                                   buckets)
+                return inner_loss_fn(marked, scale, inputs)
 
         def micro(params, scale, inputs):
             (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -1,2 +1,3 @@
 from .config import DeepSpeedZeroConfig
+from .overlap import GradBucket, partition_buckets, tree_buckets
 from .partition import ZeroPartitionPlan, shard_spec, tree_shardings

@@ -241,7 +241,9 @@ class ZeroPartitionPlan:
     def describe(self):
         """JSON-safe summary of the sharding policy — trace metadata and
         the autotuner's record of what configuration produced a trace."""
+        from .overlap import overlap_opts
         co = self.comm_opts
+        ov = overlap_opts(co)
         return {
             "stage": self.stage,
             "zero_axes": list(self.zero_axes),
@@ -256,6 +258,11 @@ class ZeroPartitionPlan:
             "param_wire": list(self.param_wire()),
             "comm_optimizations_enabled": bool(
                 co is not None and getattr(co, "enabled", False)),
+            "overlap_enabled": bool(ov is not None),
+            "overlap_bucket_mb": (float(getattr(ov, "bucket_mb", 0.0))
+                                  if ov is not None else 0.0),
+            "overlap_max_inflight": (int(getattr(ov, "max_inflight", 0))
+                                     if ov is not None else 0),
         }
 
     # wire formats ----------------------------------------------------------

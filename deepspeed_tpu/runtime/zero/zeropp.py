@@ -37,6 +37,11 @@ switches its micro-step to a manual-SPMD (``shard_map``) variant — see
 via PARTIAL-manual shard_map (manual over the dp axes, "tp" left auto so
 GSPMD keeps inserting the tensor-parallel collectives); sp/pp are rejected
 loudly (their collectives interleave with the reduction being replaced).
+
+With ``comm_optimizations.overlap`` enabled the manual reduction runs the
+bucketed two-stage pipeline from ``runtime/zero/overlap.py`` — intra-node
+psum_scatter of bucket *k* overlapping the quantized inter-node
+all-to-all of bucket *k−1* (docs/overlap.md).
 """
 
 import jax
@@ -177,6 +182,11 @@ def build_manual_dp_micro(engine):
     qw_fmt, qw_gs = plan.param_wire(zc.zero_quantized_weights_format)
     qg_fmt, qg_gs = plan.grad_wire()
     hier = plan.hierarchical_reduce()
+    # bucketed overlap scheduler: pipeline the quantized inter-node hop of
+    # bucket k with the intra-node work of bucket k+1 (docs/overlap.md)
+    from .overlap import overlap_opts
+    ov = overlap_opts(co)
+    overlap_on = ov is not None
 
     from .partition import path_str
     from ..utils import make_scaled_loss_fn
@@ -265,6 +275,64 @@ def build_manual_dp_micro(engine):
         batch_specs = batch_input_specs(inputs, dp_axes,
                                         engine._n_replicated_batch_tail)
 
+        def _overlapped_reduce(grads):
+            """Per-bucket two-stage reduction, same math as reduce_leaf:
+            stage1 = full-precision intra-node psum_scatter (hier leaves
+            only), stage2 = quantized inter-node all-to-all reduce +
+            trailing pmean/cast.  The pipeline fences bucket k's stage2
+            behind bucket k−max_inflight's output so the DCN hop of one
+            bucket overlaps the ICI hop of the next."""
+            from .overlap import (bucket_bytes_of, pipelined_bucket_reduce,
+                                  tree_buckets)
+            buckets, _, _ = tree_buckets(grads, bucket_bytes_of(ov))
+
+            def stage1(path, g):
+                info = _leaf_hier(reduce_specs[path])
+                if info is None:
+                    return g
+                dim, _, inner = info
+                part = g
+                for a in inner:
+                    part = jax.lax.psum_scatter(part, a,
+                                                scatter_dimension=dim,
+                                                tiled=True)
+                return part
+
+            def stage2(path, h):
+                spec = reduce_specs[path]
+                dim, axes = _zero_dim(spec, dp_axes)
+                if dim is None:
+                    return jax.lax.pmean(h, dp_axes).astype(grad_dtype)
+                info = _leaf_hier(spec)
+                if info is not None:
+                    _, outer, inner = info
+                    n_out = 1
+                    for a in outer:
+                        n_out *= mesh.shape[a]
+                    n_in = 1
+                    for a in inner:
+                        n_in *= mesh.shape[a]
+                    out = all_to_all_quant_reduce(h, outer, dim, n_out,
+                                                  wire_format=qg_fmt,
+                                                  group_size=qg_gs,
+                                                  mean=False)
+                    out = out / (n_in * n_out)
+                else:
+                    n = 1
+                    for a in axes:
+                        n *= mesh.shape[a]
+                    out = all_to_all_quant_reduce(h, axes, dim, n,
+                                                  wire_format=qg_fmt,
+                                                  group_size=qg_gs)
+                rest = tuple(a for a in dp_axes if a not in axes)
+                if rest:
+                    out = jax.lax.pmean(out, rest)
+                return out.astype(grad_dtype)
+
+            return pipelined_bucket_reduce(
+                grads, buckets, stage1, stage2,
+                max_inflight=getattr(ov, "max_inflight", 2))
+
         def body(params, inputs):
             # stage-3: reassemble full params from local shards (int8 when qwZ)
             def gather_leaf(kp, x):
@@ -313,7 +381,10 @@ def build_manual_dp_micro(engine):
                     out = jax.lax.pmean(out, rest)
                 return out.astype(grad_dtype)
 
-            grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+            if overlap_on:
+                grads = _overlapped_reduce(grads)
+            else:
+                grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
             return loss, grads
 
         kw = dict(mesh=mesh, in_specs=(param_specs, batch_specs),

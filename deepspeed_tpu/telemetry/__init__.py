@@ -25,12 +25,13 @@ back.  This module must stay import-light: ``comm/comm.py`` imports it at
 module scope.
 """
 
-from .comm_attribution import CommAttribution  # noqa: F401  (re-export)
+from .comm_attribution import (CommAttribution,  # noqa: F401  (re-export)
+                               overlap_efficiency)
 from .metrics import (MetricsRegistry, MonitorSink,  # noqa: F401
                       PrometheusEndpoint, render_prometheus)
-from .trace import (PHASES, SPAN_BACKWARD, SPAN_CHECKPOINT,  # noqa: F401
-                    SPAN_FORWARD, SPAN_GRAD_REDUCE, SPAN_OPTIMIZER,
-                    STEPS_FILE, TRACE_FILE, TraceRecorder)
+from .trace import (PHASES, SPAN_BACKWARD, SPAN_BUCKET_PREFIX,  # noqa: F401
+                    SPAN_CHECKPOINT, SPAN_FORWARD, SPAN_GRAD_REDUCE,
+                    SPAN_OPTIMIZER, STEPS_FILE, TRACE_FILE, TraceRecorder)
 
 #: THE flag every emit site guards on.  Only configure()/shutdown() write it.
 enabled = False
@@ -141,10 +142,10 @@ def span(name, cat="compute", **args):
 
 
 def record_comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
-                      world_size=1):
+                      world_size=1, exposed=True):
     if _recorder is not None:
         _recorder.comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
-                             world_size)
+                             world_size, exposed=exposed)
 
 
 def metadata(name, payload):

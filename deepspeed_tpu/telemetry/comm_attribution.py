@@ -47,6 +47,18 @@ def exposed_fraction(exposed_seconds, window_seconds):
     return max(0.0, min(1.0, exposed_seconds / window_seconds))
 
 
+def overlap_efficiency(hidden_seconds, total_seconds):
+    """Fraction of comm time hidden under compute — the overlap
+    scheduler's score, clamped into [0, 1].  ``total`` is hidden+exposed
+    comm time; zero total (no measured comm at all) scores 1.0: nothing
+    was exposed, vacuously perfect — callers that need to distinguish
+    "fully hidden" from "no comm" check the totals themselves
+    (``tools/trace_report.py`` prints the fully-fused-step note)."""
+    if total_seconds <= 0:
+        return 1.0
+    return max(0.0, min(1.0, hidden_seconds / total_seconds))
+
+
 class CommAttribution:
     """Accumulates per-``op[variant]`` comm records over one window (a step,
     or a whole run) and summarizes latency / wire bandwidth."""
@@ -55,16 +67,26 @@ class CommAttribution:
         self._records = {}
 
     def record(self, op, variant, msg_bytes, wire_bytes, latency_s,
-               world_size=1):
+               world_size=1, exposed=True):
+        """``exposed=False`` books the latency as *hidden* comm time —
+        measured communication that ran under compute (the overlap bench
+        and the bucket scheduler's accounting) — which feeds
+        :func:`overlap_efficiency` instead of the exposed totals.  Hidden
+        bookings do NOT bump ``count``: they annotate an op's overlapped
+        share, so ``count``/``avg_ms`` keep meaning "eager calls" /
+        "average exposed latency" for every existing consumer."""
         key = variant_key(op, variant)
         r = self._records.get(key)
         if r is None:
             r = self._records[key] = {
-                "count": 0, "total_s": 0.0, "msg_bytes": 0, "wire_bytes": 0,
-                "world_size": int(world_size),
+                "count": 0, "total_s": 0.0, "hidden_s": 0.0, "msg_bytes": 0,
+                "wire_bytes": 0, "world_size": int(world_size),
             }
-        r["count"] += 1
-        r["total_s"] += float(latency_s)
+        if exposed:
+            r["count"] += 1
+            r["total_s"] += float(latency_s)
+        else:
+            r["hidden_s"] += float(latency_s)
         r["msg_bytes"] += int(msg_bytes)
         r["wire_bytes"] += int(wire_bytes if wire_bytes is not None
                                else msg_bytes)
@@ -75,13 +97,19 @@ class CommAttribution:
         return not self._records
 
     def total_seconds(self):
+        """Exposed comm seconds only — the historical meaning every
+        exposed-comm-fraction consumer relies on."""
         return sum(r["total_s"] for r in self._records.values())
 
+    def hidden_seconds(self):
+        return sum(r["hidden_s"] for r in self._records.values())
+
     def summary(self):
-        """{key: {count, total_ms, avg_ms, msg_bytes, wire_bytes, gbps}} —
-        each record counted exactly once; a run that falls back from a
-        quantized variant to flat mid-run contributes its flat calls to the
-        flat row and its quantized calls to the ``[q_*]`` row, never both."""
+        """{key: {count, total_ms, avg_ms, msg_bytes, wire_bytes, gbps,
+        hidden_ms}} — each record counted exactly once; a run that falls
+        back from a quantized variant to flat mid-run contributes its flat
+        calls to the flat row and its quantized calls to the ``[q_*]``
+        row, never both."""
         out = {}
         for key, r in sorted(self._records.items()):
             out[key] = {
@@ -90,7 +118,9 @@ class CommAttribution:
                 "avg_ms": r["total_s"] * 1e3 / max(1, r["count"]),
                 "msg_bytes": r["msg_bytes"],
                 "wire_bytes": r["wire_bytes"],
-                "gbps": effective_gbps(r["wire_bytes"], r["total_s"]),
+                "gbps": effective_gbps(r["wire_bytes"],
+                                       r["total_s"] + r["hidden_s"]),
+                "hidden_ms": r["hidden_s"] * 1e3,
             }
         return out
 

@@ -30,7 +30,8 @@ import sys
 import time
 
 from ..utils.logging import logger
-from .comm_attribution import CommAttribution, exposed_fraction
+from .comm_attribution import (CommAttribution, exposed_fraction,
+                               overlap_efficiency)
 
 # canonical phase names — the engine emits exactly these, and
 # tools/trace_report.py columns key off them
@@ -42,6 +43,13 @@ SPAN_CHECKPOINT = "checkpoint"
 
 PHASES = (SPAN_FORWARD, SPAN_BACKWARD, SPAN_GRAD_REDUCE, SPAN_OPTIMIZER,
           SPAN_CHECKPOINT)
+
+#: per-bucket reduce spans render as ``bucket_reduce/<index>`` — their own
+#: namespace (the ``overlap`` section of the step record), never a phase
+#: column (the overlap bench and eager bucket paths emit them; a fully
+#: jitted step has none — its buckets live inside the compiled graph and
+#: are visible only as trace metadata + HLO structure)
+SPAN_BUCKET_PREFIX = "bucket_reduce"
 
 TRACE_FILE = "trace.json"
 STEPS_FILE = "steps.jsonl"
@@ -105,6 +113,7 @@ class TraceRecorder:
         self._step_t0 = None
         self._step_annotation = None
         self._phase_s = {}
+        self._bucket_s = {}
         self._step_comm = CommAttribution()
         self._run_comm = CommAttribution()
         self.steps_recorded = 0
@@ -194,7 +203,11 @@ class TraceRecorder:
         self._emit(h.name, h.cat, (h._t0 - self._epoch) * 1e6, dur * 1e6,
                    args=h.args)
         if self._step is not None:
-            self._phase_s[h.name] = self._phase_s.get(h.name, 0.0) + dur
+            if h.name.startswith(SPAN_BUCKET_PREFIX + "/"):
+                self._bucket_s[h.name] = self._bucket_s.get(h.name, 0.0) \
+                    + dur
+            else:
+                self._phase_s[h.name] = self._phase_s.get(h.name, 0.0) + dur
 
     # ----------------------------------------------------------------- steps
     def begin_step(self, step):
@@ -207,6 +220,7 @@ class TraceRecorder:
         self._step = step
         self._step_t0 = time.perf_counter()
         self._phase_s = {}
+        self._bucket_s = {}
         self._step_comm.reset()
         if self.device_annotations:
             try:
@@ -238,17 +252,27 @@ class TraceRecorder:
                    (self._step_t0 - self._epoch) * 1e6, wall_s * 1e6,
                    tid=2, args={"step": step})
         exposed_s = self._step_comm.total_seconds()
+        hidden_s = self._step_comm.hidden_seconds()
         record = {
             "step": step,
             "wall_ms": wall_s * 1e3,
             "phases": {k: v * 1e3 for k, v in sorted(self._phase_s.items())},
             "comm": {
-                "total_ms": exposed_s * 1e3,
+                "total_ms": (exposed_s + hidden_s) * 1e3,
                 "exposed_ms": exposed_s * 1e3,
+                "hidden_ms": hidden_s * 1e3,
                 "exposed_comm_fraction": exposed_fraction(exposed_s, wall_s),
+                "overlap_efficiency": overlap_efficiency(
+                    hidden_s, exposed_s + hidden_s),
                 "ops": self._step_comm.summary(),
             },
         }
+        if self._bucket_s:
+            record["overlap"] = {
+                "buckets": len(self._bucket_s),
+                "bucket_ms": {k: v * 1e3
+                              for k, v in sorted(self._bucket_s.items())},
+            }
         if metrics:
             record["metrics"] = {k: v for k, v in metrics.items()
                                  if v is not None}
@@ -267,10 +291,18 @@ class TraceRecorder:
             logger.warning("telemetry: step record write failed (%s)", e)
 
     # ------------------------------------------------------------ comm + meta
+    def bucket_span(self, index, **args):
+        """Span for one gradient bucket's eager reduce — lands in the step
+        record's ``overlap`` section, not the phase columns."""
+        return self.span(f"{SPAN_BUCKET_PREFIX}/{index}", cat="comm",
+                         **args)
+
     def comm_event(self, op, variant, msg_bytes, wire_bytes, latency_s,
-                   world_size=1):
+                   world_size=1, exposed=True):
         """One eager collective: chrome event on the comm track + join into
-        the per-step (and whole-run) attribution."""
+        the per-step (and whole-run) attribution.  ``exposed=False`` books
+        the latency as hidden (overlapped-under-compute) comm time — it
+        feeds ``overlap_efficiency`` instead of the exposed fraction."""
         if self._closed:
             return
         name = f"{op}[{variant}]" if variant else op
@@ -279,12 +311,13 @@ class TraceRecorder:
                    latency_s * 1e6, tid=_COMM_TID,
                    args={"msg_bytes": int(msg_bytes),
                          "wire_bytes": int(wire_bytes if wire_bytes
-                                           is not None else msg_bytes)})
+                                           is not None else msg_bytes),
+                         "exposed": bool(exposed)})
         self._run_comm.record(op, variant, msg_bytes, wire_bytes, latency_s,
-                              world_size)
+                              world_size, exposed=exposed)
         if self._step is not None:
             self._step_comm.record(op, variant, msg_bytes, wire_bytes,
-                                   latency_s, world_size)
+                                   latency_s, world_size, exposed=exposed)
 
     def metadata(self, name, payload):
         """Attach a structured metadata blob (zero plan, mesh, config hash);

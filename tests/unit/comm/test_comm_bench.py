@@ -48,6 +48,72 @@ def test_json_output(tmp_path):
                             "algbw_gbps", "busbw_gbps"}
 
 
+def test_overlap_sweep_rows_and_schema(tmp_path):
+    """The overlap sweep emits one candidate per (bucket_mb, wire) with the
+    overlap-efficiency accounting, archives them under --trace, and every
+    --json row (op sweep included) carries the uniform overlap fields."""
+    import json
+    out = tmp_path / "bench.json"
+    trace = tmp_path / "trace"
+    run(ops=("all_reduce", ), axis="dp", minsize=12, maxsize=12, iters=1,
+        warmup=1, print_fn=lambda *a: None, json_path=str(out),
+        trace_dir=str(trace), overlap=True, overlap_total_mb=0.5,
+        overlap_bucket_mbs=(0.05, 0.25), overlap_wires=("fp32", "int8"))
+    payload = json.loads(out.read_text())
+    over = [r for r in payload["rows"] if r["op"] == "overlap"]
+    flat = [r for r in payload["rows"] if r["op"] != "overlap"]
+    assert len(over) == 4 and len(flat) == 1
+    for row in payload["rows"]:  # uniform schema, flat rows carry None
+        assert {"overlap_efficiency", "bucket_mb",
+                "exposed_comm_frac"} <= set(row)
+    assert flat[0]["overlap_efficiency"] is None
+    for c in over:
+        assert 0.0 <= c["overlap_efficiency"] <= 1.0
+        assert 0.0 <= c["exposed_comm_frac"] <= 1.0
+        assert c["buckets"] >= 1 and c["comm_ms"] > 0 and c["step_ms"] > 0
+    # smaller bound → more buckets
+    eff = {(c["bucket_mb"], c["wire_dtype"]): c["buckets"] for c in over}
+    assert eff[(0.05, "fp32")] >= eff[(0.25, "fp32")]
+    # --trace archived the candidates for trace_report --json
+    summary = json.loads((trace / "comm_summary.json").read_text())
+    assert len(summary["overlap"]) == 4
+    # int8 candidates move fewer wire bytes than fp32 at equal payload
+    by_wire = {}
+    for c in over:
+        by_wire.setdefault(c["wire_dtype"], c["wire_bytes"])
+    assert by_wire["int8"] < by_wire["fp32"]
+
+
+def test_fold_sweeps_aggregates_overlap(tmp_path):
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "fold_sweeps", os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "..", "tools", "fold_sweeps.py"))
+    fold = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fold)
+    rows = [{"op": "overlap", "bucket_mb": 4.0, "wire_dtype": "int8",
+             "overlap_efficiency": 0.8, "exposed_comm_frac": 0.1},
+            {"op": "overlap", "bucket_mb": 4.0, "wire_dtype": "int8",
+             "overlap_efficiency": 0.6, "exposed_comm_frac": 0.3},
+            {"op": "overlap", "bucket_mb": 1.0, "wire_dtype": "fp32",
+             "overlap_efficiency": 0.2, "exposed_comm_frac": 0.5},
+            {"op": "all_reduce", "bucket_mb": None,
+             "overlap_efficiency": None, "exposed_comm_frac": None}]
+    p1 = tmp_path / "a.json"
+    p1.write_text(json.dumps({"rows": rows[:2]}))
+    p2 = tmp_path / "b.json"
+    p2.write_text(json.dumps({"rows": rows[2:]}))
+    agg = fold.aggregate_overlap([str(p1), str(p2)])
+    assert agg[0]["bucket_mb"] == 4.0 and agg[0]["runs"] == 2
+    assert abs(agg[0]["overlap_efficiency"] - 0.7) < 1e-9
+    assert agg[1]["bucket_mb"] == 1.0  # sorted best-first
+    # bench-format and malformed files are ignored, not fatal
+    (tmp_path / "c.json").write_text("{not json")
+    assert fold.aggregate_overlap([str(tmp_path / "c.json")]) == []
+
+
 def test_hier_ops_skipped_on_unsplittable_axis():
     """A size-2 axis has no non-trivial (outer, inner) split — the hier rows
     must be skipped, not reported as fake hierarchy measurements."""

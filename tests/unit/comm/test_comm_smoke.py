@@ -13,6 +13,30 @@ comm_smoke = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(comm_smoke)
 
 
+def test_overlap_loss_parity_gate(monkeypatch):
+    """ISSUE-8 acceptance: overlap-off is bit-identical, overlap-on stays
+    within parity bounds — and both overlap flavors actually engage (the
+    GSPMD bucket markers and the manual qgZ pipeline)."""
+    from deepspeed_tpu.runtime.zero import overlap
+    marked, piped = [], []
+    orig_mark = overlap.mark_tree
+    orig_pipe = overlap.pipelined_bucket_reduce
+    monkeypatch.setattr(overlap, "mark_tree",
+                        lambda *a, **k: marked.append(1) or orig_mark(*a, **k))
+    monkeypatch.setattr(
+        overlap, "pipelined_bucket_reduce",
+        lambda *a, **k: piped.append(1) or orig_pipe(*a, **k))
+    r = comm_smoke.run_overlap_smoke(steps=6)
+    assert marked, "GSPMD bucket markers never engaged"
+    assert piped, "manual qgZ bucket pipeline never engaged"
+    assert r["disabled_bit_identical"], (
+        r["flat_losses"], r["disabled_losses"])
+    assert r["fp_overlap_max_delta"] <= 1e-6, r["overlap_losses"]
+    assert r["quant_final_delta"] <= r["tolerance"], (
+        r["flat_losses"], r["quant_overlap_losses"])
+    assert r["converged"] and r["pass"]
+
+
 def test_zero2_loss_parity_with_comm_optimizations(monkeypatch):
     # prove the quantized manual micro actually engages for the comm-opts
     # run (parity against an accidentally-flat run would be vacuous)

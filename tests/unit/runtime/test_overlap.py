@@ -1,0 +1,282 @@
+"""Bucketed backward-pass gradient-reduction scheduler
+(``runtime/zero/overlap.py``, docs/overlap.md): partitioner invariants,
+structural per-bucket reduce-op evidence in the compiled micro-step, and
+loss parity for both the GSPMD-marker and manual-qgZ-pipeline flavors."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.zero import overlap
+from deepspeed_tpu.runtime.zero.overlap import (partition_buckets,
+                                                pipelined_bucket_reduce,
+                                                tree_buckets)
+from deepspeed_tpu.utils import groups
+from tests.unit.simple_model import (batches, make_simple_mlp_params,
+                                     random_dataset, simple_mlp_apply)
+
+HIDDEN = 16
+KB = 1 << 10
+
+
+def _leaf(nbytes):
+    return np.zeros((nbytes // 4, ), np.float32)
+
+
+# ------------------------------------------------------------- partitioner
+def test_partition_exact_cover_and_reverse_order():
+    items = [(f"l{i}", _leaf(256)) for i in range(7)]
+    buckets = partition_buckets(items, 600)
+    covered = [i for b in buckets for i in b.indices]
+    # exact cover: every leaf exactly once …
+    assert sorted(covered) == list(range(7))
+    # … and concatenated dispatch order is the exact reverse of the
+    # forward leaf order (the order cotangents materialize in backward)
+    assert covered == list(reversed(range(7)))
+    assert [b.index for b in buckets] == list(range(len(buckets)))
+
+
+def test_partition_respects_size_bound():
+    items = [(f"l{i}", _leaf(256)) for i in range(8)]
+    buckets = partition_buckets(items, 512)
+    for b in buckets:
+        assert b.nbytes <= 512
+        assert len(b.indices) <= 2
+
+
+def test_partition_oversized_leaf_gets_own_bucket():
+    items = [("small0", _leaf(128)), ("big", _leaf(4 * KB)),
+             ("small1", _leaf(128))]
+    buckets = partition_buckets(items, KB)
+    big = [b for b in buckets if "big" in b.paths]
+    assert len(big) == 1 and big[0].paths == ("big", )
+    covered = sorted(i for b in buckets for i in b.indices)
+    assert covered == [0, 1, 2]
+
+
+def test_partition_order_stable_across_bucket_sizes():
+    """Dispatch order is reverse-layer regardless of the bound (and thus
+    of ZeRO stage — the partitioner sees the same grad tree at stages
+    1/2/3, only the per-leaf reduce differs)."""
+    items = [(f"l{i}", _leaf(100 + 50 * i)) for i in range(9)]
+    for bound in (64, 300, 10**6):
+        buckets = partition_buckets(items, bound)
+        covered = [i for b in buckets for i in b.indices]
+        assert covered == list(reversed(range(9))), bound
+
+
+def test_tree_buckets_paths():
+    params = make_simple_mlp_params(HIDDEN, nlayers=3)
+    buckets, paths, _ = tree_buckets(params, 512)
+    assert paths[0] == "layer_0/b"
+    # last layer's leaves dispatch first
+    first = [paths[i] for i in buckets[0].indices]
+    assert all(p.startswith("layer_2") for p in first), first
+
+
+# ------------------------------------------------- pipelined manual reduce
+def test_pipelined_bucket_reduce_math_and_barriers():
+    grads = {f"l{i}": jnp.full((64, ), float(i)) for i in range(6)}
+    buckets, _, _ = tree_buckets(grads, 300)
+    assert len(buckets) >= 3
+
+    def run(g):
+        return pipelined_bucket_reduce(
+            g, buckets, lambda p, x: x * 2.0, lambda p, h: h + 1.0,
+            max_inflight=2)
+
+    out = run(grads)
+    for i in range(6):
+        np.testing.assert_allclose(out[f"l{i}"], np.full((64, ), 2.0 * i + 1))
+    # the fence structure is real graph structure: one optimization_barrier
+    # per fenced bucket pair
+    jaxpr = str(jax.make_jaxpr(run)(grads))
+    n_barriers = jaxpr.count("optimization_barrier")
+    assert n_barriers == max(0, len(buckets) - 2), (n_barriers, len(buckets))
+    # max_inflight=1 fences every adjacent pair
+    jaxpr1 = str(jax.make_jaxpr(
+        lambda g: pipelined_bucket_reduce(
+            g, buckets, lambda p, x: x, lambda p, h: h,
+            max_inflight=1))(grads))
+    assert jaxpr1.count("optimization_barrier") == len(buckets) - 1
+
+
+# --------------------------------------------------------- engine plumbing
+def _engine(co=None, stage=2, nlayers=4):
+    params = make_simple_mlp_params(HIDDEN, nlayers=nlayers)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
+    }
+    if co:
+        cfg["comm_optimizations"] = co
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_apply, model_parameters=params, config=cfg)
+    return engine
+
+
+def _teardown():
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
+
+
+OVERLAP = {"overlap": {"enabled": True, "bucket_mb": 0.0005}}
+
+
+def _micro_artifacts(engine):
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    inputs = engine.shard_batch(*data[0])
+    micro = engine._micro_step_fn()
+    args = (engine.params, engine.scale_state.scale, inputs)
+    jaxpr = jax.make_jaxpr(micro)(*args)
+    lowered = jax.jit(micro).lower(*args)
+    return jaxpr, lowered
+
+
+def test_zero2_overlap_emits_per_bucket_reduce_ops():
+    """ISSUE-8 acceptance: with overlap enabled on a ≥2-device mesh the
+    ZeRO-2 backward graph contains ≥2 distinct per-bucket reduce groups,
+    interleaved with backward compute — verified structurally from the
+    jaxpr and the lowered module."""
+    engine = _engine(OVERLAP)
+    try:
+        jaxpr, lowered = _micro_artifacts(engine)
+        prims = [str(e.primitive) for e in jaxpr.jaxpr.eqns]
+        n_buckets = prims.count("optimization_barrier")
+        assert n_buckets >= 2, prims
+        # the per-bucket reduce groups sit INSIDE the backward graph: at
+        # least one bucket barrier precedes later backward matmuls instead
+        # of trailing the whole differentiation
+        first_bar = prims.index("optimization_barrier")
+        assert "dot_general" in prims[first_bar:], prims[first_bar:]
+        # per-bucket sharding constraints reach the lowered module (the
+        # ops XLA turns into reduce-scatter/all-reduce at SPMD partition)
+        stable = lowered.as_text()
+        engine2 = _engine(None)
+        stable_off = _micro_artifacts(engine2)[1].as_text()
+        assert stable.count("@Sharding") > stable_off.count("@Sharding")
+        # compiled collective count: ≥2 distinct reduce ops survive
+        hlo = lowered.compile().as_text()
+        if isinstance(hlo, (list, tuple)):
+            hlo = "\n".join(hlo)
+        n_reduce = len(re.findall(r"(all-reduce|reduce-scatter)\(", hlo))
+        assert n_reduce >= 2, n_reduce
+    finally:
+        _teardown()
+
+
+def test_overlap_disabled_is_program_identical():
+    """Disabled (default) compiles to the exact program of HEAD: same
+    jaxpr, no markers, no barriers — the bit-identical contract."""
+    engine = _engine({"overlap": {"enabled": False, "bucket_mb": 0.0005}})
+    try:
+        jaxpr_off, _ = _micro_artifacts(engine)
+    finally:
+        _teardown()
+    engine = _engine(None)
+    try:
+        jaxpr_none, _ = _micro_artifacts(engine)
+    finally:
+        _teardown()
+    assert "optimization_barrier" not in str(jaxpr_off)
+    # normalize interpreter object addresses embedded in closure reprs
+    norm = lambda j: re.sub(r"0x[0-9a-f]+", "0x…", str(j))
+    assert norm(jaxpr_off) == norm(jaxpr_none)
+
+
+def _train(engine, steps=8):
+    data = batches(random_dataset(64, HIDDEN), 4 * engine.dp_world_size)
+    it = iter(data * 50)
+    losses = []
+    for _ in range(steps):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("stage", (2, 3))
+def test_overlap_loss_parity_gspmd(stage):
+    """Full-precision bucketed reduce is the same math per leaf — the
+    trajectory must match the unbucketed run exactly."""
+    engine = _engine(None, stage=stage)
+    try:
+        ref = _train(engine)
+    finally:
+        _teardown()
+    engine = _engine(OVERLAP, stage=stage)
+    try:
+        ov = _train(engine)
+    finally:
+        _teardown()
+    np.testing.assert_allclose(ov, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_manual_qgz_overlap_pipeline(monkeypatch):
+    """qgZ + overlap: the manual micro routes through the pipelined bucket
+    reduce (barriers in the jaxpr), and the trajectory tracks the
+    unpipelined qgZ run within quantization tolerance."""
+    fired = []
+    orig = overlap.pipelined_bucket_reduce
+    monkeypatch.setattr(
+        overlap, "pipelined_bucket_reduce",
+        lambda *a, **k: fired.append(1) or orig(*a, **k))
+    qgz = {"enabled": True, "quantized_gradients": True,
+           "quantization_group_size": 128}
+    engine = _engine(qgz)
+    try:
+        ref = _train(engine)
+    finally:
+        _teardown()
+    assert not fired
+    engine = _engine(dict(qgz, **OVERLAP))
+    try:
+        jaxpr, _ = _micro_artifacts(engine)
+        assert str(jaxpr).count("optimization_barrier") >= 1
+        ov = _train(engine)
+    finally:
+        _teardown()
+    assert fired, "overlap pipeline never engaged on the qgZ path"
+    assert abs(ov[-1] - ref[-1]) < 0.05 * max(1.0, abs(ref[0])), (ref, ov)
+
+
+def test_plan_describe_reports_overlap():
+    engine = _engine({"overlap": {"enabled": True, "bucket_mb": 2.5,
+                                  "max_inflight": 3}})
+    try:
+        d = engine.plan.describe()
+        assert d["overlap_enabled"] is True
+        assert d["overlap_bucket_mb"] == 2.5
+        assert d["overlap_max_inflight"] == 3
+    finally:
+        _teardown()
+    engine = _engine(None)
+    try:
+        assert engine.plan.describe()["overlap_enabled"] is False
+    finally:
+        _teardown()
+
+
+def test_overlap_comm_legacy_knob_arms_scheduler():
+    """Reference configs with ``zero_optimization.overlap_comm: true`` get
+    the bucketed scheduler (the knob that used to be a silent no-op)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "zero_optimization": {"stage": 2, "overlap_comm": True}})
+    assert cfg.comm_optimizations_config.overlap.enabled
+    # an explicit overlap block wins over the legacy knob
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "zero_optimization": {"stage": 2, "overlap_comm": True},
+        "comm_optimizations": {"overlap": {"enabled": False}}})
+    assert not cfg.comm_optimizations_config.overlap.enabled

@@ -178,3 +178,53 @@ def test_max_events_cap_drops_not_grows(tmp_path):
     trace = rec.chrome_trace()
     assert len(trace["traceEvents"]) == 4
     assert trace["otherData"]["dropped_events"] == 6
+
+
+def test_hidden_comm_and_overlap_efficiency(tmp_path):
+    """exposed=False comm events book hidden time: they feed
+    overlap_efficiency but never the exposed fraction."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.comm_event("reduce_scatter", "overlap", 1 << 20, None, 0.004, 8)
+    rec.comm_event("reduce_scatter", "overlap", 1 << 20, None, 0.012, 8,
+                   exposed=False)
+    record = rec.end_step()
+    comm = record["comm"]
+    assert comm["exposed_ms"] == pytest.approx(4.0)
+    assert comm["hidden_ms"] == pytest.approx(12.0)
+    assert comm["total_ms"] == pytest.approx(16.0)
+    assert comm["overlap_efficiency"] == pytest.approx(0.75)
+    row = comm["ops"]["reduce_scatter[overlap]"]
+    assert row["hidden_ms"] == pytest.approx(12.0)
+    assert row["total_ms"] == pytest.approx(4.0)  # exposed-only, as ever
+
+
+def test_no_comm_step_scores_perfect_overlap(tmp_path):
+    """A fully jitted step has no eager comm events: hidden==exposed==0 and
+    overlap_efficiency is vacuously 1.0 (trace_report prints the explicit
+    fully-fused note instead of implying a measurement)."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    record = rec.end_step()
+    assert record["comm"]["total_ms"] == 0.0
+    assert record["comm"]["overlap_efficiency"] == 1.0
+    assert not record["comm"]["ops"]
+
+
+def test_bucket_spans_land_in_overlap_section(tmp_path):
+    """bucket_reduce/<k> spans populate the step record's overlap section,
+    never the phase columns."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    with rec.span("backward"):
+        pass
+    for k in range(3):
+        with rec.bucket_span(k, nbytes=1024):
+            pass
+    record = rec.end_step()
+    assert record["overlap"]["buckets"] == 3
+    assert set(record["overlap"]["bucket_ms"]) == {
+        "bucket_reduce/0", "bucket_reduce/1", "bucket_reduce/2"}
+    assert set(record["phases"]) == {"backward"}
+    names = [e["name"] for e in rec.chrome_trace()["traceEvents"]]
+    assert names.count("bucket_reduce/1") == 1

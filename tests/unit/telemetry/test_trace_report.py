@@ -110,3 +110,77 @@ def test_cli_json_mode_and_chrome_validation(tmp_path, capsys):
     ok, detail = trace_report.validate_chrome_trace(
         str(tmp_path / "trace.json"))
     assert not ok and "missing keys" in detail
+
+
+FUSED_STEP = {"step": 0, "wall_ms": 40.0,
+              "phases": {"forward": 25.0, "backward": 10.0},
+              "comm": {"total_ms": 0.0, "exposed_ms": 0.0,
+                       "exposed_comm_fraction": 0.0, "ops": {}}}
+
+
+def test_fully_fused_step_prints_explicit_note(tmp_path):
+    """Zero comm events because the whole step is jitted: the report says
+    so instead of silently printing exposed-comm-fraction = 0."""
+    path = tmp_path / "steps.jsonl"
+    path.write_text(json.dumps(FUSED_STEP) + "\n")
+    steps = trace_report.load_steps(str(path))
+    summary = trace_report.summarize(steps)
+    assert summary["fused_steps"] == 1
+    assert summary["comm_attribution_unavailable"]
+    lines = []
+    trace_report.render_report(steps, summary, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "comm attribution unavailable (fully fused step)" in text
+    assert "(fused)" in text  # the per-step column says so too
+
+
+def test_mixed_fused_steps_keep_measured_fractions(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    path.write_text(json.dumps(FIXTURE[0]) + "\n" +
+                    json.dumps(FUSED_STEP) + "\n")
+    steps = trace_report.load_steps(str(path))
+    summary = trace_report.summarize(steps)
+    assert summary["fused_steps"] == 1
+    assert not summary["comm_attribution_unavailable"]
+    lines = []
+    trace_report.render_report(steps, summary, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "0.200" in text and "(fused)" in text
+    assert "comm attribution unavailable" not in text
+
+
+def test_hidden_comm_feeds_overlap_efficiency():
+    rec = dict(FIXTURE[0])
+    rec["comm"] = dict(rec["comm"], hidden_ms=60.0)
+    summary = trace_report.summarize([rec])
+    assert summary["hidden_comm_ms_mean"] == 60.0
+    assert summary["overlap_efficiency"] == 60.0 / 80.0
+    lines = []
+    trace_report.render_report([rec], summary, print_fn=lines.append)
+    assert any("overlap-efficiency" in ln for ln in lines)
+
+
+def test_overlap_sweep_from_comm_summary(tmp_path, capsys):
+    """A ds_bench --trace overlap sweep dir: per-bucket-size candidates
+    surface in both the table and --json (the autotuner feed)."""
+    (tmp_path / "comm_summary.json").write_text(json.dumps({
+        "ops": {"reduce_scatter[overlap_fp32_b1]": {
+            "count": 2, "total_ms": 5.0, "avg_ms": 2.5,
+            "msg_bytes": 1 << 20, "wire_bytes": 1 << 20, "gbps": 1.0}},
+        "overlap": [
+            {"bucket_mb": 1.0, "wire_dtype": "fp32", "buckets": 4,
+             "step_ms": 10.0, "comm_ms": 8.0, "hidden_ms": 6.0,
+             "exposed_comm_frac": 0.2, "overlap_efficiency": 0.75},
+            {"bucket_mb": 4.0, "wire_dtype": "int8", "buckets": 2,
+             "step_ms": 9.0, "comm_ms": 7.0, "hidden_ms": 2.0,
+             "exposed_comm_frac": 0.55, "overlap_efficiency": 0.3}]}))
+    rc = trace_report.main([str(tmp_path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["overlap_sweep"]) == 2
+    assert out["overlap_sweep"][0]["overlap_efficiency"] == 0.75
+    rc = trace_report.main([str(tmp_path)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "overlap sweep" in text
+    assert "best candidate: bucket_mb=1.0 wire=fp32" in text

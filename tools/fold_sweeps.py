@@ -24,19 +24,71 @@ def _load(path):
         return None
 
 
+def _load_ds_bench(path):
+    """ds_bench --json payload (dict with a ``rows`` list), else None."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) and isinstance(
+            rec.get("rows"), list) else None
+    except (OSError, ValueError):
+        return None
+
+
+def aggregate_overlap(paths):
+    """Merge overlap-sweep rows from ds_bench --json payloads: mean
+    overlap_efficiency / exposed_comm_frac per (bucket_mb, wire_dtype)
+    candidate, best first.  Returns a list of aggregate dicts (empty when
+    no file carries overlap rows)."""
+    cells = {}
+    for path in paths:
+        payload = _load_ds_bench(path)
+        if payload is None:
+            continue
+        for row in payload["rows"]:
+            if row.get("overlap_efficiency") is None or \
+                    row.get("bucket_mb") is None:
+                continue
+            key = (float(row["bucket_mb"]), row.get("wire_dtype", "?"))
+            c = cells.setdefault(key, {"n": 0, "eff": 0.0, "exposed": 0.0})
+            c["n"] += 1
+            c["eff"] += float(row["overlap_efficiency"])
+            c["exposed"] += float(row.get("exposed_comm_frac") or 0.0)
+    out = [{"bucket_mb": mb, "wire_dtype": wd, "runs": c["n"],
+            "overlap_efficiency": c["eff"] / c["n"],
+            "exposed_comm_frac": c["exposed"] / c["n"]}
+           for (mb, wd), c in cells.items()]
+    out.sort(key=lambda r: -r["overlap_efficiency"])
+    return out
+
+
 def main():
     runs = os.path.join(ROOT, ".bench_runs")
+    paths = sorted(glob.glob(os.path.join(runs, "*.json")) +
+                   glob.glob(os.path.join(runs, "sweeps", "*.json")))
     rows = []
-    for path in sorted(glob.glob(os.path.join(runs, "*.json")) +
-                       glob.glob(os.path.join(runs, "sweeps", "*.json"))):
+    for path in paths:
         rec = _load(path)
         if rec is None:
             continue
         name = os.path.relpath(path, runs).replace(".json", "")
         why = bench._untrustworthy(rec)
         rows.append((name, rec, why))
+    overlap = aggregate_overlap(paths)
+    if overlap:
+        print("overlap sweep (bucketed grad-reduce), best first:")
+        for r in overlap:
+            print(f"  bucket_mb={r['bucket_mb']:g} wire={r['wire_dtype']:<6}"
+                  f" overlap_eff={r['overlap_efficiency']:.3f}"
+                  f" exposed_frac={r['exposed_comm_frac']:.3f}"
+                  f" (n={r['runs']})")
+        best = overlap[0]
+        print(f"  → suggested comm_optimizations.overlap: "
+              f"{{\"enabled\": true, \"bucket_mb\": {best['bucket_mb']:g}}}")
+        print()
     if not rows:
-        print("no recorded runs yet (.bench_runs empty)")
+        if not overlap:
+            print("no recorded runs yet (.bench_runs empty)")
         return
     for name, rec, why in rows:
         flag = f"  [UNTRUSTED: {why}]" if why else ""

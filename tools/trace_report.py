@@ -80,13 +80,16 @@ def validate_chrome_trace(trace_path):
 
 
 def summarize(steps):
-    """Aggregate a run: mean wall/phases, merged comm attribution, and the
-    exposed-comm-fraction series."""
+    """Aggregate a run: mean wall/phases, merged comm attribution, the
+    exposed-comm-fraction series, and the overlap-efficiency figure
+    (hidden / total measured comm time)."""
     n = len(steps)
     phases = {}
     comm_ops = {}
     wall_total = 0.0
     exposed_total = 0.0
+    hidden_comm_total = 0.0
+    fused_steps = 0
     tokens_total = 0
     for rec in steps:
         wall_total += rec.get("wall_ms", 0.0)
@@ -94,18 +97,28 @@ def summarize(steps):
             phases[name] = phases.get(name, 0.0) + ms
         comm = rec.get("comm", {})
         exposed_total += comm.get("exposed_ms", 0.0)
+        hidden_comm_total += comm.get("hidden_ms", 0.0)
+        if not comm.get("ops") and not comm.get("total_ms", 0.0):
+            # the whole step ran inside one compiled graph: no eager
+            # collectives, so host-side comm attribution has nothing to
+            # measure (comm is hidden by construction, not absent)
+            fused_steps += 1
         for key, row in comm.get("ops", {}).items():
             agg = comm_ops.setdefault(key, {"count": 0, "total_ms": 0.0,
-                                            "msg_bytes": 0, "wire_bytes": 0})
+                                            "msg_bytes": 0, "wire_bytes": 0,
+                                            "hidden_ms": 0.0})
             agg["count"] += row.get("count", 0)
             agg["total_ms"] += row.get("total_ms", 0.0)
             agg["msg_bytes"] += row.get("msg_bytes", 0)
             agg["wire_bytes"] += row.get("wire_bytes", 0)
+            agg["hidden_ms"] += row.get("hidden_ms", 0.0)
         tokens_total += rec.get("metrics", {}).get("tokens", 0)
     for agg in comm_ops.values():
         agg["avg_ms"] = agg["total_ms"] / max(1, agg["count"])
-        agg["gbps"] = (agg["wire_bytes"] * 8 / (agg["total_ms"] / 1e3) / 1e9
-                       if agg["total_ms"] > 0 else 0.0)
+        comm_ms = agg["total_ms"] + agg.get("hidden_ms", 0.0)
+        agg["gbps"] = (agg["wire_bytes"] * 8 / (comm_ms / 1e3) / 1e9
+                       if comm_ms > 0 else 0.0)
+    comm_total = exposed_total + hidden_comm_total
     return {
         "steps": n,
         "wall_ms_mean": wall_total / n if n else 0.0,
@@ -115,6 +128,11 @@ def summarize(steps):
                                        if wall_total > 0 else 0.0),
         "hidden_ms_mean": max(0.0, (wall_total - exposed_total) / n)
         if n else 0.0,
+        "hidden_comm_ms_mean": hidden_comm_total / n if n else 0.0,
+        "overlap_efficiency": (hidden_comm_total / comm_total
+                               if comm_total > 0 else 1.0),
+        "fused_steps": fused_steps,
+        "comm_attribution_unavailable": bool(n and fused_steps == n),
         "comm_ops": comm_ops,
         "tokens_total": tokens_total,
         "tokens_per_sec": (tokens_total / (wall_total / 1e3)
@@ -147,8 +165,12 @@ def render_report(steps, summary, last=None, print_fn=print):
             line = f"{rec['step']:>6}{rec['wall_ms']:>10.2f}"
             for p in cols:
                 line += f"{rec.get('phases', {}).get(p, 0.0):>12.2f}"
-            line += (f"{comm.get('exposed_ms', 0.0):>10.2f}"
-                     f"{comm.get('exposed_comm_fraction', 0.0):>14.3f}")
+            if not comm.get("ops") and not comm.get("total_ms", 0.0):
+                # zero comm events ≠ zero comm: the step is fully jitted
+                line += f"{'-':>10}{'(fused)':>14}"
+            else:
+                line += (f"{comm.get('exposed_ms', 0.0):>10.2f}"
+                         f"{comm.get('exposed_comm_fraction', 0.0):>14.3f}")
             print_fn(line)
         print_fn("")
         print_fn(f"== run summary ({summary['steps']} steps) ==")
@@ -156,6 +178,16 @@ def render_report(steps, summary, last=None, print_fn=print):
                  f"exposed comm: {summary['exposed_ms_mean']:.2f} ms | "
                  f"exposed-comm-fraction: "
                  f"{summary['exposed_comm_fraction_mean']:.3f}")
+        if summary.get("hidden_comm_ms_mean", 0.0) > 0:
+            print_fn(f"hidden comm: {summary['hidden_comm_ms_mean']:.2f} ms"
+                     f" | overlap-efficiency (hidden/total comm): "
+                     f"{summary['overlap_efficiency']:.3f}")
+        if summary.get("comm_attribution_unavailable"):
+            print_fn("note: comm attribution unavailable (fully fused "
+                     "step) — no eager collectives ran; communication is "
+                     "scheduled inside the compiled step and the 0.000 "
+                     "exposed fraction above is a lower bound, not a "
+                     "measurement")
         if summary["tokens_per_sec"]:
             print_fn(f"tokens/s (all chips): {summary['tokens_per_sec']:.0f}")
         for name, ms in summary["phases_ms_mean"].items():
@@ -172,6 +204,26 @@ def render_report(steps, summary, last=None, print_fn=print):
     for key, agg in sorted(summary["comm_ops"].items()):
         print_fn(f"{key:<34}{agg['count']:>7}{agg['avg_ms']:>10.3f}"
                  f"{_fmt_bytes(agg['wire_bytes']):>10}{agg['gbps']:>10.2f}")
+    sweep = summary.get("overlap_sweep") or []
+    if sweep:
+        print_fn("")
+        print_fn("== overlap sweep (bucketed grad-reduce candidates) ==")
+        print_fn(f"{'bucket_mb':>10}{'wire':>8}{'buckets':>9}"
+                 f"{'step_ms':>10}{'comm_ms':>10}{'hidden_ms':>11}"
+                 f"{'exposed_frac':>14}{'overlap_eff':>13}")
+        for c in sweep:
+            print_fn(f"{c.get('bucket_mb', 0):>10g}"
+                     f"{c.get('wire_dtype', '-'):>8}"
+                     f"{c.get('buckets', 0):>9}"
+                     f"{c.get('step_ms', 0.0):>10.2f}"
+                     f"{c.get('comm_ms', 0.0):>10.2f}"
+                     f"{c.get('hidden_ms', 0.0):>11.2f}"
+                     f"{c.get('exposed_comm_frac', 0.0):>14.3f}"
+                     f"{c.get('overlap_efficiency', 0.0):>13.3f}")
+        best = max(sweep, key=lambda c: c.get("overlap_efficiency", 0.0))
+        print_fn(f"best candidate: bucket_mb={best.get('bucket_mb')} "
+                 f"wire={best.get('wire_dtype')} "
+                 f"overlap_efficiency={best.get('overlap_efficiency', 0):.3f}")
 
 
 def main(argv=None):
@@ -188,18 +240,25 @@ def main(argv=None):
 
     steps = load_steps(args.path)
     summary = summarize(steps)
+    comm_path = (os.path.join(args.path, "comm_summary.json")
+                 if os.path.isdir(args.path) else
+                 os.path.join(os.path.dirname(args.path),
+                              "comm_summary.json"))
+    archived = {}
+    if os.path.exists(comm_path):
+        with open(comm_path) as f:
+            archived = json.load(f)
+    if archived.get("overlap"):
+        # ds_bench overlap sweep: per-bucket-size overlap-efficiency rows
+        # (the autotuner's bucket-size feed)
+        summary["overlap_sweep"] = archived["overlap"]
     if not steps:
         # steps-less trace (ds_bench --trace): report from the archived
         # comm attribution alone instead of bailing
-        comm_path = (os.path.join(args.path, "comm_summary.json")
-                     if os.path.isdir(args.path) else
-                     os.path.join(os.path.dirname(args.path),
-                                  "comm_summary.json"))
-        if not os.path.exists(comm_path):
+        if not archived:
             print("no step records found", file=sys.stderr)
             return 1
-        with open(comm_path) as f:
-            summary["comm_ops"] = json.load(f).get("ops", {})
+        summary["comm_ops"] = archived.get("ops", {})
 
     trace_path = (os.path.join(args.path, "trace.json")
                   if os.path.isdir(args.path) else
