@@ -169,14 +169,17 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
 
 
 # ------------------------------------------------------------ overlap sweep
-# Bucketed grad-reduce candidates (bucket size × wire dtype): how much of
-# the gradient-reduction time can hide under backward compute at each
-# bucket granularity?  Feeds the overlap scheduler's bucket_mb choice (see
-# docs/overlap.md) the way the op sweep feeds wire_dtype.
+# Bucketed comm/compute-overlap candidates (bucket size × wire dtype), in
+# BOTH directions: how much of the gradient-reduction time can hide under
+# backward compute ("reduce"), and how much of the stage-3 param all-gather
+# can hide under forward compute ("gather")?  Feeds the overlap scheduler's
+# bucket_mb / prefetch.bucket_mb choices (see docs/overlap.md) the way the
+# op sweep feeds wire_dtype.
 
 OVERLAP_BUCKET_MBS = (1.0, 4.0, 16.0)
 OVERLAP_WIRES = ("fp32", "int8")
 OVERLAP_LAYERS = 8
+OVERLAP_DIRECTIONS = ("reduce", "gather")
 
 
 def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
@@ -276,13 +279,24 @@ def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
         wire_bytes = elems * 4 * layers
     else:
         wire_bytes = Q.quantized_wire_bytes(elems, wire, GROUP_SIZE) * layers
+    return _candidate_row("reduce", bucket_mb, wire, len(buckets), elems,
+                          layers, wire_bytes, t_compute, t_comm, t_step,
+                          t_mono)
+
+
+def _candidate_row(direction, bucket_mb, wire, n_buckets, elems, layers,
+                   wire_bytes, t_compute, t_comm, t_step, t_mono):
+    """Shared overlap-candidate accounting: exposed = step − compute,
+    hidden = comm − exposed, efficiency = hidden / comm — identical for
+    the reduce (backward) and gather (forward prefetch) directions."""
     exposed = max(0.0, t_step - t_compute)
     hidden = min(t_comm, max(0.0, t_comm - exposed))
     return {
         "op": "overlap",
+        "direction": direction,
         "bucket_mb": float(bucket_mb),
         "wire_dtype": wire,
-        "buckets": len(buckets),
+        "buckets": n_buckets,
         "bytes": int(elems * 4 * layers),
         "wire_bytes": int(wire_bytes),
         "layers": int(layers),
@@ -297,47 +311,168 @@ def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
     }
 
 
+def _gather_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
+                      iters, warmup, recorder=None):
+    """Measure one forward-direction (bucket_mb, wire_dtype) prefetch
+    candidate.
+
+    Synthetic stage-3 forward: per-layer ZeRO-sharded param leaves + a
+    matmul chain (the layer compute).  Three compiled programs — compute-
+    only, gather-only (per bucket, so the trace carries real per-bucket
+    costs), and the prefetched step where segment *k* of the chain is
+    fenced to bucket *k*'s gathered params via ``optimization_barrier``
+    (the layers that need bucket *k* run once its params arrive, while
+    bucket *k+1*'s gather — independent of the chain — may run
+    underneath).  ``wire`` = "fp32" is the plain all-gather; anything else
+    is the qwZ quantized all-gather at that wire dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..comm.collectives import quantized as Q
+    from ..runtime.zero.overlap import partition_prefetch_buckets
+
+    n = mesh.shape[axis]
+    elems = total_bytes // 4 // layers
+    elems = max(n * GROUP_SIZE, elems // (n * GROUP_SIZE) * (n * GROUP_SIZE))
+    params = [jnp.linspace(-1.0, 1.0, elems, dtype=jnp.float32)
+              for _ in range(layers)]
+    H = 256
+    x = jnp.ones((8, H), jnp.float32)
+    w = jnp.eye(H, dtype=jnp.float32) * 0.999
+
+    buckets = partition_prefetch_buckets(
+        [(f"layer_{i}", p) for i, p in enumerate(params)],
+        int(bucket_mb * (1 << 20)))
+
+    def gather_leaf(p):
+        if wire == "fp32":
+            return jax.lax.all_gather(p, axis, axis=0, tiled=True)
+        return Q.quantized_all_gather(p, (axis, ), 0, wire, GROUP_SIZE)
+
+    def sm(fn, out_specs):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P(), P(), P(axis)),
+            out_specs=out_specs, check_vma=False))
+
+    def compute_only(x, w, params):
+        cur = x
+        for _ in range(len(buckets)):
+            cur = cur @ w
+        return cur
+
+    def prefetched(x, w, params):
+        cur = x
+        full = [None] * len(params)
+        for b in buckets:
+            gathered = tuple(gather_leaf(params[i]) for i in b.indices)
+            tied = jax.lax.optimization_barrier(gathered + (cur, ))
+            cur = tied[-1] @ w
+            for j, i in enumerate(b.indices):
+                full[i] = tied[j]
+        return cur, tuple(full)
+
+    def monolithic(x, w, params):
+        full = tuple(gather_leaf(p) for p in params)
+        tied = jax.lax.optimization_barrier(full + (x, ))
+        cur = tied[-1]
+        for _ in range(len(buckets)):
+            cur = cur @ w
+        return cur, tied[:-1]
+
+    out_full = tuple(P() for _ in params)  # gathered: replicated over axis
+    args = (x, w, tuple(params))
+    t_compute = _timed(sm(compute_only, P()), args, iters, warmup)
+    t_step = _timed(sm(prefetched, (P(), out_full)), args, iters, warmup)
+    t_mono = _timed(sm(monolithic, (P(), out_full)), args, iters, warmup)
+    t_comm = 0.0
+    for b in buckets:
+        idx = b.indices
+
+        def bucket_fn(x, w, params, _idx=idx):
+            return tuple(gather_leaf(params[i]) for i in _idx)
+
+        fn = sm(bucket_fn, tuple(P() for _ in idx))
+        if recorder is not None:
+            with recorder.bucket_span(b.index, kind="param_gather",
+                                      nbytes=b.nbytes):
+                t_b = _timed(fn, args, iters, warmup)
+        else:
+            t_b = _timed(fn, args, iters, warmup)
+        t_comm += t_b
+
+    if wire == "fp32":
+        wire_bytes = elems * 4 * layers
+    else:
+        wire_bytes = Q.quantized_wire_bytes(elems, wire, GROUP_SIZE) * layers
+    return _candidate_row("gather", bucket_mb, wire, len(buckets), elems,
+                          layers, wire_bytes, t_compute, t_comm, t_step,
+                          t_mono)
+
+
 def run_overlap_sweep(axis="dp", mesh=None, bucket_mbs=OVERLAP_BUCKET_MBS,
                       wires=OVERLAP_WIRES, total_mb=8.0,
                       layers=OVERLAP_LAYERS, iters=10, warmup=2,
-                      print_fn=print, recorder=None):
-    """bucket_mb × wire_dtype sweep of the bucketed grad-reduce scheduler.
-    Returns candidate dicts (the ``--json`` rows / comm_summary ``overlap``
-    section)."""
+                      print_fn=print, recorder=None,
+                      directions=OVERLAP_DIRECTIONS):
+    """bucket_mb × wire_dtype sweep of the bucketed overlap schedulers, one
+    pass per ``direction``: "reduce" (backward grad reduce-scatter) and
+    "gather" (forward stage-3 param all-gather prefetch).  Returns
+    candidate dicts (the ``--json`` rows / comm_summary ``overlap``
+    section), each tagged with its ``direction``."""
     from ..utils import groups
     if mesh is None:
         mesh = groups.get_mesh_state().mesh
-    print_fn(f"# overlap sweep: mesh={dict(mesh.shape)} axis={axis} "
-             f"total={total_mb}MiB layers={layers}")
-    print_fn(f"{'bucket_mb':>10}{'wire':>8}{'buckets':>9}{'compute_ms':>12}"
-             f"{'comm_ms':>10}{'step_ms':>10}{'mono_ms':>10}"
-             f"{'exposed_frac':>14}{'overlap_eff':>13}")
+    unknown = [d for d in directions if d not in OVERLAP_DIRECTIONS]
+    if unknown:
+        # a --overlap-directions typo must not burn a sweep under a
+        # mislabeled tag that every report then silently drops
+        raise ValueError(
+            f"unknown overlap sweep direction(s) {unknown!r} — valid: "
+            f"{', '.join(OVERLAP_DIRECTIONS)}")
     out = []
-    for wire in wires:
-        for mb in bucket_mbs:
-            c = _overlap_candidate(mesh, axis, mb, wire,
-                                   int(total_mb * (1 << 20)), layers,
-                                   iters, warmup, recorder=recorder)
-            out.append(c)
-            if recorder is not None:
-                # exposed/hidden split rides the standard comm-event spine
-                variant = f"overlap_{wire}_b{mb:g}"
-                recorder.comm_event("reduce_scatter", variant, c["bytes"],
-                                    c["wire_bytes"], c["exposed_ms"] / 1e3,
-                                    world_size=mesh.shape[axis])
-                recorder.comm_event("reduce_scatter", variant, 0,
-                                    0, c["hidden_ms"] / 1e3,
-                                    world_size=mesh.shape[axis],
-                                    exposed=False)
-            print_fn(f"{mb:>10g}{wire:>8}{c['buckets']:>9}"
-                     f"{c['compute_ms']:>12.3f}{c['comm_ms']:>10.3f}"
-                     f"{c['step_ms']:>10.3f}{c['monolithic_ms']:>10.3f}"
-                     f"{c['exposed_comm_frac']:>14.3f}"
-                     f"{c['overlap_efficiency']:>13.3f}")
-    best = max(out, key=lambda c: c["overlap_efficiency"])
-    print_fn(f"# best: bucket_mb={best['bucket_mb']:g} "
-             f"wire={best['wire_dtype']} "
-             f"overlap_efficiency={best['overlap_efficiency']:.3f}")
+    for direction in directions:
+        measure = (_overlap_candidate if direction == "reduce"
+                   else _gather_candidate)
+        # the hidden/exposed comm-event rows use the base op the direction
+        # actually sweeps, in the op[variant] vocabulary of training traces
+        base_op = "reduce_scatter" if direction == "reduce" else "all_gather"
+        var_prefix = "overlap" if direction == "reduce" else "prefetch"
+        print_fn(f"# overlap sweep: direction={direction} "
+                 f"mesh={dict(mesh.shape)} axis={axis} "
+                 f"total={total_mb}MiB layers={layers}")
+        print_fn(f"{'bucket_mb':>10}{'wire':>8}{'buckets':>9}"
+                 f"{'compute_ms':>12}"
+                 f"{'comm_ms':>10}{'step_ms':>10}{'mono_ms':>10}"
+                 f"{'exposed_frac':>14}{'overlap_eff':>13}")
+        cands = []
+        for wire in wires:
+            for mb in bucket_mbs:
+                c = measure(mesh, axis, mb, wire,
+                            int(total_mb * (1 << 20)), layers,
+                            iters, warmup, recorder=recorder)
+                cands.append(c)
+                if recorder is not None:
+                    # exposed/hidden split rides the comm-event spine
+                    variant = f"{var_prefix}_{wire}_b{mb:g}"
+                    recorder.comm_event(base_op, variant, c["bytes"],
+                                        c["wire_bytes"],
+                                        c["exposed_ms"] / 1e3,
+                                        world_size=mesh.shape[axis])
+                    recorder.comm_event(base_op, variant, 0,
+                                        0, c["hidden_ms"] / 1e3,
+                                        world_size=mesh.shape[axis],
+                                        exposed=False)
+                print_fn(f"{mb:>10g}{wire:>8}{c['buckets']:>9}"
+                         f"{c['compute_ms']:>12.3f}{c['comm_ms']:>10.3f}"
+                         f"{c['step_ms']:>10.3f}{c['monolithic_ms']:>10.3f}"
+                         f"{c['exposed_comm_frac']:>14.3f}"
+                         f"{c['overlap_efficiency']:>13.3f}")
+        best = max(cands, key=lambda c: c["overlap_efficiency"])
+        print_fn(f"# best {direction}: bucket_mb={best['bucket_mb']:g} "
+                 f"wire={best['wire_dtype']} "
+                 f"overlap_efficiency={best['overlap_efficiency']:.3f}")
+        out.extend(cands)
     return out
 
 
@@ -354,7 +489,8 @@ _TRACE_VARIANTS = {
 def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         iters=20, warmup=3, print_fn=print, intra=0, json_path=None,
         trace_dir=None, overlap=False, overlap_total_mb=8.0,
-        overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES):
+        overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES,
+        overlap_directions=OVERLAP_DIRECTIONS):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
     (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps); with
     ``json_path``, also writes them as machine-readable JSON; with
@@ -412,13 +548,14 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             axis=axis, mesh=mesh, bucket_mbs=overlap_bucket_mbs,
             wires=overlap_wires, total_mb=overlap_total_mb,
             iters=max(2, iters // 2), warmup=warmup, print_fn=print_fn,
-            recorder=recorder)
+            recorder=recorder, directions=overlap_directions)
     if json_path:
         # uniform row schema: overlap fields present on every row so
         # BENCH_* aggregation (tools/fold_sweeps.py) never key-errors
         json_rows = [{"op": op, "bytes": int(size), "wire_bytes": int(wire),
                       "latency_us": lat * 1e6, "algbw_gbps": algbw,
                       "busbw_gbps": busbw, "bucket_mb": None,
+                      "direction": None,
                       "overlap_efficiency": None, "exposed_comm_frac": None}
                      for op, size, wire, lat, algbw, busbw in rows]
         for c in overlap_rows:
@@ -476,8 +613,13 @@ def cli_main(argv=None):
                     "per-variant comm attribution) under DIR alongside "
                     "the --json rows")
     ap.add_argument("--overlap", action="store_true",
-                    help="also sweep the bucketed grad-reduce overlap "
-                    "scheduler (bucket_mb × wire dtype; docs/overlap.md)")
+                    help="also sweep the bucketed overlap schedulers "
+                    "(bucket_mb × wire dtype, reduce AND gather "
+                    "directions; docs/overlap.md)")
+    ap.add_argument("--overlap-directions", default=None,
+                    metavar="D[,D]",
+                    help="comma-separated overlap sweep directions "
+                    "(default reduce,gather)")
     ap.add_argument("--overlap-total-mb", type=float, default=8.0,
                     help="total gradient payload for the overlap sweep")
     ap.add_argument("--overlap-buckets", default=None, metavar="MB,MB,…",
@@ -499,7 +641,10 @@ def cli_main(argv=None):
                                   args.overlap_buckets.split(","))
                             if args.overlap_buckets else OVERLAP_BUCKET_MBS),
         overlap_wires=(tuple(args.overlap_wires.split(","))
-                       if args.overlap_wires else OVERLAP_WIRES))
+                       if args.overlap_wires else OVERLAP_WIRES),
+        overlap_directions=(tuple(args.overlap_directions.split(","))
+                            if args.overlap_directions
+                            else OVERLAP_DIRECTIONS))
 
 
 if __name__ == "__main__":

@@ -13,6 +13,21 @@ from .quantized import DEFAULT_GROUP_SIZE
 
 
 @dataclass
+class Prefetch:
+    """Forward-direction ZeRO-3 param-gather prefetch knobs (see
+    ``runtime/zero/overlap.py`` / docs/overlap.md forward-prefetch
+    section).  Own enable gate, independent of ``Overlap.enabled``."""
+    enabled: bool = False
+    # bucket payload bound in MiB; 0 = the 32 MiB overlap default (the
+    # config layer stamps this from stage3_prefetch_bucket_size when that
+    # reference knob armed the prefetch)
+    bucket_mb: float = 0.0
+    # max buckets with their all-gather outstanding; clamped per model by
+    # stage3_max_live_parameters
+    max_inflight: int = 2
+
+
+@dataclass
 class Overlap:
     """Bucketed backward-pass gradient-reduction scheduler knobs (see
     ``runtime/zero/overlap.py`` / docs/overlap.md).  Own enable gate:
@@ -22,6 +37,8 @@ class Overlap:
     bucket_mb: float = 32.0
     # manual qgZ path: max buckets with the inter-node hop outstanding
     max_inflight: int = 2
+    # forward-direction stage-3 param-gather prefetch
+    prefetch: Prefetch = field(default_factory=Prefetch)
 
 
 @dataclass

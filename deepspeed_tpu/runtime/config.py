@@ -80,6 +80,25 @@ class CommsConfig(DeepSpeedConfigModel):
         return self.comms_logger.enabled
 
 
+class PrefetchConfig(DeepSpeedConfigModel):
+    """``"comm_optimizations.overlap.prefetch"`` — the forward-direction
+    ZeRO-3 param-gather prefetch (``runtime/zero/overlap.py``,
+    docs/overlap.md).  Own gate, independent of ``overlap.enabled``: the
+    two directions (backward grad reduce, forward param gather) compose
+    but arm separately.  Reference configs arm it via an explicit
+    ``zero_optimization.stage3_prefetch_bucket_size`` instead (0 there
+    keeps it off); an explicit block here wins — loudly."""
+    enabled: bool = False
+    # bucket payload bound in MiB; 0 (default) = the 32 MiB overlap
+    # default.  Configs armed via an explicit
+    # zero_optimization.stage3_prefetch_bucket_size get bucket_mb stamped
+    # from that ELEMENT count × the compute dtype itemsize.
+    bucket_mb: float = Field(0.0, ge=0)
+    # max buckets with their all-gather outstanding; further clamped per
+    # model by stage3_max_live_parameters (overlap.live_window)
+    max_inflight: int = Field(2, ge=1)
+
+
 class OverlapConfig(DeepSpeedConfigModel):
     """``"comm_optimizations.overlap"`` — the bucketed backward-pass
     gradient-reduction scheduler (``runtime/zero/overlap.py``,
@@ -88,6 +107,8 @@ class OverlapConfig(DeepSpeedConfigModel):
     reduce is split into ``bucket_mb``-bounded buckets dispatched inside
     the backward graph as each layer's gradients materialize, so XLA (or
     the manual qgZ pipeline) can hide the reduce under remaining backward
+    compute.  The nested ``prefetch`` block is the forward mirror: the
+    stage-3 param all-gather issued bucket by bucket under the forward
     compute."""
     enabled: bool = False
     # bucket size bound in MiB of gradient payload; fractional values are
@@ -96,6 +117,8 @@ class OverlapConfig(DeepSpeedConfigModel):
     # manual (qgZ) path only: how many buckets may have their inter-node
     # hop outstanding at once; the GSPMD path leaves scheduling to XLA
     max_inflight: int = Field(2, ge=1)
+    # forward-direction stage-3 param-gather prefetch (own enable gate)
+    prefetch: PrefetchConfig = PrefetchConfig()
 
 
 class CommOptimizationsConfig(DeepSpeedConfigModel):
@@ -472,6 +495,38 @@ class DeepSpeedConfig:
                     or {})
         if self.zero_config.overlap_comm and "enabled" not in _ov_user:
             self.comm_optimizations_config.overlap.enabled = True
+        # reference-compat: an EXPLICIT ``stage3_prefetch_bucket_size``
+        # arms the forward param-gather prefetch (the knob was previously
+        # parsed but silently ignored); 0 keeps prefetch off (reference
+        # semantics).  An explicit overlap.prefetch block wins — loudly,
+        # so a config carrying both knows which knob is steering.
+        _pf_user = (_ov_user.get("prefetch") or {}) \
+            if isinstance(_ov_user, dict) else {}
+        _zo_user = pd.get("zero_optimization") or {}
+        _pf_knob = ("stage3_prefetch_bucket_size" in _zo_user
+                    or "prefetch_bucket_size" in _zo_user)
+        if _pf_knob and self.zero_config.stage >= 3:
+            if "enabled" in _pf_user:
+                logger.warning(
+                    "zero_optimization.stage3_prefetch_bucket_size is "
+                    "overridden by the explicit "
+                    "comm_optimizations.overlap.prefetch block (prefetch "
+                    "stays %s); the stage3 knob only arms the prefetch "
+                    "when no explicit block is present",
+                    "enabled" if self.comm_optimizations_config.overlap
+                    .prefetch.enabled else "disabled")
+            else:
+                _pf = self.comm_optimizations_config.overlap.prefetch
+                _pf.enabled = self.zero_config.prefetch_bucket_size > 0
+                if _pf.enabled and "bucket_mb" not in _pf_user:
+                    # the knob is an ELEMENT count (reference units) —
+                    # stamp the byte bound here, where we know the knob
+                    # was explicit (the field's 5e7 default must not
+                    # silently size buckets)
+                    _itemsize = 2 if (self.fp16_enabled
+                                      or self.bfloat16_enabled) else 4
+                    _pf.bucket_mb = (self.zero_config.prefetch_bucket_size
+                                     * _itemsize / float(1 << 20))
         self.flops_profiler_config = FlopsProfilerConfig(
             **pd.get("flops_profiler", {}) or {})
         self.hybrid_engine = HybridEngineConfig(

@@ -1111,18 +1111,20 @@ class DeepSpeedEngine:
     def _micro_step_fn(self):
         """Build (loss, grads) = value_and_grad over compute params."""
         if self._onebit_opt is not None:
-            from .zero.overlap import overlap_opts
+            from .zero.overlap import overlap_opts, prefetch_opts
             if overlap_opts(self._config.comm_optimizations_config) \
+                    is not None or \
+                    prefetch_opts(self._config.comm_optimizations_config) \
                     is not None:
                 # LOUD: the 1-bit micro manages its own gradient exchange
                 # (error-compensated compressed all-reduce) — a user who
-                # armed overlap (or overlap_comm) must not believe the
-                # bucket scheduler is hiding anything here
+                # armed overlap (or overlap_comm / prefetch) must not
+                # believe the bucket schedulers are hiding anything here
                 logger.warning(
-                    "comm_optimizations.overlap is ignored with 1-bit "
-                    "optimizers: their micro-step consumes unreduced "
-                    "per-worker grads and runs its own compressed "
-                    "exchange (docs/overlap.md limits)")
+                    "comm_optimizations.overlap (and overlap.prefetch) is "
+                    "ignored with 1-bit optimizers: their micro-step "
+                    "consumes unreduced per-worker grads and runs its own "
+                    "compressed exchange (docs/overlap.md limits)")
             # 1-bit optimizers consume *unreduced* per-worker grads
             return self._onebit_opt.build_micro(self)
         apply_fn = self._effective_apply_fn()
@@ -1136,10 +1138,26 @@ class DeepSpeedEngine:
             # the legacy ZeRO++ knob or the comm_optimizations block.
             from .zero.zeropp import build_manual_dp_micro
             return build_manual_dp_micro(self)
+        from .zero.overlap import prefetch_opts, resolve_prefetch
+        pf = prefetch_opts(co)
+        if pf is not None and self.zero_stage < 3:
+            if not getattr(self, "_prefetch_stage_warned", False):
+                self._prefetch_stage_warned = True
+                # LOUD: below stage 3 params are not sharded — there is no
+                # forward all-gather for the prefetch pipeline to hide
+                logger.warning(
+                    "comm_optimizations.overlap.prefetch is ignored at "
+                    "ZeRO stage %d: the stage-3 param all-gather it "
+                    "pipelines does not exist (params replicated)",
+                    self.zero_stage)
+            pf = None
+        pf_resolved = resolve_prefetch(pf, zc) if pf is not None else None
         qw = (zc.zero_quantized_weights or
               (co_on and co.quantized_weights)) and self.zero_stage >= 3
         if qw:
-            # qwZ: int8 param all-gather (straight-through bwd)
+            # qwZ: int8 param all-gather (straight-through bwd); with
+            # prefetch armed the gather itself runs the bucket pipeline,
+            # so the GSPMD marker path below is skipped
             from .zero.zeropp import quantized_weight_gather
             inner = apply_fn
             qw_fmt, qw_gs = self.plan.param_wire(
@@ -1147,7 +1165,8 @@ class DeepSpeedEngine:
             apply_fn = lambda params, *inputs: inner(
                 quantized_weight_gather(params, self.plan,
                                         wire_format=qw_fmt,
-                                        group_size=qw_gs), *inputs)
+                                        group_size=qw_gs,
+                                        prefetch=pf_resolved), *inputs)
         dc = self._config.domino_config
         if dc.enabled:
             if self.progressive_layer_drop is not None:
@@ -1185,6 +1204,37 @@ class DeepSpeedEngine:
                 marked = mark_tree(params, self.plan.grad_shardings(params),
                                    buckets)
                 return inner_loss_fn(marked, scale, inputs)
+
+        if pf_resolved is not None and not qw:
+            # forward-direction prefetch (GSPMD flavor): per-bucket
+            # custom_vjp markers apply the *gathered* sharding constraints
+            # — and thus XLA's all-gathers — inside the forward graph, in
+            # forward-layer order with a max_live-bounded in-flight window,
+            # so bucket k+1's gather is issued while bucket k's layers
+            # compute (docs/overlap.md forward-prefetch section).  The qwZ
+            # path pipelines its own quantized gather above instead.
+            from .zero.overlap import (describe_buckets, mark_gather_tree,
+                                       prefetch_buckets_for)
+            inner_pf_fn = loss_fn
+
+            def loss_fn(params, scale, inputs):
+                buckets, window, _ = prefetch_buckets_for(
+                    params, self.plan, pf_resolved)
+                if not buckets:
+                    # every leaf persistent (or tp-claimed): nothing to
+                    # gather, keep the program untouched
+                    return inner_pf_fn(params, scale, inputs)
+                if _telemetry.enabled and \
+                        not getattr(self, "_prefetch_meta_emitted", False):
+                    self._prefetch_meta_emitted = True
+                    _telemetry.metadata(
+                        "prefetch_buckets",
+                        {"window": window,
+                         "buckets": describe_buckets(buckets)})
+                marked = mark_gather_tree(
+                    params, self.plan.gather_shardings(params), buckets,
+                    max_inflight=window)
+                return inner_pf_fn(marked, scale, inputs)
 
         def micro(params, scale, inputs):
             (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(

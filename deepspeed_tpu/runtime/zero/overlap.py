@@ -1,4 +1,4 @@
-"""Bucketed backward-pass gradient-reduction scheduler.
+"""Bucketed comm/compute-overlap schedulers for both halves of ZeRO.
 
 Without this module every ZeRO-2/3 gradient reduce runs *after* the
 backward compute that produces it: the engine's micro-step takes
@@ -34,9 +34,39 @@ module is the TPU-native translation:
   the quantized DCN all-to-all of bucket *k−1* runs while bucket *k* is
   still in its intra-node psum_scatter.
 
-Disabled (the default ``comm_optimizations.overlap.enabled: false``) the
-engine never imports this module on the hot path and the compiled HLO is
-bit-identical to the unbucketed step.
+ZeRO-3's *other* half — the parameter all-gather that precedes every
+layer's forward (and its re-gather before backward) — gets the mirrored
+forward-direction treatment, the TPU analog of the reference's prefetch
+coordinator (``partitioned_param_coordinator.py``,
+``stage3_prefetch_bucket_size``):
+
+* :func:`partition_prefetch_buckets` — the same size-bounded greedy
+  partition in **forward-layer order** (the order params are consumed),
+  with persistent (replicated) leaves excluded: they were never sharded,
+  so there is nothing to gather or to count against the live-parameter
+  budget.
+
+* :func:`mark_gather_tree` — the GSPMD hook: each bucket's leaves pass
+  through a ``custom_vjp`` identity whose *forward* ties the bucket with
+  one ``optimization_barrier`` and applies the bucket's **gathered**
+  sharding constraints, emitting that bucket's all-gathers inside the
+  forward graph where the latency-hiding scheduler can issue bucket
+  *k+1*'s gather while bucket *k*'s layers compute.  Bucket *k* is fenced
+  behind bucket *k−window*'s gathered output, so at most ``window``
+  buckets prefetch ahead — :func:`live_window` derives that bound from
+  ``stage3_max_live_parameters`` so live gathered params never
+  materialize the whole model.  Backward is the identity: the gather's
+  transpose (the gradient reduce) stays wherever the engine / the
+  backward scheduler above put it.
+
+* :func:`pipelined_gather` — the manual-SPMD hook: pipeline ``zeropp``'s
+  (quantized) per-leaf all-gather bucket by bucket with the same bounded
+  in-flight window, qwZ wire format and all.
+
+Disabled (the default ``comm_optimizations.overlap.enabled: false``, and
+``overlap.prefetch.enabled: false``) the engine never imports this module
+on the hot path and the compiled HLO is bit-identical to the unbucketed
+step.
 """
 
 import numpy as np
@@ -51,22 +81,30 @@ MB = 1 << 20
 #: custom_vjp per bucket; the structural unit tests key off this.
 BUCKET_MARKER = "bucket_reduce"
 
+#: forward-direction analog: one ``param_gather_<k>`` marker per prefetch
+#: bucket (named scope in the forward graph, ``param_gather/<k>`` spans in
+#: telemetry)
+GATHER_MARKER = "param_gather"
+
 
 class GradBucket:
     """One size-bounded group of gradient leaves, dispatched as a unit.
 
     ``indices`` point into the *forward-order* flattened leaf list (what
     ``jax.tree_util.tree_flatten`` yields); buckets themselves are ordered
-    by dispatch time, i.e. reverse-layer.
+    by dispatch time: reverse-layer for the gradient reduce, forward-layer
+    for the param-gather prefetch.  ``elems`` is the bucket's element
+    count — the unit ``stage3_max_live_parameters`` budgets in.
     """
 
-    __slots__ = ("index", "indices", "paths", "nbytes")
+    __slots__ = ("index", "indices", "paths", "nbytes", "elems")
 
-    def __init__(self, index, indices, paths, nbytes):
+    def __init__(self, index, indices, paths, nbytes, elems=0):
         self.index = index
         self.indices = tuple(indices)
         self.paths = tuple(paths)
         self.nbytes = int(nbytes)
+        self.elems = int(elems)
 
     def __repr__(self):
         return (f"GradBucket({self.index}, leaves={len(self.indices)}, "
@@ -79,43 +117,59 @@ def leaf_nbytes(x):
     return int(np.prod(shape, dtype=np.int64)) * int(itemsize)
 
 
-def partition_buckets(items, bucket_bytes):
-    """Group ``items`` (forward-order ``(path, leaf)`` pairs) into
-    size-bounded buckets in reverse-layer order.
+def leaf_elems(x):
+    return int(np.prod(getattr(x, "shape", ()), dtype=np.int64))
 
-    Invariants (unit-tested):
 
-    * every leaf lands in exactly one bucket (exact cover);
+def _greedy_partition(indexed_items, bucket_bytes):
+    """The one greedy close-on-overflow partitioner both directions share.
+
+    ``indexed_items`` yields ``(index, path, leaf)`` triples in dispatch
+    order (reverse-layer for the grad reduce, forward-layer for the
+    prefetch).  Invariants (unit-tested from both wrappers):
+
+    * every yielded leaf lands in exactly one bucket (exact cover);
     * a bucket closes before adding a leaf would exceed ``bucket_bytes``
       (so every bucket except possibly single-leaf ones respects the
       bound);
     * a single leaf larger than ``bucket_bytes`` gets its own bucket;
-    * concatenating buckets yields the exact reverse of the forward leaf
-      order — the order cotangents materialize during backward.
+    * concatenating buckets preserves the yielded order.
     """
     bucket_bytes = max(1, int(bucket_bytes))
     buckets = []
-    cur_idx, cur_paths, cur_bytes = [], [], 0
+    cur_idx, cur_paths, cur_bytes, cur_elems = [], [], 0, 0
 
     def close():
-        nonlocal cur_idx, cur_paths, cur_bytes
+        nonlocal cur_idx, cur_paths, cur_bytes, cur_elems
         if cur_idx:
             buckets.append(GradBucket(len(buckets), cur_idx, cur_paths,
-                                      cur_bytes))
-            cur_idx, cur_paths, cur_bytes = [], [], 0
+                                      cur_bytes, cur_elems))
+            cur_idx, cur_paths, cur_bytes, cur_elems = [], [], 0, 0
 
-    n = len(items)
-    for rev, (path, leaf) in enumerate(reversed(items)):
+    for i, path, leaf in indexed_items:
         nb = leaf_nbytes(leaf)
         if cur_idx and cur_bytes + nb > bucket_bytes:
             close()
-        cur_idx.append(n - 1 - rev)
+        cur_idx.append(i)
         cur_paths.append(path)
         cur_bytes += nb
+        cur_elems += leaf_elems(leaf)
         if cur_bytes >= bucket_bytes:
             close()
     close()
     return buckets
+
+
+def partition_buckets(items, bucket_bytes):
+    """Group ``items`` (forward-order ``(path, leaf)`` pairs) into
+    size-bounded buckets in **reverse-layer order** — the order cotangents
+    materialize during backward (see :func:`_greedy_partition` for the
+    shared invariants)."""
+    n = len(items)
+    return _greedy_partition(
+        ((n - 1 - rev, path, leaf)
+         for rev, (path, leaf) in enumerate(reversed(items))),
+        bucket_bytes)
 
 
 def tree_buckets(tree, bucket_bytes):
@@ -131,7 +185,8 @@ def describe_buckets(buckets):
     """JSON-safe partition summary — trace metadata so a captured trace
     records which bucketing produced it (autotuner provenance)."""
     return [{"index": b.index, "leaves": len(b.indices),
-             "mb": round(b.nbytes / MB, 4), "paths": list(b.paths)}
+             "mb": round(b.nbytes / MB, 4), "elems": b.elems,
+             "paths": list(b.paths)}
             for b in buckets]
 
 
@@ -232,6 +287,264 @@ def pipelined_bucket_reduce(grads, buckets, stage1, stage2, max_inflight=2):
         for j, i in enumerate(b.indices):
             outs[i] = o[j]
     return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+# --------------------------------------------------------------------------
+# forward-direction param-gather prefetch (ZeRO-3)
+# --------------------------------------------------------------------------
+
+@jax.custom_vjp
+def fence(xs):
+    """``lax.optimization_barrier`` with a straight-through gradient.
+
+    The pinned jax has no AD rule for the raw primitive, and the GSPMD
+    qwZ gather pipeline runs *inside* the differentiated loss — the fence
+    shapes the forward schedule only, so cotangents pass through
+    unchanged."""
+    return jax.lax.optimization_barrier(tuple(xs))
+
+
+def _fence_fwd(xs):
+    return fence(xs), None
+
+
+def _fence_bwd(_, gs):
+    return (tuple(gs), )
+
+
+fence.defvjp(_fence_fwd, _fence_bwd)
+
+
+def partition_prefetch_buckets(items, bucket_bytes, skip=()):
+    """Group ``items`` (forward-order ``(path, leaf)`` pairs) into
+    size-bounded buckets in **forward-layer order** — the order the
+    forward pass consumes params, i.e. the order their all-gathers should
+    be issued (see :func:`_greedy_partition` for the shared invariants).
+
+    ``skip`` is the persistent-leaf path set: replicated leaves take part
+    in no gather, so they land in no bucket and count against no live
+    budget (the regression the per-leaf persistence tests pin down).
+    """
+    skip = frozenset(skip)
+    return _greedy_partition(
+        ((i, path, leaf) for i, (path, leaf) in enumerate(items)
+         if path not in skip),
+        bucket_bytes)
+
+
+def gather_items(params, plan):
+    """Forward-order ``(path, leaf)`` items plus the persistent path set.
+
+    A leaf is *persistent* when its param spec carries no ZeRO axis —
+    either it sits under the persistence threshold
+    (``stage3_param_persistence_threshold`` → ``min_partition_size``), its
+    dims are fully claimed by tensor parallelism, or the stage is < 3.
+    Persistent leaves are already replicated: no gather ever touches them,
+    and they must not occupy prefetch buckets or live-parameter budget.
+    """
+    from .partition import zero_dim
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    items, persistent = [], set()
+    for kp, x in flat:
+        p = path_str(kp)
+        items.append((p, x))
+        spec = plan.param_spec(getattr(x, "shape", ()), p)
+        dim, _axes = zero_dim(spec, plan.param_axes)
+        if dim is None:
+            persistent.add(p)
+    return items, persistent
+
+
+def live_window(buckets, max_live_params, max_inflight=2):
+    """Prefetch window: how many buckets may have their gather outstanding.
+
+    The largest ``W ≤ max_inflight`` such that every ``W`` consecutive
+    buckets hold at most ``max_live_params`` gathered **elements** — the
+    reference's ``stage3_max_live_parameters`` contract, expressed as a
+    pipeline depth instead of an eviction loop (XLA's liveness frees a
+    gathered bucket after its last use; the window bounds how far ahead
+    new gathers may be issued).  Always ≥ 1: the bucket being consumed
+    must exist regardless of budget.  ``max_live_params`` ≤ 0 means no
+    element bound (window = ``max_inflight``).
+    """
+    w = max(1, int(max_inflight))
+    if not buckets or not max_live_params or max_live_params <= 0:
+        return w
+    elems = [b.elems for b in buckets]
+    # a window wider than the bucket list means "everything outstanding at
+    # once" — validate it as the full list, or the sliding check below
+    # iterates an empty range and the budget is silently ignored
+    w = min(w, len(elems))
+    while w > 1 and any(sum(elems[k:k + w]) > max_live_params
+                        for k in range(len(elems) - w + 1)):
+        w -= 1
+    return w
+
+
+def _make_gather_marker(index, shardings, n_fence, fence_sds):
+    """custom_vjp over one bucket's leaves (+ the fence operands from
+    bucket ``index − window``): the forward ties the bucket's raw shards
+    and the fence values with ONE ``optimization_barrier`` — this bucket's
+    gather cannot be hoisted before the fenced bucket's gather has
+    completed — then applies the bucket's *gathered* sharding constraints,
+    emitting the all-gathers inside the forward graph.  The backward is
+    the identity on the bucket's cotangents (and exact zeros on the
+    fences, which only ordered the schedule): the gather's transpose stays
+    wherever the engine / the grad-reduce scheduler put it instead of
+    being forced replicated by ``with_sharding_constraint``'s own
+    transpose."""
+
+    def param_gather(args):
+        n = len(args) - n_fence
+        tied = jax.lax.optimization_barrier(tuple(args))
+        with jax.named_scope(f"{GATHER_MARKER}_{index}"):
+            return tuple(
+                x if s is None else jax.lax.with_sharding_constraint(x, s)
+                for x, s in zip(tied[:n], shardings))
+
+    param_gather.__name__ = f"{GATHER_MARKER}_{index}"
+    mark = jax.custom_vjp(param_gather)
+
+    def _fwd(args):
+        return param_gather(args), None
+
+    def _bwd(_, gs):
+        import jax.numpy as jnp
+        return (tuple(gs) + tuple(jnp.zeros(s.shape, s.dtype)
+                                  for s in fence_sds), )
+
+    mark.defvjp(_fwd, _bwd)
+    return mark
+
+
+def mark_gather_tree(params, gather_shardings, buckets, max_inflight=2):
+    """Apply per-bucket prefetch markers to ``params`` (GSPMD stage-3).
+
+    ``gather_shardings`` is the matching pytree of post-gather
+    ``NamedSharding``s (param sharding minus the ZeRO axes — tp survives).
+    Call *inside* the differentiated function: each bucket's all-gather is
+    then a separately schedulable unit in the forward graph, fenced behind
+    bucket ``k − max_inflight``'s gathered output so at most
+    ``max_inflight`` buckets prefetch ahead (pass the
+    :func:`live_window`-clamped value to honor
+    ``stage3_max_live_parameters``).  Leaves outside every bucket
+    (persistent) pass through untouched.
+    """
+    max_inflight = max(1, int(max_inflight))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shard_leaves = jax.tree_util.tree_leaves(gather_shardings)
+    if len(shard_leaves) != len(leaves):
+        raise ValueError(
+            f"gather_shardings tree ({len(shard_leaves)} leaves) does not "
+            f"match params ({len(leaves)} leaves)")
+    out = list(leaves)
+    done = []  # per bucket: gathered leaves (the fence operands)
+    for k, b in enumerate(buckets):
+        xs = [out[i] for i in b.indices]
+        fence_at = k - max_inflight
+        fences = tuple(done[fence_at]) if fence_at >= 0 else ()
+        mark = _make_gather_marker(
+            b.index, [shard_leaves[i] for i in b.indices], len(fences),
+            tuple(jax.ShapeDtypeStruct(f.shape, f.dtype) for f in fences))
+        g = mark(tuple(xs) + fences)
+        done.append(list(g))
+        for j, i in enumerate(b.indices):
+            out[i] = g[j]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def pipelined_gather(params, buckets, gather, max_inflight=2):
+    """Manual-SPMD prefetch pipeline: gather each bucket's leaves with a
+    bounded in-flight window.
+
+    ``gather(path, x)`` reassembles one leaf — ``zeropp``'s quantized qwZ
+    all-gather, a plain ``lax.all_gather``, or the identity for persistent
+    leaves.  Bucket *k*'s gather inputs are fenced behind bucket
+    *k−max_inflight*'s gathered outputs via ``lax.optimization_barrier``:
+    at most ``max_inflight`` buckets have their (DCN-crossing, when
+    quantized) gather outstanding while earlier buckets' layers compute —
+    the reference prefetch coordinator's in-flight window as graph
+    structure.  Leaves outside every bucket pass through ``gather``
+    unfenced (the identity for persistent leaves).  Buckets iterate in
+    forward-layer (consumption) order.
+    """
+    max_inflight = max(1, int(max_inflight))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = [path_str(kp) for kp, _ in flat]
+    leaves = [x for _, x in flat]
+    bucketed = {i for b in buckets for i in b.indices}
+    outs = [None if i in bucketed else gather(paths[i], leaves[i])
+            for i in range(len(leaves))]
+    done = []  # per bucket: gathered outputs (the fence operands)
+    for k, b in enumerate(buckets):
+        xs = [leaves[i] for i in b.indices]
+        fence_at = k - max_inflight
+        if fence_at >= 0 and done[fence_at]:
+            tied = fence(tuple(xs) + tuple(done[fence_at]))
+            xs = list(tied[:len(xs)])
+            old = list(tied[len(xs):])
+            prev = buckets[fence_at]
+            done[fence_at] = old
+            for j, i in enumerate(prev.indices):
+                outs[i] = old[j]
+        g = [gather(paths[i], x) for i, x in zip(b.indices, xs)]
+        done.append(g)
+        for j, i in enumerate(b.indices):
+            outs[i] = g[j]
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+def prefetch_opts(comm_opts):
+    """The ``comm_optimizations.overlap.prefetch`` block, or None when
+    absent/disabled.  Its gate is independent of ``overlap.enabled`` —
+    the two directions (backward grad reduce, forward param gather)
+    compose but arm separately."""
+    ov = getattr(comm_opts, "overlap", None) if comm_opts is not None \
+        else None
+    pf = getattr(ov, "prefetch", None) if ov is not None else None
+    if pf is None or not getattr(pf, "enabled", False):
+        return None
+    return pf
+
+
+def prefetch_bucket_bytes(pf):
+    """prefetch.bucket_mb → bytes; 0 (the default) falls back to the
+    grad-overlap default bound.  Configs armed via the reference knob
+    ``stage3_prefetch_bucket_size`` arrive with ``bucket_mb`` already
+    stamped from that element count (``runtime/config.py`` does it where
+    knob explicitness is known — the field's 5e7 default must not
+    silently size buckets)."""
+    mb = float(getattr(pf, "bucket_mb", 0.0))
+    if mb > 0:
+        return max(1, int(mb * MB))
+    return 32 * MB
+
+
+def resolve_prefetch(pf, zero_config=None):
+    """Normalize a prefetch block + the stage-3 live-parameter knob into
+    the plain numbers the gather hooks consume (one dict,
+    duck-type-free)."""
+    if pf is None:
+        return None
+    return {
+        "bucket_bytes": prefetch_bucket_bytes(pf),
+        "max_inflight": max(1, int(getattr(pf, "max_inflight", 2))),
+        "max_live_params": int(
+            getattr(zero_config, "max_live_parameters", 0) or 0)
+        if zero_config is not None else 0,
+    }
+
+
+def prefetch_buckets_for(params, plan, resolved):
+    """``(buckets, window, persistent)`` for a resolved prefetch config:
+    forward-order buckets over the gatherable leaves, the
+    max_live-clamped in-flight window, and the persistent path set."""
+    items, persistent = gather_items(params, plan)
+    buckets = partition_prefetch_buckets(items, resolved["bucket_bytes"],
+                                         skip=persistent)
+    window = live_window(buckets, resolved["max_live_params"],
+                         resolved["max_inflight"])
+    return buckets, window, persistent
 
 
 def overlap_opts(comm_opts):

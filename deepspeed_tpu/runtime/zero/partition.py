@@ -117,6 +117,23 @@ def zero_dim(spec, zero_axes):
     return None, ()
 
 
+def gathered_spec(spec, zero_axes):
+    """``spec`` with its ZeRO axes stripped from the zero dim — the leaf's
+    sharding AFTER the stage-3 all-gather (tp and other non-ZeRO axes
+    survive).  Persistent / unsharded leaves come back unchanged.  Shared
+    by the qwZ gather wrappers (``zeropp``) and the forward prefetch
+    markers (``overlap.mark_gather_tree``)."""
+    dim, axes = zero_dim(spec, zero_axes)
+    if dim is None:
+        return spec
+    entry = spec[dim]
+    names = entry if isinstance(entry, tuple) else (entry, )
+    kept = tuple(a for a in names if a not in axes)
+    new = list(spec)
+    new[dim] = kept if len(kept) > 1 else (kept[0] if kept else None)
+    return P(*new)
+
+
 def path_str(kp):
     """jax key-path → 'a/b/c' string for rule matching."""
     parts = []
@@ -241,9 +258,10 @@ class ZeroPartitionPlan:
     def describe(self):
         """JSON-safe summary of the sharding policy — trace metadata and
         the autotuner's record of what configuration produced a trace."""
-        from .overlap import overlap_opts
+        from .overlap import overlap_opts, prefetch_opts
         co = self.comm_opts
         ov = overlap_opts(co)
+        pf = prefetch_opts(co)
         return {
             "stage": self.stage,
             "zero_axes": list(self.zero_axes),
@@ -263,6 +281,11 @@ class ZeroPartitionPlan:
                                   if ov is not None else 0.0),
             "overlap_max_inflight": (int(getattr(ov, "max_inflight", 0))
                                      if ov is not None else 0),
+            "prefetch_enabled": bool(pf is not None),
+            "prefetch_bucket_mb": (float(getattr(pf, "bucket_mb", 0.0))
+                                   if pf is not None else 0.0),
+            "prefetch_max_inflight": (int(getattr(pf, "max_inflight", 0))
+                                      if pf is not None else 0),
         }
 
     # wire formats ----------------------------------------------------------
@@ -440,6 +463,18 @@ class ZeroPartitionPlan:
             lambda kp, x: self._sharding(self.grad_spec(x.shape, path_str(kp)),
                                          mesh=self.state_mesh),
             params)
+
+    def gather_shardings(self, params):
+        """``NamedSharding``s of the POST-gather layout — each leaf's param
+        sharding minus the ZeRO axes (tp survives; persistent leaves keep
+        their spec).  The forward-prefetch markers constrain to these, so
+        XLA emits the stage-3 all-gather at the marker instead of at first
+        use."""
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: NamedSharding(
+                self.param_mesh,
+                gathered_spec(self.param_spec(x.shape, path_str(kp)),
+                              self.param_axes)), params)
 
     def param_specs(self, params):
         return jax.tree_util.tree_map_with_path(

@@ -41,7 +41,11 @@ loudly (their collectives interleave with the reduction being replaced).
 With ``comm_optimizations.overlap`` enabled the manual reduction runs the
 bucketed two-stage pipeline from ``runtime/zero/overlap.py`` — intra-node
 psum_scatter of bucket *k* overlapping the quantized inter-node
-all-to-all of bucket *k−1* (docs/overlap.md).
+all-to-all of bucket *k−1* (docs/overlap.md).  With
+``comm_optimizations.overlap.prefetch`` enabled the forward param
+all-gather is the mirror image: ``pipelined_gather`` issues bucket *k+1*'s
+(quantized, when qwZ) gather while bucket *k*'s layers compute, with a
+``max_inflight`` window clamped by ``stage3_max_live_parameters``.
 """
 
 import jax
@@ -56,7 +60,8 @@ from ...comm.collectives.quantized import (DEFAULT_GROUP_SIZE,
                                            hierarchical_quant_reduce_scatter,
                                            qdq_all_gather_st,
                                            quantized_all_gather)
-from .partition import zero_dim as _zero_dim
+from .partition import (gathered_spec as _gathered_spec,
+                        zero_dim as _zero_dim)
 
 
 def _entry_names(entry):
@@ -72,32 +77,29 @@ def _collapse(names):
     return names if len(names) > 1 else (names[0] if names else None)
 
 
-def _strip_axes(spec, dim, axes):
-    """Remove ``axes`` from ``spec[dim]`` (gathered result keeps e.g. tp)."""
-    entry = spec[dim]
-    names = entry if isinstance(entry, tuple) else (entry, )
-    kept = tuple(a for a in names if a not in axes)
-    new = list(spec)
-    new[dim] = kept if len(kept) > 1 else (kept[0] if kept else None)
-    return P(*new)
-
-
 def quantized_weight_gather(params, plan, wire_format="int8",
-                            group_size=DEFAULT_GROUP_SIZE):
+                            group_size=DEFAULT_GROUP_SIZE, prefetch=None):
     """qwZ in GSPMD mode: explicitly gather every ZeRO-sharded param with a
     quantized payload; XLA sees already-replicated (over dp) values and
     inserts no further gather.  Differentiable (straight-through; backward is
     the standard reduce-scatter).  Usable both outside and inside
-    ``jax.jit``."""
+    ``jax.jit``.
+
+    ``prefetch`` (a dict from ``overlap.resolve_prefetch``) pipelines the
+    per-leaf gathers bucket by bucket in forward-layer order with a bounded
+    in-flight window (``overlap.pipelined_gather``) — the stage-3 prefetch
+    coordinator over the quantized wire.  Persistent leaves are excluded
+    from the pipeline (the gather below is the identity for them anyway).
+    """
     from .partition import path_str
     mesh = plan.param_mesh
 
-    def gather_leaf(kp, x):
-        spec = plan.param_spec(x.shape, path_str(kp))
+    def gather_one(path, x):
+        spec = plan.param_spec(x.shape, path)
         dim, axes = _zero_dim(spec, plan.param_axes)
         if dim is None:
             return x
-        out_spec = _strip_axes(spec, dim, axes)
+        out_spec = _gathered_spec(spec, plan.param_axes)
         # positional call: custom_vjp rejects kwargs for nondiff argnums
         fn = shard_map(
             lambda t: qdq_all_gather_st(t, axes, dim, wire_format,
@@ -105,7 +107,13 @@ def quantized_weight_gather(params, plan, wire_format="int8",
             mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
         return fn(x)
 
-    return jax.tree_util.tree_map_with_path(gather_leaf, params)
+    if prefetch is not None:
+        from .overlap import pipelined_gather, prefetch_buckets_for
+        buckets, window, _ = prefetch_buckets_for(params, plan, prefetch)
+        if buckets:
+            return pipelined_gather(params, buckets, gather_one, window)
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, x: gather_one(path_str(kp), x), params)
 
 
 def build_manual_dp_micro(engine):
@@ -184,9 +192,15 @@ def build_manual_dp_micro(engine):
     hier = plan.hierarchical_reduce()
     # bucketed overlap scheduler: pipeline the quantized inter-node hop of
     # bucket k with the intra-node work of bucket k+1 (docs/overlap.md)
-    from .overlap import overlap_opts
+    from .overlap import overlap_opts, prefetch_opts, resolve_prefetch
     ov = overlap_opts(co)
     overlap_on = ov is not None
+    # forward-direction prefetch: pipeline the stage-3 param all-gather
+    # bucket by bucket under the early layers' compute (docs/overlap.md
+    # forward-prefetch section); a no-op below stage 3 where every leaf is
+    # persistent and the bucket list comes back empty
+    pf = prefetch_opts(co)
+    pf_resolved = resolve_prefetch(pf, zc) if pf is not None else None
 
     from .partition import path_str
     from ..utils import make_scaled_loss_fn
@@ -274,6 +288,14 @@ def build_manual_dp_micro(engine):
         from ..utils import batch_input_specs
         batch_specs = batch_input_specs(inputs, dp_axes,
                                         engine._n_replicated_batch_tail)
+        # prefetch buckets from GLOBAL shapes (same reason as the specs
+        # above: inside the shard_map body the leaves are local shards and
+        # both sizes and spec inference would be wrong)
+        pf_buckets, pf_window = (), 1
+        if pf_resolved is not None:
+            from .overlap import prefetch_buckets_for
+            pf_buckets, pf_window, _ = prefetch_buckets_for(
+                params, plan, pf_resolved)
 
         def _overlapped_reduce(grads):
             """Per-bucket two-stage reduction, same math as reduce_leaf:
@@ -335,8 +357,8 @@ def build_manual_dp_micro(engine):
 
         def body(params, inputs):
             # stage-3: reassemble full params from local shards (int8 when qwZ)
-            def gather_leaf(kp, x):
-                spec = gather_specs[path_str(kp)]
+            def gather_one(path, x):
+                spec = gather_specs[path]
                 dim, axes = _zero_dim(spec, plan.param_axes)
                 if dim is None:
                     return x
@@ -344,7 +366,15 @@ def build_manual_dp_micro(engine):
                     return quantized_all_gather(x, axes, dim, qw_fmt, qw_gs)
                 return jax.lax.all_gather(x, axes, axis=dim, tiled=True)
 
-            full = jax.tree_util.tree_map_with_path(gather_leaf, params)
+            if pf_buckets:
+                # forward prefetch: per-bucket gathers with a bounded
+                # in-flight window instead of one up-front tree gather
+                from .overlap import pipelined_gather
+                full = pipelined_gather(params, pf_buckets, gather_one,
+                                        pf_window)
+            else:
+                full = jax.tree_util.tree_map_with_path(
+                    lambda kp, x: gather_one(path_str(kp), x), params)
             (_, loss), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(full, scale, inputs)
             loss = jax.lax.pmean(loss, dp_axes)

@@ -30,8 +30,9 @@ from .comm_attribution import (CommAttribution,  # noqa: F401  (re-export)
 from .metrics import (MetricsRegistry, MonitorSink,  # noqa: F401
                       PrometheusEndpoint, render_prometheus)
 from .trace import (PHASES, SPAN_BACKWARD, SPAN_BUCKET_PREFIX,  # noqa: F401
-                    SPAN_CHECKPOINT, SPAN_FORWARD, SPAN_GRAD_REDUCE,
-                    SPAN_OPTIMIZER, STEPS_FILE, TRACE_FILE, TraceRecorder)
+                    SPAN_CHECKPOINT, SPAN_FORWARD, SPAN_GATHER_PREFIX,
+                    SPAN_GRAD_REDUCE, SPAN_OPTIMIZER, STEPS_FILE, TRACE_FILE,
+                    TraceRecorder)
 
 #: THE flag every emit site guards on.  Only configure()/shutdown() write it.
 enabled = False

@@ -50,6 +50,10 @@ PHASES = (SPAN_FORWARD, SPAN_BACKWARD, SPAN_GRAD_REDUCE, SPAN_OPTIMIZER,
 #: jitted step has none — its buckets live inside the compiled graph and
 #: are visible only as trace metadata + HLO structure)
 SPAN_BUCKET_PREFIX = "bucket_reduce"
+#: forward-direction twin: per-bucket param-gather prefetch spans render
+#: as ``param_gather/<index>`` in the same ``overlap`` namespace
+SPAN_GATHER_PREFIX = "param_gather"
+_BUCKET_SPAN_PREFIXES = (SPAN_BUCKET_PREFIX + "/", SPAN_GATHER_PREFIX + "/")
 
 TRACE_FILE = "trace.json"
 STEPS_FILE = "steps.jsonl"
@@ -203,7 +207,7 @@ class TraceRecorder:
         self._emit(h.name, h.cat, (h._t0 - self._epoch) * 1e6, dur * 1e6,
                    args=h.args)
         if self._step is not None:
-            if h.name.startswith(SPAN_BUCKET_PREFIX + "/"):
+            if h.name.startswith(_BUCKET_SPAN_PREFIXES):
                 self._bucket_s[h.name] = self._bucket_s.get(h.name, 0.0) \
                     + dur
             else:
@@ -291,11 +295,12 @@ class TraceRecorder:
             logger.warning("telemetry: step record write failed (%s)", e)
 
     # ------------------------------------------------------------ comm + meta
-    def bucket_span(self, index, **args):
-        """Span for one gradient bucket's eager reduce — lands in the step
-        record's ``overlap`` section, not the phase columns."""
-        return self.span(f"{SPAN_BUCKET_PREFIX}/{index}", cat="comm",
-                         **args)
+    def bucket_span(self, index, kind=SPAN_BUCKET_PREFIX, **args):
+        """Span for one bucket's eager collective — ``kind`` picks the
+        direction namespace (``bucket_reduce`` for the backward gradient
+        reduce, ``param_gather`` for the forward prefetch).  Lands in the
+        step record's ``overlap`` section, not the phase columns."""
+        return self.span(f"{kind}/{index}", cat="comm", **args)
 
     def comm_event(self, op, variant, msg_bytes, wire_bytes, latency_s,
                    world_size=1, exposed=True):

@@ -49,9 +49,10 @@ def test_json_output(tmp_path):
 
 
 def test_overlap_sweep_rows_and_schema(tmp_path):
-    """The overlap sweep emits one candidate per (bucket_mb, wire) with the
-    overlap-efficiency accounting, archives them under --trace, and every
-    --json row (op sweep included) carries the uniform overlap fields."""
+    """The overlap sweep emits one candidate per (direction, bucket_mb,
+    wire) — reduce AND gather directions — with the overlap-efficiency
+    accounting, archives them under --trace, and every --json row (op
+    sweep included) carries the uniform overlap fields."""
     import json
     out = tmp_path / "bench.json"
     trace = tmp_path / "trace"
@@ -62,26 +63,42 @@ def test_overlap_sweep_rows_and_schema(tmp_path):
     payload = json.loads(out.read_text())
     over = [r for r in payload["rows"] if r["op"] == "overlap"]
     flat = [r for r in payload["rows"] if r["op"] != "overlap"]
-    assert len(over) == 4 and len(flat) == 1
+    assert len(over) == 8 and len(flat) == 1
+    assert {r["direction"] for r in over} == {"reduce", "gather"}
     for row in payload["rows"]:  # uniform schema, flat rows carry None
-        assert {"overlap_efficiency", "bucket_mb",
+        assert {"overlap_efficiency", "bucket_mb", "direction",
                 "exposed_comm_frac"} <= set(row)
     assert flat[0]["overlap_efficiency"] is None
+    assert flat[0]["direction"] is None
     for c in over:
         assert 0.0 <= c["overlap_efficiency"] <= 1.0
         assert 0.0 <= c["exposed_comm_frac"] <= 1.0
         assert c["buckets"] >= 1 and c["comm_ms"] > 0 and c["step_ms"] > 0
-    # smaller bound → more buckets
-    eff = {(c["bucket_mb"], c["wire_dtype"]): c["buckets"] for c in over}
-    assert eff[(0.05, "fp32")] >= eff[(0.25, "fp32")]
+    # smaller bound → more buckets, in both directions
+    eff = {(c["direction"], c["bucket_mb"], c["wire_dtype"]): c["buckets"]
+           for c in over}
+    assert eff[("reduce", 0.05, "fp32")] >= eff[("reduce", 0.25, "fp32")]
+    assert eff[("gather", 0.05, "fp32")] >= eff[("gather", 0.25, "fp32")]
     # --trace archived the candidates for trace_report --json
     summary = json.loads((trace / "comm_summary.json").read_text())
-    assert len(summary["overlap"]) == 4
-    # int8 candidates move fewer wire bytes than fp32 at equal payload
-    by_wire = {}
-    for c in over:
-        by_wire.setdefault(c["wire_dtype"], c["wire_bytes"])
-    assert by_wire["int8"] < by_wire["fp32"]
+    assert len(summary["overlap"]) == 8
+    # int8 candidates move fewer wire bytes than fp32 at equal payload,
+    # per direction
+    for direction in ("reduce", "gather"):
+        by_wire = {}
+        for c in over:
+            if c["direction"] == direction:
+                by_wire.setdefault(c["wire_dtype"], c["wire_bytes"])
+        assert by_wire["int8"] < by_wire["fp32"], direction
+
+
+def test_overlap_sweep_rejects_unknown_direction():
+    """A --overlap-directions typo fails loudly instead of burning a
+    sweep under a mislabeled tag every report would drop."""
+    from deepspeed_tpu.benchmarks.comm_bench import run_overlap_sweep
+    with pytest.raises(ValueError, match="gahter"):
+        run_overlap_sweep(axis="dp", directions=("reduce", "gahter"),
+                          print_fn=lambda *a: None)
 
 
 def test_fold_sweeps_aggregates_overlap(tmp_path):
@@ -109,9 +126,44 @@ def test_fold_sweeps_aggregates_overlap(tmp_path):
     assert agg[0]["bucket_mb"] == 4.0 and agg[0]["runs"] == 2
     assert abs(agg[0]["overlap_efficiency"] - 0.7) < 1e-9
     assert agg[1]["bucket_mb"] == 1.0  # sorted best-first
+    # rows predating the direction field aggregate as direction="reduce"
+    assert all(r["direction"] == "reduce" for r in agg)
     # bench-format and malformed files are ignored, not fatal
     (tmp_path / "c.json").write_text("{not json")
     assert fold.aggregate_overlap([str(tmp_path / "c.json")]) == []
+
+
+def test_fold_sweeps_aggregates_both_directions(tmp_path):
+    """One sweep archive feeds the autotuner both bucket sizes: gather
+    rows aggregate separately from reduce rows under the same
+    (bucket_mb, wire) cell."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "fold_sweeps", os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "..", "tools", "fold_sweeps.py"))
+    fold = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fold)
+    rows = [{"op": "overlap", "direction": "reduce", "bucket_mb": 4.0,
+             "wire_dtype": "int8", "overlap_efficiency": 0.8,
+             "exposed_comm_frac": 0.1},
+            {"op": "overlap", "direction": "gather", "bucket_mb": 4.0,
+             "wire_dtype": "int8", "overlap_efficiency": 0.4,
+             "exposed_comm_frac": 0.5},
+            {"op": "overlap", "direction": "gather", "bucket_mb": 1.0,
+             "wire_dtype": "int8", "overlap_efficiency": 0.6,
+             "exposed_comm_frac": 0.2}]
+    p = tmp_path / "a.json"
+    p.write_text(json.dumps({"rows": rows}))
+    agg = fold.aggregate_overlap([str(p)])
+    assert len(agg) == 3
+    gather = [r for r in agg if r["direction"] == "gather"]
+    reduce_ = [r for r in agg if r["direction"] == "reduce"]
+    assert len(gather) == 2 and len(reduce_) == 1
+    # best-first within the gather direction
+    assert gather[0]["bucket_mb"] == 1.0
+    assert gather[0]["overlap_efficiency"] == 0.6
 
 
 def test_hier_ops_skipped_on_unsplittable_axis():

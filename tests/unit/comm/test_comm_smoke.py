@@ -37,6 +37,33 @@ def test_overlap_loss_parity_gate(monkeypatch):
     assert r["converged"] and r["pass"]
 
 
+def test_gather_prefetch_parity_gate(monkeypatch):
+    """ISSUE-9 acceptance: prefetch-off is bit-identical at stage 3,
+    fp prefetch is bit-close (≤1e-6), int8 qwZ prefetch stays within the
+    quantized tolerance and converges — and both prefetch flavors
+    actually engage (the GSPMD gather markers and the pipelined qwZ
+    gather)."""
+    from deepspeed_tpu.runtime.zero import overlap
+    marked, piped = [], []
+    orig_mark = overlap.mark_gather_tree
+    orig_pipe = overlap.pipelined_gather
+    monkeypatch.setattr(
+        overlap, "mark_gather_tree",
+        lambda *a, **k: marked.append(1) or orig_mark(*a, **k))
+    monkeypatch.setattr(
+        overlap, "pipelined_gather",
+        lambda *a, **k: piped.append(1) or orig_pipe(*a, **k))
+    r = comm_smoke.run_gather_prefetch_smoke(steps=6)
+    assert marked, "GSPMD gather markers never engaged"
+    assert piped, "pipelined qwZ gather never engaged"
+    assert r["disabled_bit_identical"], (
+        r["flat_losses"], r["disabled_losses"])
+    assert r["fp_prefetch_max_delta"] <= 1e-6, r["prefetch_losses"]
+    assert r["quant_final_delta"] <= r["tolerance"], (
+        r["flat_losses"], r["quant_prefetch_losses"])
+    assert r["converged"] and r["pass"]
+
+
 def test_zero2_loss_parity_with_comm_optimizations(monkeypatch):
     # prove the quantized manual micro actually engages for the comm-opts
     # run (parity against an accidentally-flat run would be vacuous)

@@ -228,3 +228,21 @@ def test_bucket_spans_land_in_overlap_section(tmp_path):
     assert set(record["phases"]) == {"backward"}
     names = [e["name"] for e in rec.chrome_trace()["traceEvents"]]
     assert names.count("bucket_reduce/1") == 1
+
+
+def test_gather_bucket_spans_share_overlap_section(tmp_path):
+    """param_gather/<k> spans (the forward-prefetch direction) land in the
+    same overlap section as bucket_reduce/<k>, never the phase columns."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    with rec.span("forward"):
+        pass
+    with rec.bucket_span(0, kind="param_gather", nbytes=2048):
+        pass
+    with rec.bucket_span(0, nbytes=1024):
+        pass
+    record = rec.end_step()
+    assert record["overlap"]["buckets"] == 2
+    assert set(record["overlap"]["bucket_ms"]) == {
+        "param_gather/0", "bucket_reduce/0"}
+    assert set(record["phases"]) == {"forward"}

@@ -184,3 +184,33 @@ def test_overlap_sweep_from_comm_summary(tmp_path, capsys):
     assert rc == 0
     assert "overlap sweep" in text
     assert "best candidate: bucket_mb=1.0 wire=fp32" in text
+
+
+def test_gather_sweep_renders_own_table(tmp_path, capsys):
+    """direction="gather" rows render as the gather-prefetch table, split
+    from the reduce rows (rows without a direction count as reduce)."""
+    (tmp_path / "comm_summary.json").write_text(json.dumps({
+        "ops": {},
+        "overlap": [
+            {"bucket_mb": 1.0, "wire_dtype": "fp32", "buckets": 4,
+             "step_ms": 10.0, "comm_ms": 8.0, "hidden_ms": 6.0,
+             "exposed_comm_frac": 0.2, "overlap_efficiency": 0.75},
+            {"direction": "gather", "bucket_mb": 2.0, "wire_dtype": "int8",
+             "buckets": 3, "step_ms": 7.0, "comm_ms": 5.0, "hidden_ms": 4.0,
+             "exposed_comm_frac": 0.1, "overlap_efficiency": 0.8},
+            {"direction": "gather", "bucket_mb": 8.0, "wire_dtype": "fp32",
+             "buckets": 1, "step_ms": 9.0, "comm_ms": 5.0, "hidden_ms": 0.0,
+             "exposed_comm_frac": 0.5, "overlap_efficiency": 0.0}]}))
+    rc = trace_report.main([str(tmp_path)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "gather-prefetch sweep" in text
+    assert "best prefetch candidate: bucket_mb=2.0 wire=int8" in text
+    # the direction-less row stays in the reduce table
+    assert "best candidate: bucket_mb=1.0 wire=fp32" in text
+    # --json carries the full tagged list (the autotuner's two feeds)
+    rc = trace_report.main([str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    dirs = [c.get("direction") for c in out["overlap_sweep"]]
+    assert dirs.count("gather") == 2

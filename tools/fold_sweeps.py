@@ -37,9 +37,12 @@ def _load_ds_bench(path):
 
 def aggregate_overlap(paths):
     """Merge overlap-sweep rows from ds_bench --json payloads: mean
-    overlap_efficiency / exposed_comm_frac per (bucket_mb, wire_dtype)
-    candidate, best first.  Returns a list of aggregate dicts (empty when
-    no file carries overlap rows)."""
+    overlap_efficiency / exposed_comm_frac per (direction, bucket_mb,
+    wire_dtype) candidate, best first within each direction.  ``direction``
+    is "reduce" (backward grad reduce) or "gather" (forward param-gather
+    prefetch); rows predating the gather direction count as "reduce".
+    Returns a list of aggregate dicts (empty when no file carries overlap
+    rows) — one sweep archive feeds the autotuner BOTH bucket sizes."""
     cells = {}
     for path in paths:
         payload = _load_ds_bench(path)
@@ -49,16 +52,18 @@ def aggregate_overlap(paths):
             if row.get("overlap_efficiency") is None or \
                     row.get("bucket_mb") is None:
                 continue
-            key = (float(row["bucket_mb"]), row.get("wire_dtype", "?"))
+            key = (row.get("direction") or "reduce",
+                   float(row["bucket_mb"]), row.get("wire_dtype", "?"))
             c = cells.setdefault(key, {"n": 0, "eff": 0.0, "exposed": 0.0})
             c["n"] += 1
             c["eff"] += float(row["overlap_efficiency"])
             c["exposed"] += float(row.get("exposed_comm_frac") or 0.0)
-    out = [{"bucket_mb": mb, "wire_dtype": wd, "runs": c["n"],
+    out = [{"direction": d, "bucket_mb": mb, "wire_dtype": wd,
+            "runs": c["n"],
             "overlap_efficiency": c["eff"] / c["n"],
             "exposed_comm_frac": c["exposed"] / c["n"]}
-           for (mb, wd), c in cells.items()]
-    out.sort(key=lambda r: -r["overlap_efficiency"])
+           for (d, mb, wd), c in cells.items()]
+    out.sort(key=lambda r: (r["direction"], -r["overlap_efficiency"]))
     return out
 
 
@@ -76,16 +81,29 @@ def main():
         rows.append((name, rec, why))
     overlap = aggregate_overlap(paths)
     if overlap:
-        print("overlap sweep (bucketed grad-reduce), best first:")
-        for r in overlap:
-            print(f"  bucket_mb={r['bucket_mb']:g} wire={r['wire_dtype']:<6}"
-                  f" overlap_eff={r['overlap_efficiency']:.3f}"
-                  f" exposed_frac={r['exposed_comm_frac']:.3f}"
-                  f" (n={r['runs']})")
-        best = overlap[0]
-        print(f"  → suggested comm_optimizations.overlap: "
-              f"{{\"enabled\": true, \"bucket_mb\": {best['bucket_mb']:g}}}")
-        print()
+        titles = {"reduce": "overlap sweep (bucketed grad-reduce)",
+                  "gather": "gather-prefetch sweep (forward param-gather)"}
+        for direction in ("reduce", "gather"):
+            rows_d = [r for r in overlap if r["direction"] == direction]
+            if not rows_d:
+                continue
+            print(f"{titles[direction]}, best first:")
+            for r in rows_d:
+                print(f"  bucket_mb={r['bucket_mb']:g} "
+                      f"wire={r['wire_dtype']:<6}"
+                      f" overlap_eff={r['overlap_efficiency']:.3f}"
+                      f" exposed_frac={r['exposed_comm_frac']:.3f}"
+                      f" (n={r['runs']})")
+            best = rows_d[0]
+            if direction == "reduce":
+                print(f"  → suggested comm_optimizations.overlap: "
+                      f"{{\"enabled\": true, "
+                      f"\"bucket_mb\": {best['bucket_mb']:g}}}")
+            else:
+                print(f"  → suggested comm_optimizations.overlap.prefetch: "
+                      f"{{\"enabled\": true, "
+                      f"\"bucket_mb\": {best['bucket_mb']:g}}}")
+            print()
     if not rows:
         if not overlap:
             print("no recorded runs yet (.bench_runs empty)")

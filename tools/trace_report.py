@@ -205,13 +205,24 @@ def render_report(steps, summary, last=None, print_fn=print):
         print_fn(f"{key:<34}{agg['count']:>7}{agg['avg_ms']:>10.3f}"
                  f"{_fmt_bytes(agg['wire_bytes']):>10}{agg['gbps']:>10.2f}")
     sweep = summary.get("overlap_sweep") or []
-    if sweep:
+    # one table per sweep direction; rows predating the gather direction
+    # have no "direction" field and count as reduce
+    reduce_rows = [c for c in sweep
+                   if (c.get("direction") or "reduce") == "reduce"]
+    gather_rows = [c for c in sweep if c.get("direction") == "gather"]
+    for title, rows_d, suggest in (
+            ("overlap sweep (bucketed grad-reduce candidates)",
+             reduce_rows, "best candidate"),
+            ("gather-prefetch sweep (forward param-gather candidates)",
+             gather_rows, "best prefetch candidate")):
+        if not rows_d:
+            continue
         print_fn("")
-        print_fn("== overlap sweep (bucketed grad-reduce candidates) ==")
+        print_fn(f"== {title} ==")
         print_fn(f"{'bucket_mb':>10}{'wire':>8}{'buckets':>9}"
                  f"{'step_ms':>10}{'comm_ms':>10}{'hidden_ms':>11}"
                  f"{'exposed_frac':>14}{'overlap_eff':>13}")
-        for c in sweep:
+        for c in rows_d:
             print_fn(f"{c.get('bucket_mb', 0):>10g}"
                      f"{c.get('wire_dtype', '-'):>8}"
                      f"{c.get('buckets', 0):>9}"
@@ -220,8 +231,8 @@ def render_report(steps, summary, last=None, print_fn=print):
                      f"{c.get('hidden_ms', 0.0):>11.2f}"
                      f"{c.get('exposed_comm_frac', 0.0):>14.3f}"
                      f"{c.get('overlap_efficiency', 0.0):>13.3f}")
-        best = max(sweep, key=lambda c: c.get("overlap_efficiency", 0.0))
-        print_fn(f"best candidate: bucket_mb={best.get('bucket_mb')} "
+        best = max(rows_d, key=lambda c: c.get("overlap_efficiency", 0.0))
+        print_fn(f"{suggest}: bucket_mb={best.get('bucket_mb')} "
                  f"wire={best.get('wire_dtype')} "
                  f"overlap_efficiency={best.get('overlap_efficiency', 0):.3f}")
 
