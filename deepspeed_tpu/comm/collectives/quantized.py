@@ -76,6 +76,61 @@ def quantized_wire_bytes(n_elements, wire_format, group_size):
     return int(math.ceil(n_elements * PAYLOAD_BYTES[wire_format])) + groups * 4
 
 
+# ------------------------------------------------------------ rowwise codec
+# Per-row variant of the blockwise codecs, shared with the quantized paged-KV
+# cache (inference/v2/kv_codec.py): one f32 scale per *leading index*, the
+# group being the trailing ``reduce_axes`` axes (a token's [Hkv, Dh] K/V row).
+# Same symmetric-absmax convention as the int8 wire codec above and the same
+# e4m3fn saturation rule as ops/fp_quantizer — the ZeRO++ codec family, keyed
+# so a paged scatter/gather can move scales alongside values.
+
+ROWWISE_FORMATS = ("int8", "fp8")
+
+
+def rowwise_storage_dtype(wire_format):
+    """Element dtype a rowwise-quantized payload is stored as."""
+    if wire_format == "int8":
+        return jnp.int8
+    if wire_format == "fp8":
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unknown rowwise wire format {wire_format!r} "
+                     f"(have {', '.join(ROWWISE_FORMATS)})")
+
+
+def rowwise_codec(wire_format, reduce_axes=2):
+    """Wire format name → (encode, decode) closures with per-row scales.
+
+    ``encode(x)`` quantizes ``x[..., G1, G2]`` (the trailing ``reduce_axes``
+    axes form the scale group) and returns ``(q, scale)`` where ``q`` has
+    x's shape in the storage dtype and ``scale`` has the leading shape in
+    f32.  ``decode(q, scale)`` returns f32 (accumulation never round-trips
+    through the narrow dtype — same rule as all_to_all_quant_reduce)."""
+    ax = tuple(range(-reduce_axes, 0))
+    if wire_format == "int8":
+        qmax = 127.0
+        store = lambda y: jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    elif wire_format == "fp8":
+        # native e4m3fn: clamp before the cast — the "fn" encoding has no
+        # inf, overflow lands on NaN (same guard as ops/fp_quantizer)
+        qmax = float(jnp.finfo(jnp.float8_e4m3fn).max)  # 448
+        store = lambda y: jnp.clip(y, -qmax, qmax).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError(f"unknown rowwise wire format {wire_format!r} "
+                         f"(have {', '.join(ROWWISE_FORMATS)})")
+
+    def encode(x):
+        xf = x.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(xf), axis=ax, keepdims=True)
+        scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+        return store(xf / scale), jnp.squeeze(scale, axis=ax)
+
+    def decode(q, scale):
+        return q.astype(jnp.float32) * scale.reshape(scale.shape
+                                                     + (1, ) * reduce_axes)
+
+    return encode, decode
+
+
 def quantized_all_gather(x, ax_names, dim, wire_format="int8",
                          group_size=DEFAULT_GROUP_SIZE):
     """Inside-shard_map: quantize-gather the local tile along mesh axes

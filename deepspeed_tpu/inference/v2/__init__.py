@@ -6,4 +6,4 @@ from .config_v2 import RaggedInferenceEngineConfig
 from .engine_v2 import InferenceEngineV2
 from .engine_factory import build_engine_from_checkpoint, build_hf_engine
 from .ragged import (BlockedAllocator, BlockedKVCache, DSSequenceDescriptor,
-                     DSStateManager)
+                     DSStateManager, KVCacheExhausted)

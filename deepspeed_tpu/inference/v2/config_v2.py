@@ -34,6 +34,11 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = DSStateManagerConfig()
     dtype: str = "bfloat16"
     quantization_mode: Optional[str] = None
+    # Quantized paged-KV serving (``kv_codec.py``): store the blocked KV
+    # cache as int8/fp8 rows + per-token f32 scales (dequant-on-read ragged
+    # forward) so one chip holds ~2-4× more concurrent sequences.  None
+    # (default) keeps the full-precision cache — bit-identical programs.
+    kv_cache_dtype: Optional[str] = None
     # Max greedy decode steps fused into one device program when every
     # running sequence is in pure decode (``ragged_forward.decode_burst``) —
     # one host round-trip per ``decode_burst`` tokens instead of per token.

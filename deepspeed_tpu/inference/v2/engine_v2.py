@@ -22,7 +22,8 @@ import jax.numpy as jnp
 
 from ...utils.logging import logger
 from .config_v2 import RaggedInferenceEngineConfig
-from .ragged import BlockedKVCache, DSStateManager
+from .kv_codec import resolve_kv_dtype
+from .ragged import BlockedKVCache, DSStateManager, KVCacheExhausted
 from .ragged_forward import RAGGED_FORWARDS
 
 
@@ -58,6 +59,15 @@ class InferenceEngineV2:
         tp = int(getattr(config.tensor_parallel, "tp_size", 1) or 1)
         self._tp = tp
         self._tp_mesh = None
+        # quantized paged-KV mode (kv_codec.py): the cache stores int8/fp8
+        # rows + per-token f32 scales; the ragged step dequantizes on read.
+        # Unset (None) keeps today's fp cache and exactly today's programs.
+        self._kv_dtype = resolve_kv_dtype(
+            getattr(config, "kv_cache_dtype", None))
+        if self._kv_dtype is not None and tp > 1:
+            raise NotImplementedError(
+                "kv_cache_dtype does not compose with tensor parallelism "
+                "yet (the per-token scale arrays are laid out pre-shard)")
         # weight-only quantized serving (reference quantization_mode):
         # resident weights in int8/int4 wire format, dequantized INSIDE the
         # jitted ragged step (and inside decode bursts — the wrapper is
@@ -88,7 +98,7 @@ class InferenceEngineV2:
             # decode_burst traces the wrapper inside its own program
             self._step_fn = jax.jit(
                 dq_step, static_argnames=("cfg", "block_size", "layout",
-                                          "use_kernel"),
+                                          "use_kernel", "kv_dtype"),
                 donate_argnums=(1, ))
         if tp > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -149,10 +159,13 @@ class InferenceEngineV2:
         self.kv_cache = BlockedKVCache(
             cfg.num_hidden_layers, num_blocks, block_size,
             cfg.num_key_value_heads, cfg.head_dim,
-            dtype=jnp.dtype(config.dtype))
+            dtype=jnp.dtype(config.dtype), kv_dtype=self._kv_dtype)
         self.state_manager = DSStateManager(sm, self.kv_cache)
         self._budget = int(sm.max_ragged_batch_size)
-        self._kv = self.kv_cache.data
+        # the device-side cache the step functions thread: a plain array
+        # (fp path) or the (data, scales) pytree (quantized path)
+        self._kv = self.kv_cache.data if self._kv_dtype is None \
+            else (self.kv_cache.data, self.kv_cache.scales)
         if self._kv_sharding is not None:
             self._kv = jax.device_put(self._kv, self._kv_sharding)
             # drop the replicated original — a full unsharded cache pinned
@@ -166,12 +179,28 @@ class InferenceEngineV2:
     def put(self, batch_uids, batch_tokens, do_schedule=False):
         """Queue prompt (or continuation) tokens (reference ``put`` :130 also
         runs the engine; here scheduling is explicit — pass
-        ``do_schedule=True`` for reference-style behavior)."""
+        ``do_schedule=True`` for reference-style behavior).
+
+        An unknown uid starts a NEW sequence (the admission path).  A uid
+        whose sequence already finished raises instead of silently
+        resurrecting it: the done sequence's KV prefix and token history
+        would leak into what the caller thinks is a fresh request — flush
+        first (a flushed uid is unknown again and admits cleanly).  The
+        check runs over the whole batch BEFORE any sequence mutates, so a
+        rejected put leaves every sequence untouched (a retry after the
+        flush must not double-extend the earlier uids)."""
+        batch_uids = list(batch_uids)
+        for uid in batch_uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is not None and seq.done:
+                raise ValueError(
+                    f"put() on finished uid {uid!r} — flush it first "
+                    "(continuing a done sequence would silently reuse its "
+                    "KV prefix and token history)")
         for uid, toks in zip(batch_uids, batch_tokens):
             toks = [int(t) for t in np.asarray(toks).reshape(-1)]
             seq = self.state_manager.get_or_create_sequence(uid)
             seq.tokens.extend(toks)
-            seq.done = False
         if do_schedule:
             return self.schedule_step()
         return {}
@@ -243,7 +272,8 @@ class InferenceEngineV2:
         slots = np.zeros(T, np.int32)  # slot 0 → garbage block
         finishing = []  # (seq, buffer index of its last scheduled token)
         placed = 0
-        deferred = 0    # sequences the KV pool could not grow this step
+        deferred = 0        # sequences the KV pool could not grow this step
+        deferred_want = 0   # blocks those sequences needed and couldn't get
 
         d_cur = 0                      # decode-region cursor
         p_cur = decode_cap             # prefill-region cursor (atom-aligned)
@@ -284,6 +314,11 @@ class InferenceEngineV2:
                 seq, seq.seen_tokens + take))
             if take <= 0:
                 deferred += 1
+                # blocks this sequence would need to advance ONE token —
+                # the wanted_blocks figure a typed exhaustion reports
+                deferred_want += max(
+                    1, self.kv_cache.blocks_for(seq.seen_tokens + 1)
+                    - len(seq.blocks))
                 continue
             sm.ensure_capacity(seq, seq.seen_tokens + take)
             toks[start:start + take] = pending[:take]
@@ -305,12 +340,15 @@ class InferenceEngineV2:
         if placed == 0:
             if deferred:
                 # nothing schedulable AND nothing in flight to free blocks:
-                # deferring forever would spin — surface the exhaustion
-                raise RuntimeError(
-                    f"KV cache exhausted: {deferred} sequence(s) deferred "
-                    f"with 0 schedulable tokens and no other work in "
-                    f"flight — raise state_manager.num_blocks, lower "
-                    f"concurrency, or flush finished sequences")
+                # deferring forever would spin — surface the exhaustion as
+                # the typed capacity error so a serving scheduler can
+                # catch-and-preempt (serving/scheduler.py)
+                raise KVCacheExhausted(
+                    deferred_want, sm.free_blocks,
+                    detail=f"{deferred} sequence(s) deferred with 0 "
+                    f"schedulable tokens and no other work in flight — "
+                    f"raise state_manager.num_blocks, lower concurrency, "
+                    f"preempt, or flush finished sequences")
             return None
         last_idx = np.zeros(sm.max_seqs, dtype=np.int32)
         for seq, idx in finishing:
@@ -366,7 +404,7 @@ class InferenceEngineV2:
             jnp.asarray(self.state_manager.block_table),
             jnp.asarray(last_idx), cfg=self.model_config,
             block_size=self.kv_cache.block_size, layout=layout,
-            use_kernel=self._tp == 1)
+            use_kernel=self._tp == 1, kv_dtype=self._kv_dtype)
         out = {}
         if finishing:
             if do_sample:
@@ -493,7 +531,7 @@ class InferenceEngineV2:
             block_size=self.kv_cache.block_size, k=k,
             use_kernel=self._tp == 1, sample=sample, key=key,
             temperature=float(temperature), top_k=int(top_k),
-            top_p=float(top_p))
+            top_p=float(top_p), kv_dtype=self._kv_dtype)
         toks_out = np.asarray(toks_out)      # ONE fetch for k×seqs tokens
         self.burst_steps = getattr(self, "burst_steps", 0) + 1
         out = {}
@@ -508,6 +546,20 @@ class InferenceEngineV2:
         return out
 
     # ------------------------------------------------------------- generate
+    def _mark_done(self, uid, produced, tok, eos_token_id, max_new_tokens):
+        """Record one generated token and apply the completion rule (EOS or
+        the max-new-tokens budget) — the ONE place both the per-step loop
+        and the burst path decide a sequence is finished.  Returns True when
+        the sequence just completed (the caller drops it from its active
+        set); overshoot past EOS inside a burst window is garbage the flush
+        drops — ``produced`` truncates exactly."""
+        produced[uid].append(tok)
+        if (eos_token_id is not None and tok == eos_token_id) or \
+                len(produced[uid]) >= max_new_tokens:
+            self.state_manager.get_sequence(uid).done = True
+            return True
+        return False
+
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  rng=None):
@@ -535,15 +587,10 @@ class InferenceEngineV2:
                     top_k=top_k, top_p=top_p, seed=rng)
                 if burst is not None:
                     for uid, toks in burst.items():
-                        seq = self.state_manager.get_sequence(uid)
                         for tok in toks:
-                            produced[uid].append(tok)
-                            if (eos_token_id is not None
-                                    and tok == eos_token_id) or \
-                                    len(produced[uid]) >= max_new_tokens:
-                                # overshoot past EOS is garbage the flush
-                                # drops; ``produced`` truncates exactly
-                                seq.done = True
+                            if self._mark_done(uid, produced, tok,
+                                               eos_token_id,
+                                               max_new_tokens):
                                 active.discard(uid)
                                 break
                     continue
@@ -559,13 +606,11 @@ class InferenceEngineV2:
                     continue
                 break
             for uid, tok in next_tokens.items():
-                seq = self.state_manager.get_sequence(uid)
-                produced[uid].append(tok)
-                if (eos_token_id is not None and tok == eos_token_id) or \
-                        len(produced[uid]) >= max_new_tokens:
-                    seq.done = True
+                if self._mark_done(uid, produced, tok, eos_token_id,
+                                   max_new_tokens):
                     active.discard(uid)
                 else:
-                    seq.tokens.append(tok)  # decode continues next step
+                    # decode continues next step
+                    self.state_manager.get_sequence(uid).tokens.append(tok)
         self.flush(uids)
         return [produced[u] for u in uids]

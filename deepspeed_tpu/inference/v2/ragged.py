@@ -17,6 +17,25 @@ import numpy as np
 import jax.numpy as jnp
 
 
+class KVCacheExhausted(RuntimeError):
+    """The block pool cannot satisfy an allocation (capacity, not a bug).
+
+    Carries ``wanted_blocks`` / ``free_blocks`` so a serving scheduler can
+    catch-and-preempt (``serving/scheduler.py``) while genuine programming
+    errors keep surfacing as other exception types.  Subclasses
+    ``RuntimeError`` so pre-existing ``except RuntimeError`` callers keep
+    working."""
+
+    def __init__(self, wanted_blocks, free_blocks, detail=""):
+        self.wanted_blocks = int(wanted_blocks)
+        self.free_blocks = int(free_blocks)
+        msg = (f"KV cache exhausted: want {self.wanted_blocks} block(s), "
+               f"{self.free_blocks} free")
+        if detail:
+            msg += f" — {detail}"
+        super().__init__(msg)
+
+
 class BlockedAllocator:
     """Free-list allocator over ``num_blocks`` KV blocks (reference
     ``blocked_allocator.py`` — the linked-list becomes a python set; the
@@ -32,8 +51,7 @@ class BlockedAllocator:
 
     def allocate(self, n):
         if n > len(self._free):
-            raise RuntimeError(
-                f"KV cache exhausted: want {n} blocks, {len(self._free)} free")
+            raise KVCacheExhausted(n, len(self._free))
         out = [self._free.pop() for _ in range(n)]
         return out
 
@@ -66,15 +84,30 @@ class DSSequenceDescriptor:
 
 class BlockedKVCache:
     """Paged KV storage (reference ``kv_cache.py``): one jnp array
-    ``[L, 2, num_blocks, block_size, Hkv, Dh]`` + the allocator."""
+    ``[L, 2, num_blocks, block_size, Hkv, Dh]`` + the allocator.
+
+    With ``kv_dtype`` set ("int8"/"fp8" — ``kv_codec.py``), the cache is the
+    quantized-serving layout instead: ``data`` holds the same shape in the
+    narrow storage dtype and ``scales`` holds one f32 per (layer, k/v,
+    block, position, kv-head) row — the pair travels through the jitted
+    ragged step as one ``(data, scales)`` pytree."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
-                 head_dim, dtype=jnp.bfloat16):
+                 head_dim, dtype=jnp.bfloat16, kv_dtype=None):
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
-        self.data = jnp.zeros(
-            (num_layers, 2, num_blocks, block_size, num_kv_heads, head_dim),
-            dtype=dtype)
+        self.kv_dtype = kv_dtype
+        shape = (num_layers, 2, num_blocks, block_size, num_kv_heads,
+                 head_dim)
+        if kv_dtype is None:
+            self.data = jnp.zeros(shape, dtype=dtype)
+            self.scales = None
+        else:
+            from .kv_codec import storage_dtype
+            self.data = jnp.zeros(shape, dtype=storage_dtype(kv_dtype))
+            # scale=1 for never-written positions keeps dequant a no-op on
+            # the zero payload (garbage block included)
+            self.scales = jnp.ones(shape[:5], dtype=jnp.float32)
         self.allocator = BlockedAllocator(num_blocks)
         # block 0 is the garbage sink: padding tokens in the ragged buffer
         # scatter their K/V there (their slot-0 block-table row is all zeros)

@@ -41,7 +41,8 @@ def _rmsnorm(x, w, eps):
 
 
 def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
-                     window=0, layout=(0, 0), use_kernel=True):
+                     window=0, layout=(0, 0), use_kernel=True,
+                     kv_scales=None):
     """q: [T, H, Dh]; caches: [num_blocks, bs, Hkv, Dh]; tables_t: [T, maxb];
     positions: [T]; window: sliding-window size (0 → full causal).
     Returns [T, H, Dh].
@@ -52,9 +53,15 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
     for the first ``decode_cap`` rows, atom-tiled kernel (``atom``
     same-sequence rows per tile — much better MXU occupancy for prefill)
     for the rest.  Fallback: XLA gather of each token's block run with
-    position masking."""
+    position masking.
+
+    ``kv_scales=(k_scales, v_scales)`` ([num_blocks, bs, Hkv] f32 each) is
+    the quantized-KV read path: the caches hold int8/fp8 rows and only the
+    gathered context is dequantized (per-(token, head) scale applied inside
+    the same f32 widening the math does anyway).  The Pallas kernel doesn't
+    consume scales, so this path always takes the XLA gather."""
     import os
-    if (use_kernel
+    if (use_kernel and kv_scales is None
             and (jax.default_backend() == "tpu"
                  or os.environ.get("DS_TPU_TEST_PAGED_INTERPRET"))
             and not os.environ.get("DS_TPU_DISABLE_PALLAS_PAGED")):
@@ -78,6 +85,13 @@ def _paged_attention(q, k_cache, v_cache, tables_t, positions, block_size,
     ctx = maxb * block_size
     k_ctx = k_cache[tables_t].reshape(T, ctx, Hkv, Dh)
     v_ctx = v_cache[tables_t].reshape(T, ctx, Hkv, Dh)
+    if kv_scales is not None:
+        # dequant-on-read: per-(token, head) scales broadcast over Dh
+        ks, vs = kv_scales
+        k_ctx = (k_ctx.astype(jnp.float32)
+                 * ks[tables_t].reshape(T, ctx, Hkv)[:, :, :, None])
+        v_ctx = (v_ctx.astype(jnp.float32)
+                 * vs[tables_t].reshape(T, ctx, Hkv)[:, :, :, None])
     g = H // Hkv
     qg = q.reshape(T, Hkv, g, Dh).astype(jnp.float32)
     scores = jnp.einsum("tkgd,tckd->tkgc", qg,
@@ -131,14 +145,36 @@ def _head_logits(params, x, last_token_idx, embed_key="embed_tokens"):
     return logits
 
 
+def _kv_layer(kv_data, l):
+    """Layer ``l`` view of the cache pytree: an array slice on the fp path,
+    a ``(data_l, scales_l)`` pair on the quantized path."""
+    if isinstance(kv_data, tuple):
+        data, scales = kv_data
+        return (data[l], scales[l])
+    return kv_data[l]
+
+
+def _kv_set(kv_data, l, kv_layer):
+    """Write layer ``l`` back into the cache pytree (inverse of
+    :func:`_kv_layer`)."""
+    if isinstance(kv_data, tuple):
+        data, scales = kv_data
+        layer_data, layer_scales = kv_layer
+        return (data.at[l].set(layer_data), scales.at[l].set(layer_scales))
+    return kv_data.at[l].set(kv_layer)
+
+
 def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
                             positions, cos, sin, *, cfg, block_size,
                             rotary=True, rotary_dim=None,
-                            layout=(0, 0), use_kernel=True):
+                            layout=(0, 0), use_kernel=True, kv_dtype=None):
     """Shared attention sub-block: qkv → rotary → cache scatter → paged
     attention → output projection.  Returns (attn_out [T, D], new kv_layer).
-    kv_layer: [2, num_blocks, bs, Hkv, Dh].  ``rotary_dim`` < head_dim →
-    partial rotary (phi family)."""
+    kv_layer: [2, num_blocks, bs, Hkv, Dh] — or, with ``kv_dtype`` set, the
+    quantized pair ``(data [2, nb, bs, Hkv, Dh] narrow, scales [2, nb, bs, Hkv]
+    f32)``: K/V rows are encoded once on the scatter write and dequantized
+    on read inside the paged attention (``kv_codec.py``).  ``rotary_dim`` <
+    head_dim → partial rotary (phi family)."""
     dtype = jnp.dtype(cfg.dtype)
     H, Dh = cfg.num_attention_heads, cfg.head_dim
     q = _qkv(h, lp_attn["q_proj"], dtype)
@@ -153,12 +189,29 @@ def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
         else:
             q = _rotary(q, cos, sin, positions)
             k = _rotary(k, cos, sin, positions)
-    kv_layer = kv_layer.at[0, blk, off].set(k.astype(kv_layer.dtype))
-    kv_layer = kv_layer.at[1, blk, off].set(v.astype(kv_layer.dtype))
-    out = _paged_attention(q, kv_layer[0], kv_layer[1], tables_t,
+    if kv_dtype is None:
+        kv_layer = kv_layer.at[0, blk, off].set(k.astype(kv_layer.dtype))
+        kv_layer = kv_layer.at[1, blk, off].set(v.astype(kv_layer.dtype))
+        k_cache, v_cache = kv_layer[0], kv_layer[1]
+        kv_scales = None
+    else:
+        from .kv_codec import codec
+        encode, _ = codec(kv_dtype)
+        data, scales = kv_layer
+        qk, sk = encode(k)          # [T, Hkv, Dh] narrow, [T, Hkv] f32
+        qv, sv = encode(v)
+        data = data.at[0, blk, off].set(qk)
+        data = data.at[1, blk, off].set(qv)
+        scales = scales.at[0, blk, off].set(sk)
+        scales = scales.at[1, blk, off].set(sv)
+        kv_layer = (data, scales)
+        k_cache, v_cache = data[0], data[1]
+        kv_scales = (scales[0], scales[1])
+    out = _paged_attention(q, k_cache, v_cache, tables_t,
                            positions, block_size,
                            window=getattr(cfg, "sliding_window", 0),
-                           layout=layout, use_kernel=use_kernel)
+                           layout=layout, use_kernel=use_kernel,
+                           kv_scales=kv_scales)
     o = out.reshape(out.shape[0], H * Dh)
     o = jnp.einsum("tf,fd->td", o, lp_attn["o_proj"]["kernel"].astype(dtype))
     if "bias" in lp_attn["o_proj"]:
@@ -166,10 +219,12 @@ def _ragged_attention_block(lp_attn, h, kv_layer, blk, off, tables_t,
     return o, kv_layer
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout",
+                                             "use_kernel", "kv_dtype"),
                    donate_argnums=(1, ))
 def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                      block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
+                      block_tables, last_token_idx, *, cfg, block_size,
+                      layout=(0, 0), use_kernel=True, kv_dtype=None):
     """One ragged engine iteration for the Llama family.
 
     Args:
@@ -205,10 +260,10 @@ def llama_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         # scatter this batch's K/V into the paged cache (linear_blocked_kv_
         # rotary analog), then attend against the updated pages
         attn_out, kv_layer = _ragged_attention_block(
-            lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
+            lp["self_attn"], h, _kv_layer(kv_data, l), blk, off, tables_t, positions,
             cos, sin, cfg=cfg, block_size=block_size, layout=layout,
-            use_kernel=use_kernel)
-        kv_data = kv_data.at[l].set(kv_layer)
+            use_kernel=use_kernel, kv_dtype=kv_dtype)
+        kv_data = _kv_set(kv_data, l, kv_layer)
         x = x + attn_out
         h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
         gate = h2 @ mlp["gate_proj"]["kernel"].astype(dtype)
@@ -229,10 +284,12 @@ def _lm_head(params, x, last_token_idx, cfg):
     return xl @ params["lm_head"]["kernel"].astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout",
+                                             "use_kernel", "kv_dtype"),
                    donate_argnums=(1, ))
 def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                        block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
+                        block_tables, last_token_idx, *, cfg, block_size,
+                      layout=(0, 0), use_kernel=True, kv_dtype=None):
     """One ragged engine iteration for Mixtral (reference
     ``inference/v2/model_implementations/mixtral/``): Llama attention skeleton
     with the MLP replaced by the exact top-k sparse MoE (``moe_apply`` —
@@ -256,10 +313,10 @@ def mixtral_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         lp = params[f"layers_{l}"]
         h = _rmsnorm(x, lp["input_layernorm"]["weight"], eps)
         attn_out, kv_layer = _ragged_attention_block(
-            lp["self_attn"], h, kv_data[l], blk, off, tables_t, positions,
+            lp["self_attn"], h, _kv_layer(kv_data, l), blk, off, tables_t, positions,
             cos, sin, cfg=cfg, block_size=block_size, layout=layout,
-            use_kernel=use_kernel)
-        kv_data = kv_data.at[l].set(kv_layer)
+            use_kernel=use_kernel, kv_dtype=kv_dtype)
+        kv_data = _kv_set(kv_data, l, kv_layer)
         x = x + attn_out
         h2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps)
         moe = lp["moe"]
@@ -292,10 +349,12 @@ def _layernorm(x, p, eps):
             + p["bias"]).astype(x.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout",
+                                             "use_kernel", "kv_dtype"),
                    donate_argnums=(1, ))
 def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                       block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
+                       block_tables, last_token_idx, *, cfg, block_size,
+                      layout=(0, 0), use_kernel=True, kv_dtype=None):
     """One ragged engine iteration for Falcon (reference
     ``inference/v2/model_implementations/falcon/``): parallel-block layout —
     attention and the GELU MLP read the same layernormed input and add into
@@ -323,10 +382,11 @@ def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
                        "v_proj": lp["v_proj"], "o_proj": lp["dense"]}
         attn_out, kv_layer = _ragged_attention_block(
-            attn_params, h_attn, kv_data[l], blk, off, tables_t, positions,
+            attn_params, h_attn, _kv_layer(kv_data, l), blk, off, tables_t,
+            positions,
             cos, sin, cfg=acfg, block_size=block_size, layout=layout,
-            use_kernel=use_kernel)
-        kv_data = kv_data.at[l].set(kv_layer)
+            use_kernel=use_kernel, kv_dtype=kv_dtype)
+        kv_data = _kv_set(kv_data, l, kv_layer)
         if not cfg.parallel_attn:
             x = x + attn_out
             h_mlp = _layernorm(x, lp["post_attention_layernorm"], eps)
@@ -339,10 +399,12 @@ def falcon_ragged_step(params, kv_data, token_ids, positions, seq_slots,
                         embed_key="word_embeddings"), kv_data
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout",
+                                             "use_kernel", "kv_dtype"),
                    donate_argnums=(1, ))
 def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
+                    block_tables, last_token_idx, *, cfg, block_size,
+                      layout=(0, 0), use_kernel=True, kv_dtype=None):
     """One ragged engine iteration for OPT (reference
     ``inference/v2/model_implementations/opt/``): learned positions (+2
     offset), pre-LN blocks, ReLU MLP, no rotary."""
@@ -366,10 +428,10 @@ def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
                        "v_proj": lp["v_proj"], "o_proj": lp["out_proj"]}
         attn_out, kv_layer = _ragged_attention_block(
-            attn_params, h, kv_data[l], blk, off, tables_t, positions,
+            attn_params, h, _kv_layer(kv_data, l), blk, off, tables_t, positions,
             None, None, cfg=acfg, block_size=block_size, rotary=False,
-            layout=layout, use_kernel=use_kernel)
-        kv_data = kv_data.at[l].set(kv_layer)
+            layout=layout, use_kernel=use_kernel, kv_dtype=kv_dtype)
+        kv_data = _kv_set(kv_data, l, kv_layer)
         x = x + attn_out
         if not cfg.do_layer_norm_before:
             x = _layernorm(x, lp["self_attn_layer_norm"], eps)
@@ -385,10 +447,12 @@ def opt_ragged_step(params, kv_data, token_ids, positions, seq_slots,
     return _head_logits(params, x, last_token_idx), kv_data
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout", "use_kernel"),
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "layout",
+                                             "use_kernel", "kv_dtype"),
                    donate_argnums=(1, ))
 def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
-                    block_tables, last_token_idx, *, cfg, block_size, layout=(0, 0), use_kernel=True):
+                    block_tables, last_token_idx, *, cfg, block_size,
+                      layout=(0, 0), use_kernel=True, kv_dtype=None):
     """One ragged engine iteration for Phi-2 (reference
     ``inference/v2/model_implementations/phi/``): parallel block, partial
     rotary, LayerNorm, biased linears (incl. lm_head)."""
@@ -411,10 +475,10 @@ def phi_ragged_step(params, kv_data, token_ids, positions, seq_slots,
         attn_params = {"q_proj": lp["q_proj"], "k_proj": lp["k_proj"],
                        "v_proj": lp["v_proj"], "o_proj": lp["dense"]}
         attn_out, kv_layer = _ragged_attention_block(
-            attn_params, h, kv_data[l], blk, off, tables_t, positions,
+            attn_params, h, _kv_layer(kv_data, l), blk, off, tables_t, positions,
             cos, sin, cfg=acfg, block_size=block_size, rotary_dim=rd,
-            layout=layout, use_kernel=use_kernel)
-        kv_data = kv_data.at[l].set(kv_layer)
+            layout=layout, use_kernel=use_kernel, kv_dtype=kv_dtype)
+        kv_data = _kv_set(kv_data, l, kv_layer)
         mlp = _lin(jax.nn.gelu(_lin(h, lp["fc1"], dtype)), lp["fc2"], dtype)
         x = x + attn_out + mlp
 
@@ -452,12 +516,12 @@ def _device_sample(logits, key, temperature, top_k, top_p):
 @functools.partial(
     jax.jit,
     static_argnames=("step_fn", "cfg", "block_size", "k", "use_kernel",
-                     "sample", "top_k"),
+                     "sample", "top_k", "kv_dtype"),
     donate_argnums=(1, ))
 def decode_burst(params, kv_data, tok0, pos0, active, block_tables, *,
                  step_fn, cfg, block_size, k, use_kernel=True,
                  sample=False, key=None, temperature=1.0, top_k=0,
-                 top_p=1.0):
+                 top_p=1.0, kv_dtype=None):
     """``k`` greedy decode iterations in ONE compiled program.
 
     The per-step serving loop pays a host round-trip per generated token
@@ -501,7 +565,8 @@ def decode_burst(params, kv_data, tok0, pos0, active, block_tables, *,
         logits, kv = inner(params, kv, jnp.where(active, toks, 0),
                            jnp.where(active, pos, 0), slots, block_tables,
                            rows, cfg=cfg, block_size=block_size,
-                           layout=(0, 0), use_kernel=use_kernel)
+                           layout=(0, 0), use_kernel=use_kernel,
+                           kv_dtype=kv_dtype)
         if sample:
             key, sub = jax.random.split(key)
             nxt = _device_sample(logits, sub, temperature, top_k, top_p)

@@ -1,0 +1,45 @@
+"""Serving config block (``ServingConfig``) — scheduler-side knobs layered
+over the engine's :class:`RaggedInferenceEngineConfig` (which owns the
+batching/KV geometry: token budget, block size, ``kv_cache_dtype``, decode
+burst)."""
+
+from typing import Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    #: in-flight sequence cap; clamped to the engine's slot count
+    #: (``max_ragged_sequence_count`` − 1 — slot 0 is the padding slot)
+    max_concurrent: int = 64
+    #: admission queue bound; 0 = unbounded.  A full queue makes ``submit``
+    #: raise :class:`~deepspeed_tpu.serving.scheduler.AdmissionQueueFull` —
+    #: the caller-visible backpressure signal
+    max_queue_depth: int = 0
+    #: KV-pressure admission gate: a request is admitted only when
+    #: ``blocks_for(len(prompt) + reserve) + floor ≤ free_blocks``.  None →
+    #: one block of decode headroom (the first decode block is the one a
+    #: just-admitted request always grows into)
+    kv_admit_reserve_tokens: Optional[int] = None
+    #: free blocks the admission gate keeps in reserve for the sequences
+    #: already running (decode growth) — raises the backpressure threshold
+    kv_free_block_floor: int = 0
+    #: cap on consecutive preemptions inside ONE scheduler step before the
+    #: exhaustion is re-raised to the caller (a single request bigger than
+    #: the whole pool must fail loudly, not evict the world)
+    max_preemptions_per_step: int = 8
+
+    # sampling (greedy by default; sampled serving keeps the per-step loop
+    # unless the engine's decode_burst_sampling opts into the device PRNG)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    #: replica-health heartbeats (elasticity watchdog): directory to beat
+    #: into once per scheduler step; None → honor ``DS_TPU_HEARTBEAT_DIR``
+    #: when the elastic agent exported it, else no heartbeat
+    heartbeat_dir: Optional[str] = None
+    #: rank stamped into the heartbeat file name
+    heartbeat_rank: int = 0

@@ -1,0 +1,117 @@
+"""Typed request lifecycle for the serving engine.
+
+A :class:`Request` is the unit the scheduler moves through the state
+machine::
+
+    QUEUED ──admit──▶ PREFILL ──first token──▶ DECODE ──EOS/max──▶ DONE
+       ▲                 │                        │
+       └──requeue── EVICTED ◀──────preempt────────┘
+
+Transitions are validated (:meth:`Request.transition`): an illegal edge is
+a scheduler bug and raises immediately instead of corrupting accounting.
+``EVICTED`` is transient under the default preempt-and-requeue policy —
+the scheduler re-queues the victim at the FRONT of the admission queue
+(LIFO among victims) with its full token history, so re-admission
+recomputes the KV prefix and greedy decoding continues token-identically.
+
+Latency accounting lives here too: TTFT (submit → first generated token)
+and the per-token gaps (TBT) the serve bench folds into p50/p99.
+"""
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"      # in the admission queue, no KV held
+    PREFILL = "prefill"    # admitted; prompt (or recovery) tokens streaming
+    DECODE = "decode"      # producing tokens, one per engine iteration
+    DONE = "done"          # completed (EOS or max_new_tokens); KV released
+    EVICTED = "evicted"    # preempted under KV pressure; KV released
+
+
+#: legal edges of the lifecycle (EVICTED → QUEUED is the requeue path;
+#: QUEUED → DONE covers cancellation before admission)
+_TRANSITIONS = {
+    RequestState.QUEUED: (RequestState.PREFILL, RequestState.DONE),
+    RequestState.PREFILL: (RequestState.DECODE, RequestState.EVICTED,
+                           RequestState.DONE),
+    RequestState.DECODE: (RequestState.DONE, RequestState.EVICTED),
+    RequestState.EVICTED: (RequestState.QUEUED, ),
+    RequestState.DONE: (),
+}
+
+
+class IllegalTransition(RuntimeError):
+    """A lifecycle edge outside the state machine — a scheduler bug."""
+
+
+@dataclass
+class Request:
+    """One serving request and its full accounting record."""
+
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int = 32
+    eos_token_id: Optional[int] = None
+    #: streaming callback ``on_token(token: int, done: bool)`` — invoked
+    #: once per generated token, from the scheduler thread
+    on_token: Optional[Callable[[int, bool], None]] = None
+
+    state: RequestState = RequestState.QUEUED
+    produced: List[int] = field(default_factory=list)
+    preemptions: int = 0
+    #: monotonically increasing admission ticket — the LIFO preemption key
+    admit_order: int = -1
+
+    # latency bookkeeping (scheduler clock timestamps, seconds)
+    t_submit: float = field(default_factory=time.perf_counter)
+    t_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+    #: decode-phase inter-token gaps (seconds) — the TBT histogram feed
+    token_gaps: List[float] = field(default_factory=list)
+
+    def transition(self, new_state):
+        if new_state not in _TRANSITIONS[self.state]:
+            raise IllegalTransition(
+                f"request {self.uid}: {self.state.name} → {new_state.name} "
+                "is not a lifecycle edge")
+        self.state = new_state
+
+    # ------------------------------------------------------------- recording
+    def record_token(self, tok, now, done):
+        """Book one generated token: stream it, stamp TTFT on the first."""
+        if self.t_first_token is None:
+            self.t_first_token = now
+        elif self.t_last_token is not None:
+            self.token_gaps.append(now - self.t_last_token)
+        self.t_last_token = now
+        self.produced.append(int(tok))
+        if self.on_token is not None:
+            self.on_token(int(tok), done)
+
+    @property
+    def remaining_tokens(self):
+        return max(0, self.max_new_tokens - len(self.produced))
+
+    @property
+    def resume_tokens(self):
+        """Token history a preempted request re-enters the engine with:
+        prompt + everything already produced (the KV prefix to recompute)."""
+        return list(self.prompt) + list(self.produced)
+
+    @property
+    def ttft(self):
+        """Submit → first token, seconds (None until the first token)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def mean_tbt(self):
+        if not self.token_gaps:
+            return None
+        return sum(self.token_gaps) / len(self.token_gaps)

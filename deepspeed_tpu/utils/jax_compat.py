@@ -67,6 +67,15 @@ def install():
     when the adapter was installed, False when jax already has the API (or
     has neither spelling)."""
     try:
+        from jax.experimental.pallas import tpu as _pltpu
+        if not hasattr(_pltpu, "CompilerParams") and \
+                hasattr(_pltpu, "TPUCompilerParams"):
+            # the pinned jaxlib spells it TPUCompilerParams; the kernels use
+            # the modern name
+            _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+    except ImportError:
+        pass
+    try:
         getattr(jax, "shard_map")
         return False
     except AttributeError:

@@ -30,6 +30,12 @@ def main():
     ap.add_argument("--quant", default=None, choices=("int8", "int4"),
                     help="weight-only quantized serving (wire-format "
                     "resident weights, ~1 byte/weight)")
+    ap.add_argument("--serve", action="store_true",
+                    help="drive the production serving scheduler "
+                    "(admission queue + streaming + preemption; "
+                    "docs/serving.md) instead of one-shot generate")
+    ap.add_argument("--kv-dtype", default=None, choices=("int8", "fp8"),
+                    help="quantized paged-KV cache (docs/serving.md)")
     args = ap.parse_args()
 
     cfg = llama.llama_tiny(dtype="float32", remat=False)
@@ -40,6 +46,7 @@ def main():
         model, params=params,
         config=dict(dtype=cfg.dtype,
                     quantization_mode=args.quant,
+                    kv_cache_dtype=args.kv_dtype,
                     state_manager=dict(max_tracked_sequences=8,
                                        max_ragged_batch_size=64,
                                        max_ragged_sequence_count=8,
@@ -48,6 +55,23 @@ def main():
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, size=12).tolist()
                for _ in range(4)]
+    if args.serve:
+        from deepspeed_tpu.serving import ServingScheduler
+        sched = ServingScheduler(eng)
+        streams = {}
+        for i, p in enumerate(prompts):
+            streams[i] = []
+            sched.submit(p, max_new_tokens=8,
+                         on_token=lambda t, d, i=i: streams[i].append(t))
+        sched.drain()
+        for i in range(len(prompts)):
+            req = sched.query(i)
+            print(f"req {i}: +{len(streams[i])} tokens -> {streams[i]} "
+                  f"(ttft {req.ttft * 1e3:.1f} ms)")
+        print(f"serving: {sched.completed} completed, "
+              f"{sched.preemptions} preemptions, "
+              f"peak {sched.peak_running} in flight (docs/serving.md)")
+        return
     out = eng.generate(prompts, max_new_tokens=8)
     for i, toks in enumerate(out):
         print(f"seq {i}: +{len(toks)} tokens -> {toks}")

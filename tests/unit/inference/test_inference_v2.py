@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import deepspeed_tpu
 from deepspeed_tpu.inference.v2 import (BlockedAllocator, BlockedKVCache,
                                         DSStateManager, InferenceEngineV2,
+                                        KVCacheExhausted,
                                         RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
 from deepspeed_tpu.models import llama
@@ -47,6 +48,42 @@ def test_blocked_allocator():
         a.free(got[:1] + got[:1])  # double free
     with pytest.raises(RuntimeError):
         a.allocate(100)
+
+
+def test_kv_cache_exhausted_is_typed():
+    """ISSUE-11: exhaustion carries wanted/free block counts (scheduler
+    catch-and-preempt) and stays a RuntimeError for legacy callers."""
+    a = BlockedAllocator(4)
+    a.allocate(3)
+    with pytest.raises(KVCacheExhausted) as ei:
+        a.allocate(2)
+    assert ei.value.wanted_blocks == 2
+    assert ei.value.free_blocks == 1
+    assert isinstance(ei.value, RuntimeError)
+    assert "KV cache exhausted" in str(ei.value)
+
+
+def test_put_on_done_uid_raises():
+    """ISSUE-11: put() must not silently resurrect a finished sequence —
+    flushing first (uid unknown again) is the sanctioned path."""
+    model, cfg, params = _model()
+    eng = _v2(model, params)
+    eng.put([3], [[1, 2, 3]])
+    eng.schedule_step()
+    eng.state_manager.get_sequence(3).done = True
+    with pytest.raises(ValueError, match="finished uid"):
+        eng.put([3], [[4]])
+    eng.flush([3])
+    eng.put([3], [[4, 5]])    # flushed → unknown → fresh admission is fine
+    assert eng.query(3)["length"] == 2
+    # the guard validates the WHOLE batch before mutating: a rejected put
+    # must leave earlier uids untouched (retry must not double-extend)
+    eng.state_manager.get_sequence(3).done = True
+    eng.put([5], [[7]])
+    with pytest.raises(ValueError, match="finished uid"):
+        eng.put([5, 3], [[8, 9], [10]])
+    assert eng.query(5)["tokens"] == [7]
+    eng.flush([3, 5])
 
 
 def test_state_manager_lifecycle():

@@ -1,0 +1,127 @@
+"""serve_bench acceptance tests (ISSUE-11): the ``--smoke`` gate runs under
+tier-1 (importlib convention, same as test_comm_smoke.py), the workload
+generator is seed-deterministic, and the ``--json`` rows keep the mixed
+``fold_sweeps``/``trace_report`` archive contracts working."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_here = os.path.dirname(__file__)
+_tools = os.path.join(_here, "..", "..", "..", "tools")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+serve_bench = _load("serve_bench")
+
+
+def test_serve_bench_smoke_end_to_end():
+    """ISSUE-11 acceptance: ≥8 concurrent sequences on a too-small KV
+    cache, ≥1 preemption, all complete, streams match one-shot generate,
+    int8-KV token-identical to fp over ≥64 decode steps, unset dtype
+    bit-identical."""
+    r = serve_bench.run_smoke(seed=0, print_fn=lambda *a: None)
+    assert r["completed"] == 8
+    assert r["preemptions"] >= 1
+    assert r["peak_running"] >= 8
+    assert r["streams_match_generate"]
+    assert r["decode_steps_compared"] >= 64
+    assert r["int8_kv_token_identical"]
+    assert r["unset_bit_identical"]
+    assert r["pass"]
+
+
+def test_workload_is_seed_deterministic():
+    a = serve_bench.make_workload(16, 32.0, seed=7, max_new_tokens=8)
+    b = serve_bench.make_workload(16, 32.0, seed=7, max_new_tokens=8)
+    c = serve_bench.make_workload(16, 32.0, seed=8, max_new_tokens=8)
+    assert a == b
+    assert a != c
+    # arrival times strictly ordered, prompt lengths from the mixture
+    times = [t for t, _, _ in a]
+    assert times == sorted(times)
+    mix = {l for l, _ in serve_bench.PROMPT_MIX}
+    assert {len(p) for _, p, _ in a} <= mix
+
+
+def test_traffic_row_schema_and_fold_aggregation(tmp_path):
+    """A small real traffic run must emit the uniform ds_bench row schema
+    (direction: "serve") and aggregate through fold_sweeps without
+    disturbing the overlap aggregation on a mixed archive."""
+    from deepspeed_tpu.serving import ServingScheduler
+    eng, _ = serve_bench._tiny_engine(num_blocks=64, decode_burst=8)
+    sched = ServingScheduler(eng)
+    plan = serve_bench.make_workload(6, 0.0, seed=0, max_new_tokens=6)
+    row = serve_bench.run_traffic(sched, plan)
+    assert row["direction"] == "serve"
+    assert row["completed"] == 6
+    # the uniform ds_bench keys are all present (None where n/a)
+    for key in ("op", "bytes", "wire_bytes", "latency_us", "bucket_mb",
+                "overlap_efficiency", "exposed_comm_frac"):
+        assert key in row
+    assert row["ttft_p50_ms"] is not None
+    assert row["tokens_per_s_per_chip"] > 0
+    assert row["kv_bytes_per_token"] > 0
+    # TBT gaps are amortized over burst windows, never fabricated zeros
+    assert row["tbt_p50_ms"] is None or row["tbt_p50_ms"] > 0
+
+    serve_path = tmp_path / "serve.json"
+    serve_path.write_text(json.dumps({"rows": [row]}))
+    overlap_path = tmp_path / "overlap.json"
+    overlap_path.write_text(json.dumps({"rows": [
+        {"op": "all_reduce", "direction": None, "bucket_mb": None,
+         "overlap_efficiency": None, "exposed_comm_frac": None},
+        {"op": "overlap", "direction": "reduce", "bucket_mb": 8.0,
+         "wire_dtype": "fp", "overlap_efficiency": 0.5,
+         "exposed_comm_frac": 0.2},
+    ]}))
+    fold = _load("fold_sweeps")
+    paths = [str(serve_path), str(overlap_path)]
+    serve_rows = fold.aggregate_serve(paths)
+    assert len(serve_rows) == 1
+    assert serve_rows[0]["wire_dtype"] == "fp"
+    assert serve_rows[0]["requests"] == 6
+    # serve rows are invisible to the overlap aggregation and vice versa
+    overlap_rows = fold.aggregate_overlap(paths)
+    assert [r["direction"] for r in overlap_rows] == ["reduce"]
+
+
+def test_serve_bench_main_json(tmp_path):
+    """CLI surface: --requests/--rate/--json writes a loadable payload."""
+    out = tmp_path / "serve.json"
+    rc = serve_bench.main(["--requests", "4", "--rate", "0",
+                           "--max-new", "4", "--json", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert payload["bench"] == "serve"
+    assert len(payload["rows"]) == 1
+    assert payload["rows"][0]["direction"] == "serve"
+
+
+def test_trace_report_renders_serving_phases(tmp_path, capsys):
+    """A serving telemetry dir (prefill/decode/mixed phases) must render
+    through trace_report — the mixed-archive contract."""
+    steps = tmp_path / "steps.jsonl"
+    recs = [
+        {"step": 1, "wall_ms": 10.0, "phases": {"prefill": 9.5},
+         "comm": {}, "metrics": {"tokens": 0}},
+        {"step": 2, "wall_ms": 2.0, "phases": {"decode": 1.9},
+         "comm": {}, "metrics": {"tokens": 8}},
+    ]
+    steps.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    tr = _load("trace_report")
+    loaded = tr.load_steps(str(tmp_path))
+    summary = tr.summarize(loaded)
+    tr.render_report(loaded, summary)
+    out = capsys.readouterr().out
+    assert "prefill" in out and "decode" in out
+    assert summary["tokens_total"] == 8

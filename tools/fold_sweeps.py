@@ -67,6 +67,52 @@ def aggregate_overlap(paths):
     return out
 
 
+def aggregate_serve(paths):
+    """Merge serving-bench rows (``direction: "serve"`` — serve_bench
+    --json) across runs: mean TTFT/TBT percentiles and tokens/s/chip per
+    KV wire dtype, total preemptions.  Coexists with overlap/op rows in
+    mixed archives (those carry ``direction`` None/reduce/gather and are
+    skipped here, exactly as serve rows are skipped by
+    :func:`aggregate_overlap` — their overlap_efficiency is None)."""
+    cells = {}
+    for path in paths:
+        payload = _load_ds_bench(path)
+        if payload is None:
+            continue
+        for row in payload["rows"]:
+            if row.get("direction") != "serve":
+                continue
+            key = row.get("wire_dtype") or "fp"
+            c = cells.setdefault(key, {
+                "n": 0, "requests": 0, "preemptions": 0, "tok_s": 0.0,
+                "ttft_p50": 0.0, "ttft_p99": 0.0, "tbt_p50": 0.0,
+                "tbt_p99": 0.0, "lat_runs": 0})
+            c["n"] += 1
+            c["requests"] += int(row.get("requests") or 0)
+            c["preemptions"] += int(row.get("preemptions") or 0)
+            c["tok_s"] += float(row.get("tokens_per_s_per_chip") or 0.0)
+            if row.get("ttft_p50_ms") is not None:
+                c["lat_runs"] += 1
+                c["ttft_p50"] += float(row["ttft_p50_ms"])
+                c["ttft_p99"] += float(row.get("ttft_p99_ms") or 0.0)
+                c["tbt_p50"] += float(row.get("tbt_p50_ms") or 0.0)
+                c["tbt_p99"] += float(row.get("tbt_p99_ms") or 0.0)
+    out = []
+    for wd, c in cells.items():
+        lr = max(1, c["lat_runs"])
+        out.append({
+            "wire_dtype": wd, "runs": c["n"], "requests": c["requests"],
+            "preemptions": c["preemptions"],
+            "tokens_per_s_per_chip": c["tok_s"] / c["n"],
+            "ttft_p50_ms": c["ttft_p50"] / lr,
+            "ttft_p99_ms": c["ttft_p99"] / lr,
+            "tbt_p50_ms": c["tbt_p50"] / lr,
+            "tbt_p99_ms": c["tbt_p99"] / lr,
+        })
+    out.sort(key=lambda r: -r["tokens_per_s_per_chip"])
+    return out
+
+
 def main():
     runs = os.path.join(ROOT, ".bench_runs")
     paths = sorted(glob.glob(os.path.join(runs, "*.json")) +
@@ -79,6 +125,19 @@ def main():
         name = os.path.relpath(path, runs).replace(".json", "")
         why = bench._untrustworthy(rec)
         rows.append((name, rec, why))
+    serve = aggregate_serve(paths)
+    if serve:
+        print("serve bench (direction=serve), best tokens/s first:")
+        for r in serve:
+            print(f"  kv={r['wire_dtype']:<6} "
+                  f"tok/s/chip={r['tokens_per_s_per_chip']:8.0f}"
+                  f"  ttft p50/p99={r['ttft_p50_ms']:.1f}/"
+                  f"{r['ttft_p99_ms']:.1f}ms"
+                  f"  tbt p50/p99={r['tbt_p50_ms']:.2f}/"
+                  f"{r['tbt_p99_ms']:.2f}ms"
+                  f"  preempt={r['preemptions']}"
+                  f" (n={r['runs']}, {r['requests']} reqs)")
+        print()
     overlap = aggregate_overlap(paths)
     if overlap:
         titles = {"reduce": "overlap sweep (bucketed grad-reduce)",

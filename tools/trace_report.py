@@ -153,6 +153,10 @@ def render_report(steps, summary, last=None, print_fn=print):
     shown = steps[-last:] if last else steps
     cols = [p for p in PHASE_COLUMNS
             if any(p in r.get("phases", {}) for r in shown)]
+    # non-training span names (the serving scheduler emits prefill/decode/
+    # mixed) get their own columns so mixed archives stay readable
+    cols += sorted({p for r in shown for p in r.get("phases", {})}
+                   - set(PHASE_COLUMNS))
     header = f"{'step':>6}{'wall_ms':>10}"
     for p in cols:
         header += f"{p:>12}"
