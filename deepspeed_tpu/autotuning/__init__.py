@@ -1,7 +1,10 @@
-"""Autotuning (reference ``deepspeed/autotuning/``): explores ZeRO stage ×
-micro-batch-size (× offload) spaces, measures throughput, emits the best
-config."""
+"""Autotuning (reference ``deepspeed/autotuning/``): the legacy ZeRO-stage ×
+micro-batch grid plus the closed-loop comm/ZeRO autotuner (topology probe →
+measured search over wire dtypes / hierarchy / overlap bucketing → emitted
+``comm_optimizations`` + ``zero_optimization`` block; docs/autotuning.md)."""
 
-from .autotuner import Autotuner
+from .autotuner import Autotuner, AutotuningError, run_autotuning
 from .config import AutotuningConfig
-from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+from .probe import derive_wire_ladder, probe_topology, run_probes
+from .tuner import (GridSearchTuner, ModelBasedTuner, RandomTuner,
+                    featurize_config)

@@ -3,15 +3,27 @@
 The reference forks ``deepspeed`` launcher jobs per experiment and scrapes
 timer logs; here each experiment is an **in-process trial**: build an engine
 with the candidate config, run a few profiled steps on the user's data, read
-the throughput timer.  (A single SPMD process drives all chips on TPU, so
-in-process trials measure the real thing — there is no per-rank subprocess to
-orchestrate.)
+the per-step timings.  (A single SPMD process drives all chips on TPU, so
+in-process trials measure the real thing — there is no per-rank subprocess
+to orchestrate.)
 
-Flow (mirrors reference ``tune()``):
-  1. model-info profile (num params / per-step memory estimate, :663);
-  2. build the tuning space: ZeRO stages × micro-batch candidates (:741);
-  3. run the tuner strategy (grid/random/model-based) with early stopping;
-  4. write ``autotuning_results/`` with per-exp metrics + the best config.
+Two tuning surfaces share the machinery:
+
+* the **legacy grid** (reference ``tune()``): ZeRO stage × micro-batch
+  (× mesh factorization), maximizing throughput;
+* the **closed comm loop** (``autotuning.tune_comm``, ISSUE 12): a
+  topology-probe stage (``probe.py`` — (inter, intra) factorization plus
+  per-(op, message-size, wire) median-latency micro-probes reusing the
+  in-process ``ds_bench`` candidate machinery), then a search over the
+  real ``comm_optimizations``/ZeRO knob surface — per-message-size wire
+  dtype (the EQuARX lesson, emitted as a ``wire_dtype_by_size`` ladder),
+  hierarchy on/off, ``min_message_size``, ``overlap.bucket_mb`` /
+  ``max_inflight`` in both directions, ZeRO stage — scored by measured
+  median step time with ``exposed_comm_frac`` as the tie-breaker, then an
+  emit stage writing ``autotuning_results/`` with per-trial
+  ``ds_bench``-schema rows plus a ready-to-paste config block that is
+  round-tripped through the pydantic config models as a self-check before
+  it is written.
 """
 
 import itertools
@@ -21,12 +33,17 @@ import time
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..utils.logging import logger
-from .config import AutotuningConfig
+from .config import MIN_METRICS, AutotuningConfig
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
 TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,
           "model_based": ModelBasedTuner}
+
+
+class AutotuningError(RuntimeError):
+    """A tuning-stage invariant failed (emit self-check, empty space)."""
 
 
 class Autotuner:
@@ -47,6 +64,9 @@ class Autotuner:
         self.steps_per_trial = steps_per_trial or at.end_profile_step
         self.results = []
         self.model_info = None
+        self.topology = None
+        self.probe_rows = None
+        self.wire_ladders = {}
 
     # ------------------------------------------------------------ profiling
     def profile_model_info(self):
@@ -59,6 +79,37 @@ class Autotuner:
             n = 0
         self.model_info = {"num_params": n}
         return self.model_info
+
+    # ------------------------------------------------------------- probing
+    def probe(self):
+        """Topology-probe stage (closed comm loop step 1): read the fabric
+        factorization and run the per-(op, size, wire) micro-probes, then
+        derive the measured wire ladders (``probe.derive_wire_ladder``).
+        Idempotent — the search stage calls it lazily."""
+        if self.probe_rows is not None:
+            return self.probe_rows
+        from . import probe as P
+        import deepspeed_tpu
+        deepspeed_tpu.comm.init_distributed()
+        c = self.cfg
+        intra = int((self.base_config.get("comm_optimizations") or {})
+                    .get("intra_node_size", 0) or 0)
+        with _telemetry.span("autotune/probe", cat="autotune"):
+            self.topology = P.probe_topology(axis=c.comm_axis,
+                                             intra_node_size=intra)
+            self.probe_rows = P.run_probes(
+                sizes_log2=c.probe_sizes, wires=c.probe_wires,
+                axis=c.comm_axis, iters=c.probe_iters,
+                warmup=c.probe_warmup, repeat=c.probe_repeat, intra=intra)
+        for op in ("reduce_scatter", "all_gather"):
+            ladder = P.derive_wire_ladder(self.probe_rows, op=op)
+            if ladder is not None:
+                self.wire_ladders[op] = ladder
+        logger.info(
+            f"autotuning probe: topology={self.topology['hierarchy']} "
+            f"{len(self.probe_rows)} probe rows, "
+            f"ladders={list(self.wire_ladders)}")
+        return self.probe_rows
 
     # --------------------------------------------------------- tuning space
     def _micro_batch_candidates(self):
@@ -95,9 +146,14 @@ class Autotuner:
             cands.append({"dp": -1, "tp": 2, "sp": 2})
         return cands
 
+    def _base_trial_config(self):
+        ds = dict(self.base_config)
+        ds.pop("autotuning", None)
+        return json.loads(json.dumps(ds))  # deep copy
+
     def build_tuning_space(self):
-        """ZeRO-stage × mbs (× mesh) grid (reference config_templates per
-        stage; mesh is the TPU extension)."""
+        """Legacy grid: ZeRO-stage × mbs (× mesh) (reference
+        config_templates per stage; mesh is the TPU extension)."""
         stages = self.cfg.zero_stages
         if stages is None:
             stages = [0, 1, 2, 3]
@@ -107,9 +163,7 @@ class Autotuner:
         for stage, mbs, mesh in itertools.product(
                 stages, self._micro_batch_candidates(),
                 self._mesh_candidates()):
-            ds = dict(self.base_config)
-            ds.pop("autotuning", None)
-            ds = json.loads(json.dumps(ds))  # deep copy
+            ds = self._base_trial_config()
             ds.setdefault("zero_optimization", {})["stage"] = stage
             ds["train_micro_batch_size_per_gpu"] = mbs
             ds.pop("train_batch_size", None)
@@ -120,89 +174,456 @@ class Autotuner:
             exps.append({"name": name, "ds_config": ds})
         return exps
 
+    # ---------------------------------------------- comm-loop tuning space
+    def _comm_blocks(self, stage=0):
+        """Candidate ``comm_optimizations`` blocks (closed comm loop step 2).
+
+        None = the hand-written default (absent block) — ALWAYS in the
+        space, so the search can conclude "leave it alone" and the smoke
+        gate's "autotuned ≤ default" holds by construction.  The quantized
+        candidates sweep each probe wire globally plus the measured
+        per-size ladder; the overlap dimension composes bucket_mb ×
+        max_inflight onto every base block (overlap has its own gate, so
+        it also rides the flat default)."""
+        c = self.cfg
+        bases = [None]
+        ladder_rs = self.wire_ladders.get("reduce_scatter")
+        ladder_ag = self.wire_ladders.get("all_gather")
+        for hier in (c.hierarchical_candidates or [True]):
+            for mms in (c.min_message_sizes or [0]):
+                proto = {"enabled": True, "hierarchical_allreduce": hier,
+                         "min_message_size": mms}
+                for w in c.probe_wires:
+                    bases.append(dict(proto, quantized_gradients=True,
+                                      wire_dtype=w))
+                if ladder_rs:
+                    # the EQuARX candidate: per-size wire choice from the
+                    # measured reduce_scatter (qgZ) probes
+                    bases.append(dict(proto, quantized_gradients=True,
+                                      wire_dtype_by_size=ladder_rs))
+                if ladder_ag:
+                    # qwZ sibling: the all_gather probes' ladder carried by
+                    # the weight-gather path (one ladder field serves the
+                    # whole block, so the two ladders ride separate
+                    # candidates)
+                    bases.append(dict(proto, quantized_weights=True,
+                                      wire_dtype_by_size=ladder_ag))
+        blocks = []
+        for b in bases:
+            blocks.append(b)
+            for mb in c.bucket_mb_candidates:
+                for infl in c.max_inflight_candidates:
+                    nb = dict(b) if b else {}
+                    nb["overlap"] = {"enabled": True, "bucket_mb": mb,
+                                     "max_inflight": infl}
+                    blocks.append(nb)
+        if stage >= 3:
+            # forward param-gather prefetch only exists at stage 3; give the
+            # gather-direction priors (and sweep bests) candidates to land
+            # on — one set over the flat base, one over the qwZ ladder base
+            pf_bases = [None] + ([bases[-1]] if ladder_ag else [])
+            for b in pf_bases:
+                for mb in c.bucket_mb_candidates:
+                    for infl in c.max_inflight_candidates:
+                        nb = dict(b) if b else {}
+                        nb["overlap"] = {"prefetch": {
+                            "enabled": True, "bucket_mb": mb,
+                            "max_inflight": infl}}
+                        blocks.append(nb)
+        return blocks
+
+    @staticmethod
+    def _block_name(stage, block):
+        if block is None:
+            return f"z{stage}_default"
+        parts = [f"z{stage}"]
+        if block.get("enabled"):
+            if block.get("wire_dtype_by_size"):
+                parts.append("ladder")
+            elif block.get("quantized_gradients"):
+                parts.append(f"w{block.get('wire_dtype', 'int8')}")
+            if block.get("quantized_weights"):
+                parts.append("qw")
+            if block.get("hierarchical_allreduce"):
+                parts.append("hier")
+            if block.get("min_message_size"):
+                parts.append(f"mms{block['min_message_size']}")
+        ov = block.get("overlap") or {}
+        if ov.get("enabled"):
+            parts.append(f"ov{ov['bucket_mb']:g}x{ov.get('max_inflight', 2)}")
+        pf = ov.get("prefetch") or {}
+        if pf.get("enabled"):
+            parts.append(f"pf{pf['bucket_mb']:g}x{pf.get('max_inflight', 2)}")
+        return "_".join(parts)
+
+    def build_comm_space(self):
+        """Candidate full configs for the comm loop: comm block × ZeRO
+        stage, micro-batch and mesh pinned to the base config (the comm
+        loop tunes the communication surface, not the batch trinity)."""
+        self.probe()
+        stages = self.cfg.zero_stages
+        if stages is None:
+            stages = [int((self.base_config.get("zero_optimization") or {})
+                          .get("stage", 0))]
+        user_co = self.base_config.get("comm_optimizations")
+        exps = []
+        for stage in stages:
+            stage_exps = []
+            for block in self._comm_blocks(stage):
+                ds = self._base_trial_config()
+                ds.setdefault("zero_optimization", {})["stage"] = stage
+                if block is None:
+                    ds.pop("comm_optimizations", None)
+                else:
+                    ds["comm_optimizations"] = json.loads(json.dumps(block))
+                stage_exps.append({"name": self._block_name(stage, block),
+                                   "ds_config": ds,
+                                   "pinned": block is None})
+            if user_co is not None:
+                # the user's own hand-written block IS a candidate (pinned
+                # right after the absent-block default): "leave it alone"
+                # must mean keeping what the user had, and the ≤-baseline
+                # comparison must cover it, not just the bare default
+                ds = self._base_trial_config()
+                ds.setdefault("zero_optimization", {})["stage"] = stage
+                ds["comm_optimizations"] = json.loads(json.dumps(user_co))
+                stage_exps.insert(1, {"name": f"z{stage}_user",
+                                      "ds_config": ds, "pinned": True})
+            exps.extend(stage_exps)
+        if not exps:
+            raise AutotuningError("comm tuning space is empty — check "
+                                  "zero_stages / candidate lists")
+        if self.cfg.priors_file:
+            from .priors import load_priors_file, seed_exps_with_priors
+            priors = load_priors_file(self.cfg.priors_file)
+            # the baseline candidates (absent-block default + the user's
+            # own block) stay pinned at the FRONT: they are what the
+            # acceptance compares against, and a priors ordering that
+            # pushed them past the trial budget would break the
+            # "autotuned ≤ default" invariant (and the smoke gate)
+            pinned = [e for e in exps if e.get("pinned")]
+            rest = [e for e in exps if not e.get("pinned")]
+            exps = pinned + seed_exps_with_priors(rest, priors)
+            logger.info(f"autotuning: search seeded from priors file "
+                        f"{self.cfg.priors_file}")
+        return exps
+
     # ----------------------------------------------------------- experiment
     def _run_experiment(self, exp):
         import jax
         import deepspeed_tpu
+        from ..comm.comm import comms_logger
         from ..utils import groups
         ds = exp["ds_config"]
-        mbs = ds["train_micro_batch_size_per_gpu"]
+        mbs = ds.get("train_micro_batch_size_per_gpu", 1)
         groups.reset_mesh()
         deepspeed_tpu.comm.destroy_process_group()
+        c = _telemetry.counter("autotune/trials",
+                               help="autotuner trials run")
+        if c is not None:
+            c.inc()
+        prev_log = (comms_logger.enabled, comms_logger.prof_all,
+                    comms_logger.sync_timing)
+        # trials are hermetic: the surrounding session's accumulated comm
+        # stats come back after the trial, not an empty table
+        prev_dict = comms_logger.comms_dict
         try:
-            engine, _, _, _ = deepspeed_tpu.initialize(
-                model=self.model, model_parameters=self.model_parameters,
-                config=ds)
-            batch = self.batch_fn(mbs * engine.dp_world_size)
-            if not isinstance(batch, tuple):
-                batch = (batch, )
-            if engine.params is None:
-                # flax module without explicit parameters: born-sharded init
-                engine.initialize_parameters(0, *batch)
-            warmup = max(1, self.cfg.start_profile_step - 1)
-            steps = max(self.steps_per_trial, warmup + 1)
-            t0 = None
-            for i in range(steps):
-                loss = engine(*batch)
-                engine.backward(loss)
-                engine.step()
-                if i + 1 == warmup:
-                    jax.block_until_ready(loss)
+            with _telemetry.span(f"autotune/trial/{exp['name']}",
+                                 cat="autotune"):
+                engine, _, _, _ = deepspeed_tpu.initialize(
+                    model=self.model, model_parameters=self.model_parameters,
+                    config=ds)
+                batch = self.batch_fn(mbs * engine.dp_world_size)
+                if not isinstance(batch, tuple):
+                    batch = (batch, )
+                if engine.params is None:
+                    # flax module without explicit params: born-sharded init
+                    engine.initialize_parameters(0, *batch)
+                warmup = max(1, self.cfg.start_profile_step - 1)
+                steps = max(self.steps_per_trial, warmup + 1)
+                # eager-collective latency during the measured window — the
+                # exposed_comm_frac tie-breaker (jit-internal collectives
+                # are already hidden by XLA and don't appear here).
+                # sync_timing: without it, timed_op records async ENQUEUE
+                # latency (microseconds regardless of payload) and the
+                # tie-breaker would be scheduler noise; the fence cost is
+                # identical across candidates, so the comparison stays fair
+                comms_logger.enabled = True
+                comms_logger.prof_all = True
+                comms_logger.sync_timing = True
+                comms_logger.comms_dict = {}
+                step_times = []
+                comm_s = 0.0
+                for i in range(steps):
+                    if i == warmup:
+                        comms_logger.comms_dict = {}
                     t0 = time.perf_counter()
-            jax.block_until_ready(
-                jax.tree_util.tree_leaves(engine.params)[0])
-            dt = time.perf_counter() - t0
-            measured = steps - warmup
-            samples = mbs * engine.dp_world_size * \
-                engine.gradient_accumulation_steps() * measured
-            thr = samples / dt if dt > 0 else 0.0
-            result = {"throughput": thr, "latency": dt / measured,
-                      "flops": None, "steps": measured}
+                    loss = engine(*batch)
+                    engine.backward(loss)
+                    engine.step()
+                    # per-step fence: median-of-steps needs real step
+                    # boundaries (identical protocol for every candidate)
+                    jax.block_until_ready(
+                        jax.tree_util.tree_leaves(engine.params)[0])
+                    if i >= warmup:
+                        step_times.append(time.perf_counter() - t0)
+                for sizes in comms_logger.comms_dict.values():
+                    for (_, latencies, *_rest) in sizes.values():
+                        comm_s += sum(latencies)
+                measured = len(step_times)
+                total = sum(step_times)
+                step_med = float(np.median(step_times))
+                samples = mbs * engine.dp_world_size * \
+                    engine.gradient_accumulation_steps() * measured
+                thr = samples / total if total > 0 else 0.0
+                result = {
+                    "throughput": thr,
+                    "latency": total / measured,
+                    "step_time_ms": step_med * 1e3,
+                    "step_time": step_med * 1e3,
+                    "exposed_comm_frac": (min(1.0, comm_s / total)
+                                          if total > 0 else 0.0),
+                    "flops": None,
+                    "steps": measured,
+                }
         except Exception as e:  # OOM / invalid combo → prune the point
             logger.warning(f"autotuning exp {exp['name']} failed: {e}")
             result = None
         finally:
+            (comms_logger.enabled, comms_logger.prof_all,
+             comms_logger.sync_timing) = prev_log
+            comms_logger.comms_dict = prev_dict
             groups.reset_mesh()
             deepspeed_tpu.comm.destroy_process_group()
-        self.results.append({"name": exp["name"], "result": result})
+        self.results.append({"name": exp["name"], "result": result,
+                             "ds_config": exp["ds_config"]})
         return result
 
     # ---------------------------------------------------------------- tune
     def tune(self):
         self.profile_model_info()
-        exps = self.build_tuning_space()
-        tuner_cls = TUNERS.get(self.cfg.tuner_type, GridSearchTuner)
+        c = self.cfg
+        if c.tune_comm:
+            exps = self.build_comm_space()
+            metric = "step_time" if c.metric == "throughput" else c.metric
+            mode = "min" if metric in MIN_METRICS else "max"
+            tie = "exposed_comm_frac"
+        else:
+            exps = self.build_tuning_space()
+            metric, tie = c.metric, None
+            mode = "min" if metric in MIN_METRICS else "max"
+        tuner_cls = TUNERS.get(c.tuner_type, GridSearchTuner)
         kw = {}
-        if tuner_cls is ModelBasedTuner and self.cfg.priors_path and \
-                os.path.isdir(self.cfg.priors_path):
-            if self.cfg.metric != "throughput":
-                # bench records are tokens/s (a throughput); seeding a
-                # latency/flops search with them would silently run cold
-                logger.warning(
-                    f"measured priors only exist for metric='throughput' "
-                    f"(configured: {self.cfg.metric!r}); tuning starts "
-                    "cold")
-            else:
-                from .priors import load_measured_priors
-                kw["priors"] = load_measured_priors(self.cfg.priors_path)
-        tuner = tuner_cls(exps, self._run_experiment, metric=self.cfg.metric,
-                          **kw)
-        best = tuner.tune(sample_size=1,
-                          n_trials=self.cfg.tuner_num_trials,
-                          early_stopping=self.cfg.tuner_early_stopping)
-        self._write_results(best)
+        if tuner_cls is ModelBasedTuner:
+            kw["priors"] = self._measured_priors(metric)
+        if tie is not None:
+            kw["tie_breaker"] = tie
+            kw["tie_rtol"] = c.tie_rtol
+        tuner = tuner_cls(exps, self._run_experiment, metric=metric,
+                          mode=mode, **kw)
+        with _telemetry.span("autotune/search", cat="autotune"):
+            best = tuner.tune(sample_size=1,
+                              n_trials=c.tuner_num_trials,
+                              early_stopping=c.tuner_early_stopping)
+        if best is not None:
+            g = _telemetry.gauge("autotune/best_" + metric,
+                                 help="autotuner best primary metric")
+            if g is not None:
+                g.set(float(best["result"][metric]))
+        self._write_results(best, metric)
         return best
 
-    def _write_results(self, best):
+    def _measured_priors(self, metric):
+        if not (self.cfg.priors_path and
+                os.path.isdir(self.cfg.priors_path)):
+            return None
+        if metric != "throughput":
+            # bench records are tokens/s (a throughput); seeding a
+            # latency/step-time search with them would silently run cold
+            logger.warning(
+                f"measured priors only exist for metric='throughput' "
+                f"(configured: {metric!r}); tuning starts cold")
+            return None
+        from .priors import load_measured_priors
+        return load_measured_priors(self.cfg.priors_path)
+
+    # ---------------------------------------------------------------- emit
+    def _trial_rows(self, metric):
+        """Per-trial rows in the uniform ``ds_bench --json`` schema
+        (``benchmarks.comm_bench.bench_row`` — the one row constructor all
+        producers share), so the trial archive folds/plots with the probe
+        and sweep archives."""
+        from ..benchmarks.comm_bench import bench_row
+        rows = []
+        for r in self.results:
+            res = r["result"]
+            co = (r.get("ds_config") or {}).get("comm_optimizations") or {}
+            ov = co.get("overlap") or {}
+            rows.append(bench_row(
+                op="trial",
+                trial=r["name"],
+                latency_us=(res["step_time_ms"] * 1e3 if res else None),
+                repeat=res["steps"] if res else 0,
+                wire_dtype=("ladder" if co.get("wire_dtype_by_size") else
+                            co.get("wire_dtype", "int8")
+                            if (co.get("quantized_gradients")
+                                or co.get("quantized_weights"))
+                            else "fp32"),
+                bucket_mb=(float(ov["bucket_mb"])
+                           if ov.get("enabled") else None),
+                exposed_comm_frac=(res.get("exposed_comm_frac")
+                                   if res else None),
+                metric=metric,
+                metric_value=res.get(metric) if res else None,
+            ))
+        return rows
+
+    @staticmethod
+    def _check_round_trip(section, src, model):
+        """Emit self-check: every key we are about to publish must survive
+        the pydantic round-trip with an equal value — a field the model
+        clamps, coerces, or drops would otherwise emit a block that
+        configures something other than what was measured.  Keys are read
+        back through the model's field/alias map (``stage3_*`` alias
+        spellings are how the docs write the zero block — an alias is a
+        rename the model itself honors, not drift)."""
+        from pydantic import BaseModel
+        fields = type(model).model_fields
+        alias_to_name = {f.alias: name for name, f in fields.items()
+                         if f.alias}
+        for k, v in src.items():
+            attr = alias_to_name.get(k, k)
+            got = getattr(model, attr, None)
+            if isinstance(v, dict) and isinstance(got, BaseModel):
+                Autotuner._check_round_trip(f"{section}.{k}", v, got)
+            elif got != v:
+                raise AutotuningError(
+                    f"emitted config failed round-trip self-check: "
+                    f"{section}.{k} = {v!r} came back as {got!r}")
+
+    def emit_block(self, best):
+        """The ready-to-paste ``comm_optimizations`` + ``zero_optimization``
+        block of the winning trial, round-tripped through the pydantic
+        config models as a self-check before anyone writes it."""
+        ds = best["ds_config"]
+        block = {}
+        co = ds.get("comm_optimizations")
+        if co is not None:
+            block["comm_optimizations"] = json.loads(json.dumps(co))
+        zo = ds.get("zero_optimization")
+        if zo:
+            block["zero_optimization"] = json.loads(json.dumps(zo))
+        from ..runtime.config import CommOptimizationsConfig
+        from ..runtime.zero.config import DeepSpeedZeroConfig
+        if "comm_optimizations" in block:
+            self._check_round_trip(
+                "comm_optimizations", block["comm_optimizations"],
+                CommOptimizationsConfig(**block["comm_optimizations"]))
+        if "zero_optimization" in block:
+            self._check_round_trip(
+                "zero_optimization", block["zero_optimization"],
+                DeepSpeedZeroConfig(**block["zero_optimization"]))
+        return block
+
+    def _write_results(self, best, metric="throughput"):
         os.makedirs(self.cfg.results_dir, exist_ok=True)
-        with open(os.path.join(self.cfg.results_dir, "exps.json"), "w") as f:
-            json.dump(self.results, f, indent=2)
-        with open(os.path.join(self.cfg.results_dir,
-                               "model_info.json"), "w") as f:
-            json.dump(self.model_info, f, indent=2)
+
+        def _dump(name, payload):
+            with open(os.path.join(self.cfg.results_dir, name), "w") as f:
+                json.dump(payload, f, indent=2)
+
+        _dump("exps.json", self.results)
+        _dump("model_info.json", self.model_info)
+        _dump("trials.json", {"metric": metric,
+                              "rows": self._trial_rows(metric)})
+        if self.topology is not None:
+            _dump("topology.json", self.topology)
+        if self.probe_rows is not None:
+            _dump("probes.json", {"rows": self.probe_rows,
+                                  "wire_ladders": self.wire_ladders})
         if best is not None:
-            with open(os.path.join(self.cfg.results_dir,
-                                   "ds_config_optimal.json"), "w") as f:
-                json.dump(best["ds_config"], f, indent=2)
-            logger.info(f"autotuning best: {best['name']} "
-                        f"{self.cfg.metric}={best['result'][self.cfg.metric]:.1f}")
+            _dump("ds_config_optimal.json", best["ds_config"])
+            _dump("tuned_block.json", self.emit_block(best))
+            logger.info(
+                f"autotuning best: {best['name']} "
+                f"{metric}={best['result'][metric]:.3f}")
+
+
+def run_autotuning(args=None, model=None, base_config=None,
+                   model_parameters=None, batch_fn=None,
+                   steps_per_trial=None):
+    """THE autotuning entry (launcher ``--autotuning`` and programmatic).
+
+    * programmatic: pass ``model``/``model_parameters``/``batch_fn`` and a
+      ``base_config`` carrying an ``autotuning`` block (the
+      ``deepspeed.initialize``-style config — ``autotuning.enabled: false``
+      means this function refuses to run, matching "off by default = zero
+      behavior change");
+    * launcher (``deepspeed --autotuning run script.py --deepspeed_config
+      cfg.json``): the config is read from the user args and the trials run
+      on a built-in synthetic model — the comm surface is model-agnostic
+      enough for a first config, and the emitted block documents exactly
+      what was measured.
+
+    Returns the best experiment dict (or None when every trial failed).
+    """
+    if base_config is None and args is not None:
+        cfg_path = None
+        user_args = list(getattr(args, "user_args", []) or [])
+        for i, a in enumerate(user_args):
+            if a == "--deepspeed_config" and i + 1 < len(user_args):
+                cfg_path = user_args[i + 1]
+            elif a.startswith("--deepspeed_config="):
+                cfg_path = a.split("=", 1)[1]
+        if cfg_path is None:
+            raise AutotuningError(
+                "--autotuning needs --deepspeed_config <json> among the "
+                "user args (the config whose autotuning block drives the "
+                "search)")
+        with open(cfg_path) as f:
+            base_config = json.load(f)
+    base_config = dict(base_config or {})
+    at = base_config.get("autotuning", {})
+    at_cfg = at if isinstance(at, AutotuningConfig) else \
+        AutotuningConfig(**at)
+    if not at_cfg.enabled:
+        raise AutotuningError(
+            "autotuning.enabled is false — set it to true to run the "
+            "search (off by default = zero behavior change)")
+    if model is None:
+        model, model_parameters, batch_fn = _synthetic_trial_model()
+        base_config.setdefault("train_micro_batch_size_per_gpu", 4)
+        base_config.setdefault("optimizer",
+                               {"type": "sgd", "params": {"lr": 0.1}})
+    tuner = Autotuner(model, base_config, model_parameters=model_parameters,
+                      batch_fn=batch_fn, autotuning_config=at_cfg,
+                      steps_per_trial=steps_per_trial)
+    return tuner.tune()
+
+
+def _synthetic_trial_model(hidden=64, nlayers=4, seed=0):
+    """Tiny deterministic MLP + batch builder for model-less entries (the
+    launcher path and tools/autotune_smoke.py): enough layers/leaves that
+    the overlap partitioners form >1 bucket and the grad reduce is real."""
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": (rng.standard_normal((hidden, hidden)) * 0.2
+                  ).astype("float32"),
+            "b": np.zeros((hidden, ), "float32"),
+        }
+
+    def apply_fn(p, x, y):
+        import jax.numpy as jnp
+        h = x
+        for i in range(nlayers):
+            h = jnp.tanh(h @ p[f"layer_{i}"]["w"] + p[f"layer_{i}"]["b"])
+        return jnp.mean((h - y) ** 2)
+
+    def batch_fn(global_batch):
+        r = np.random.default_rng(1)
+        x = r.standard_normal((global_batch, hidden)).astype("float32")
+        return (x, np.tanh(x * 0.5).astype("float32"))
+
+    return apply_fn, params, batch_fn

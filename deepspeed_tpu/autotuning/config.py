@@ -1,31 +1,49 @@
 """Autotuning config — same JSON keys as reference
-``autotuning/constants.py`` / ``autotuning/config.py``."""
+``autotuning/constants.py`` / ``autotuning/config.py`` for the surviving
+surface, plus the comm-surface closed loop (ISSUE 12 / docs/autotuning.md).
+
+Unlike every other config block, this one REJECTS unknown keys
+(``extra="forbid"``): a mistyped search knob (``bucket_mb_candiates``)
+would otherwise silently tune the default space and burn the whole trial
+budget measuring nothing the user asked for.  Stale reference-only fields
+(``arg_mappings``, ``mp_size``, ``model_info``, ``overwrite``,
+``max/min_train_batch_size``) that were parsed-but-ignored are gone for
+the same reason — configs carrying them now fail loudly instead of
+pretending the knob did something.
+"""
 
 from typing import Dict, List, Optional
 
+from pydantic import ConfigDict, model_validator
+
 from ..runtime.config_utils import DeepSpeedConfigModel
+
+METRICS = ("throughput", "latency", "flops", "step_time")
+TUNER_TYPES = ("gridsearch", "random", "model_based")
+#: metrics where smaller is better (the tuner runs in min mode)
+MIN_METRICS = ("latency", "step_time")
 
 
 class AutotuningConfig(DeepSpeedConfigModel):
+    # pydantic v2 merges this with DeepSpeedConfigModel's ConfigDict, so
+    # only the one divergence is stated: unknown keys fail loudly (see
+    # module doc) instead of the base's extra="allow"
+    model_config = ConfigDict(extra="forbid")
+
     enabled: bool = False
     fast: bool = True
     results_dir: str = "autotuning_results"
     exps_dir: str = "autotuning_exps"
-    overwrite: bool = True
     start_profile_step: int = 3
     end_profile_step: int = 5
-    metric: str = "throughput"          # throughput | latency | flops
+    # throughput | latency | flops | step_time (step_time/latency = min mode)
+    metric: str = "throughput"
     tuner_type: str = "gridsearch"      # gridsearch | random | model_based
     tuner_early_stopping: int = 5
     tuner_num_trials: int = 50
-    arg_mappings: Optional[Dict[str, str]] = None
-    max_train_batch_size: Optional[int] = None
-    min_train_batch_size: int = 1
     max_train_micro_batch_size_per_gpu: int = 1024
     min_train_micro_batch_size_per_gpu: int = 1
     num_tuning_micro_batch_sizes: int = 3
-    mp_size: int = 1
-    model_info: Optional[Dict] = None
     zero_stages: Optional[List[int]] = None  # TPU addition: restrict space
     # TPU addition: also explore mesh factorizations (the launcher-level
     # knob the reference cannot tune in-process).  Candidates are dicts for
@@ -37,3 +55,52 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # this directory (tools/bench_retry.sh artifacts).  Opt-in ("" = off):
     # stale artifacts in a launch cwd must not silently bias a search.
     priors_path: str = ""
+
+    # ------------------------------------------------ comm-surface loop
+    # tune_comm: walk the comm_optimizations/ZeRO surface instead of the
+    # legacy stage × micro-batch grid — topology probe first, then the
+    # search stage over per-size wire dtype / hierarchy / min_message_size
+    # / overlap bucketing, scored by measured step time with
+    # exposed_comm_frac as the tie-breaker (docs/autotuning.md).
+    tune_comm: bool = False
+    # fold_sweeps --priors artifact; "" = cold start.  Candidates matching
+    # the measured-best (direction, bucket_mb, wire) aggregates are
+    # proposed first.
+    priors_file: str = ""
+    # mesh axis the comm trials/probes sweep
+    comm_axis: str = "dp"
+    # micro-probe surface: log2 payload bytes per size bucket, quantized
+    # wire formats to race against the flat fp32 op, and the warmup +
+    # repeat-block protocol (median + IQR, see ds_bench --repeat)
+    probe_sizes: List[int] = [14, 18, 22]
+    probe_wires: List[str] = ["int8", "fp8"]
+    probe_iters: int = 4
+    probe_warmup: int = 1
+    probe_repeat: int = 3
+    # search-space candidate lists
+    bucket_mb_candidates: List[float] = [1.0, 4.0, 32.0]
+    max_inflight_candidates: List[int] = [2]
+    min_message_sizes: List[int] = [0]
+    hierarchical_candidates: List[bool] = [True]
+    # candidates within this relative step-time margin count as a tie and
+    # are broken by the lower exposed_comm_frac
+    tie_rtol: float = 0.02
+
+    @model_validator(mode="after")
+    def _check_enums(self):
+        if self.metric not in METRICS:
+            raise ValueError(f"autotuning.metric {self.metric!r} unknown "
+                             f"(have {', '.join(METRICS)})")
+        if self.tuner_type not in TUNER_TYPES:
+            raise ValueError(
+                f"autotuning.tuner_type {self.tuner_type!r} unknown "
+                f"(have {', '.join(TUNER_TYPES)})")
+        from ..comm.collectives import WIRE_FORMATS
+        for w in self.probe_wires:
+            if w not in WIRE_FORMATS:
+                raise ValueError(
+                    f"autotuning.probe_wires entry {w!r} unknown "
+                    f"(have {', '.join(WIRE_FORMATS)})")
+        if self.start_profile_step < 1:
+            raise ValueError("autotuning.start_profile_step must be >= 1")
+        return self

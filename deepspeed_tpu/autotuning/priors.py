@@ -59,6 +59,72 @@ def record_to_prior(rec):
     }
 
 
+# ---------------------------------------------------------- priors files
+# ``tools/fold_sweeps.py --priors OUT.json`` exports the aggregated
+# (direction, bucket_mb, wire_dtype) bests from ds_bench --overlap archives
+# under this schema tag; the autotuner ingests the file to seed its search
+# (candidates matching the measured bests are proposed first).
+PRIORS_SCHEMA = "ds_tpu_autotune_priors/1"
+
+
+def load_priors_file(path):
+    """Load a ``fold_sweeps --priors`` artifact.  Loud on a missing file or
+    wrong schema — a stale/foreign JSON must not silently order the
+    search."""
+    with open(path) as f:
+        data = json.load(f)
+    schema = data.get("schema") if isinstance(data, dict) else None
+    if schema != PRIORS_SCHEMA:
+        raise ValueError(
+            f"{path}: not an autotuner priors file (schema {schema!r}, "
+            f"expected {PRIORS_SCHEMA!r}; generate one with "
+            "tools/fold_sweeps.py --priors OUT.json)")
+    if not isinstance(data.get("overlap"), list):
+        raise ValueError(f"{path}: priors file has no 'overlap' aggregate "
+                         "list")
+    return data
+
+
+def _block_matches_prior(co, best):
+    """How many of the measured-best (direction, bucket_mb, wire) choices a
+    candidate's comm block agrees with."""
+    ov = (co.get("overlap") or {})
+    pf = (ov.get("prefetch") or {})
+    score = 0
+    r = best.get("reduce")
+    if r is not None and ov.get("enabled") and \
+            float(ov.get("bucket_mb") or -1) == float(r["bucket_mb"]):
+        score += 1
+    g = best.get("gather")
+    if g is not None and pf.get("enabled") and \
+            float(pf.get("bucket_mb") or -1) == float(g["bucket_mb"]):
+        score += 1
+    if r is not None:
+        wire = (co.get("wire_dtype", "int8")
+                if co.get("enabled") and co.get("quantized_gradients")
+                else "fp32")
+        if wire == r.get("wire_dtype"):
+            score += 1
+    return score
+
+
+def seed_exps_with_priors(exps, priors):
+    """Stable-reorder candidate experiments so configs consistent with the
+    priors' per-direction bests run first — the grid tuner's early
+    stopping and the model-based tuner's cold phase both start from the
+    measured ground truth instead of list order."""
+    best = {}
+    for row in priors.get("overlap", []):
+        # fold_sweeps sorts best-first within each direction
+        best.setdefault(row.get("direction"), row)
+    if not best:
+        return list(exps)
+    return sorted(
+        exps,
+        key=lambda e: -_block_matches_prior(
+            e["ds_config"].get("comm_optimizations") or {}, best))
+
+
 def load_measured_priors(runs_dir=".bench_runs"):
     """Collect priors from every trustworthy record under ``runs_dir``
     (top-level ``*.json`` ladder legs + ``sweeps/*.json``)."""

@@ -1,0 +1,130 @@
+"""Topology-probe stage of the closed-loop comm autotuner.
+
+Answers two questions before any config trial runs:
+
+1. **What is the fabric?**  :func:`probe_topology` reads the
+   ``comm/collectives/topology.py`` (inter-node, intra-node) factorization
+   of the comm axis — the same :func:`factor_group` the engine's
+   hierarchical variants dispatch on, so the probe sees exactly the
+   hierarchy the tuned config would use.
+2. **What does each (op, message-size, wire) actually cost here?**
+   :func:`run_probes` races the flat fp32 collective against each
+   candidate quantized wire format per size bucket, using the in-process
+   ``ds_bench`` candidate machinery (``benchmarks.comm_bench.probe_op``)
+   with warmup + repeated timed blocks + median/IQR — no subprocess
+   orchestration, no single-shot noise.
+
+:func:`derive_wire_ladder` then applies the EQuARX lesson (arxiv
+2506.17615: the optimal quantization choice varies by message size and
+op): per size bucket, the measured-fastest wire wins, and adjacent
+same-wire buckets merge into a ``wire_dtype_by_size`` ladder the
+collectives engine dispatches on (``comm/collectives/engine.py``).
+"""
+
+from ..utils.logging import logger
+
+#: logical probe surface → (flat op, quantized op) in ds_bench vocabulary.
+#: reduce_scatter feeds the gradient (qgZ) wire choice, all_gather the
+#: weight (qwZ) one.
+PROBE_OPS = {
+    "reduce_scatter": ("reduce_scatter", "quant_reduce_scatter"),
+    "all_gather": ("all_gather", "quant_all_gather"),
+}
+
+
+def probe_topology(axis="dp", mesh=None, intra_node_size=0):
+    """Factorize the comm axis into (inter, intra) — the hierarchy the
+    tuned config's ``hierarchical_allreduce`` / 2-hop variants would ride.
+    Returns a JSON-able report; ``hierarchy`` is None on flat fabrics
+    (single node, indivisible split)."""
+    from ..comm.backend import ProcessGroup
+    from ..comm.collectives.topology import factor_group
+    from ..utils import groups
+    if mesh is None:
+        mesh = groups.get_mesh_state().mesh
+    report = {
+        "axis": axis,
+        "world": int(mesh.shape.get(axis, 1)),
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "hierarchy": None,
+    }
+    if report["world"] > 1:
+        h = factor_group(ProcessGroup(mesh, (axis, )),
+                         intra_node_size=intra_node_size)
+        if h is not None:
+            report["hierarchy"] = {
+                "outer_axes": list(h.outer_axes),
+                "inner_axes": list(h.inner_axes),
+                "inter": int(h.outer_size),
+                "intra": int(h.inner_size),
+            }
+    return report
+
+
+def run_probes(ops=("reduce_scatter", "all_gather"),
+               sizes_log2=(14, 18, 22), wires=("int8", "fp8"), axis="dp",
+               mesh=None, iters=4, warmup=1, repeat=3, intra=0,
+               group_size=None, print_fn=None):
+    """Per-(op, message-size-bucket, wire) latency/bandwidth probes.
+
+    For every logical op and size bucket, measures the flat fp32 op plus
+    each quantized wire candidate; every row is the uniform ``ds_bench``
+    JSON schema (median ``latency_us``, ``iqr_us``, ``repeat``) tagged
+    with ``probe_op`` (the logical op) and ``size_log2`` (the bucket).
+    """
+    from ..benchmarks.comm_bench import GROUP_SIZE, probe_op
+    gs = group_size or GROUP_SIZE
+    rows = []
+    for logical in ops:
+        if logical not in PROBE_OPS:
+            raise ValueError(f"unknown probe op {logical!r} "
+                             f"(have {', '.join(PROBE_OPS)})")
+        flat_op, quant_op = PROBE_OPS[logical]
+        for p in sizes_log2:
+            nbytes = 1 << int(p)
+            candidates = [("fp32", flat_op)] + [(w, quant_op) for w in wires]
+            for wire, bench_op in candidates:
+                row = probe_op(
+                    bench_op, nbytes, axis=axis, mesh=mesh, iters=iters,
+                    warmup=warmup, repeat=repeat, intra=intra,
+                    wire=(wire if wire != "fp32" else "int8"),
+                    group_size=gs)
+                row["probe_op"] = logical
+                row["wire_dtype"] = wire
+                row["size_log2"] = int(p)
+                rows.append(row)
+                if print_fn is not None:
+                    print_fn(f"# probe {logical:<16} 2^{p:<3} {wire:<6} "
+                             f"median={row['latency_us']:9.1f}us "
+                             f"iqr={row['iqr_us']:7.1f}us")
+    return rows
+
+
+def derive_wire_ladder(rows, op="reduce_scatter"):
+    """Measured probe rows → ``wire_dtype_by_size`` ladder for ``op``.
+
+    Per size bucket the wire with the lowest median latency wins;
+    contiguous same-wire buckets merge into one rung whose ``max_bytes``
+    is the largest probed size of the run, and the last run becomes the
+    catch-all (``max_bytes: null``).  Returns None when no rows cover
+    ``op`` (the caller skips the ladder candidate)."""
+    per_size = {}
+    for r in rows:
+        if r.get("probe_op") != op or r.get("latency_us") is None:
+            continue
+        p = int(r["size_log2"])
+        cur = per_size.get(p)
+        if cur is None or r["latency_us"] < cur["latency_us"]:
+            per_size[p] = r
+    if not per_size:
+        return None
+    ladder = []
+    for p in sorted(per_size):
+        wire = per_size[p]["wire_dtype"]
+        if ladder and ladder[-1][1] == wire:
+            ladder[-1][0] = 1 << p       # extend the same-wire run
+        else:
+            ladder.append([1 << p, wire])
+    ladder[-1][0] = None                 # largest run = catch-all rung
+    logger.info(f"autotuning: derived {op} wire ladder {ladder}")
+    return ladder

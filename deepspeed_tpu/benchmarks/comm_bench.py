@@ -37,21 +37,36 @@ WIRE_FORMAT = "int8"
 GROUP_SIZE = 2048
 
 
-def _timed(f, args, iters, warmup):
-    """Average per-call latency of ``f(*args)`` after ``warmup`` calls —
-    the one timing loop both sweeps share (block_until_ready fences the
-    async dispatch; safe with warmup=0)."""
+def _timed_stats(f, args, iters, warmup, repeat=1):
+    """Per-call latency statistics of ``f(*args)``: after ``warmup`` calls,
+    time ``repeat`` independent blocks of ``iters`` calls each and return
+    ``(median, iqr)`` over the per-block averages.  Single-shot timings on
+    small messages are noise-dominated (scheduler jitter, dispatch
+    variance) — the median resists outliers and the IQR reports how noisy
+    the probe actually was, so a downstream cost model can weigh it.
+    ``block_until_ready`` fences the async dispatch; safe with warmup=0."""
     import jax
     out = None
     for _ in range(warmup):
         out = f(*args)
     if out is not None:
         jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    samples = []
+    for _ in range(max(1, repeat)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*args)
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) / iters)
+    med = float(np.median(samples))
+    iqr = float(np.percentile(samples, 75) - np.percentile(samples, 25)) \
+        if len(samples) > 1 else 0.0
+    return med, iqr
+
+
+def _timed(f, args, iters, warmup, repeat=1):
+    """Median per-call latency (see :func:`_timed_stats`)."""
+    return _timed_stats(f, args, iters, warmup, repeat=repeat)[0]
 
 
 class UnsplittableAxis(ValueError):
@@ -86,7 +101,8 @@ def _hier(mesh, axis, intra):
             n // inner, inner)
 
 
-def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
+def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0, repeat=1,
+               wire=WIRE_FORMAT, group_size=GROUP_SIZE):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -138,34 +154,79 @@ def _bench_one(op, axis, nbytes, mesh, iters, warmup, intra=0):
         bw_op = "all_reduce"
     elif op == "quant_all_gather":
         f = make(lambda t: Q.quantized_all_gather(
-            t, (axis, ), 0, WIRE_FORMAT, GROUP_SIZE).reshape(-1)[:t.shape[0]],
+            t, (axis, ), 0, wire, group_size).reshape(-1)[:t.shape[0]],
             out_spec=P())
-        wire_bytes = Q.quantized_wire_bytes(elems, WIRE_FORMAT, GROUP_SIZE)
+        wire_bytes = Q.quantized_wire_bytes(elems, wire, group_size)
         bw_op = "all_gather"
     elif op == "quant_reduce_scatter":
         f = make(lambda t: Q.all_to_all_quant_reduce(
-            t, (axis, ), 0, n, wire_format=WIRE_FORMAT,
-            group_size=GROUP_SIZE), in_spec=P(), out_spec=P(axis))
-        wire_bytes = Q.quantized_wire_bytes(elems, WIRE_FORMAT, GROUP_SIZE)
+            t, (axis, ), 0, n, wire_format=wire,
+            group_size=group_size), in_spec=P(), out_spec=P(axis))
+        wire_bytes = Q.quantized_wire_bytes(elems, wire, group_size)
         bw_op = "reduce_scatter"
     elif op == "hier_quant_reduce_scatter":
         smesh, out_ax, in_ax, n_out, n_in = _hier(mesh, axis, intra)
         f = make(lambda t: Q.hierarchical_quant_reduce_scatter(
             t, (in_ax, ), (out_ax, ), 0, n_in, n_out,
-            wire_format=WIRE_FORMAT, group_size=GROUP_SIZE),
+            wire_format=wire, group_size=group_size),
             m=smesh, in_spec=P(), out_spec=P((in_ax, out_ax)))
         # quantized payload crossing DCN on 1/n_in of the data
-        wire_bytes = Q.quantized_wire_bytes(elems // n_in, WIRE_FORMAT,
-                                            GROUP_SIZE)
+        wire_bytes = Q.quantized_wire_bytes(elems // n_in, wire,
+                                            group_size)
         bw_op = "reduce_scatter"
     else:
         raise ValueError(op)
 
-    lat = _timed(f, (x, ), iters, warmup)
+    lat, iqr = _timed_stats(f, (x, ), iters, warmup, repeat=repeat)
 
     from ..utils.comms_logging import calc_bw_log
     algbw, busbw = calc_bw_log(bw_op, wire_bytes, lat, n)
-    return size_bytes, wire_bytes, lat, algbw, busbw
+    return size_bytes, wire_bytes, lat, algbw, busbw, iqr
+
+
+# ------------------------------------------------------------- row schema
+def bench_row(**fields):
+    """THE uniform ``ds_bench --json`` row: every producer (the op sweep,
+    the overlap sweep, :func:`probe_op`, the autotuner's trial archive)
+    builds rows through this one constructor, so a field added to the
+    schema lands everywhere at once instead of drifting across hand-built
+    dict literals.  Unset schema fields are explicit ``None``; extra
+    producer-specific keys (overlap accounting, trial names) pass
+    through."""
+    row = {"op": None, "bytes": None, "wire_bytes": None,
+           "latency_us": None, "iqr_us": None, "repeat": None,
+           "wire_dtype": None, "algbw_gbps": None, "busbw_gbps": None,
+           "bucket_mb": None, "direction": None,
+           "overlap_efficiency": None, "exposed_comm_frac": None}
+    row.update(fields)
+    return row
+
+
+# ------------------------------------------------------------- probe API
+def probe_op(op, nbytes, axis="dp", mesh=None, iters=5, warmup=2, repeat=3,
+             intra=0, wire=WIRE_FORMAT, group_size=GROUP_SIZE):
+    """One in-process micro-probe — the reusable ``ds_bench`` candidate
+    machinery the autotuner's topology-probe stage calls directly (no
+    subprocess orchestration).  Runs ``op`` at ``nbytes`` with warmup +
+    ``repeat`` timed blocks and returns ONE row in the uniform
+    ``ds_bench --json`` schema (median ``latency_us`` + ``iqr_us``).
+
+    ``wire`` selects the wire format of the ``quant_*`` /
+    ``hier_quant_*`` ops (the per-size probes sweep it); flat ops ignore
+    it and report ``wire_dtype: "fp32"``.  Raises
+    :class:`UnsplittableAxis` for ``hier_*`` ops on axes with no
+    non-trivial split — the caller skips that candidate."""
+    from ..utils import groups
+    if mesh is None:
+        mesh = groups.get_mesh_state().mesh
+    size, wire_bytes, lat, algbw, busbw, iqr = _bench_one(
+        op, axis, nbytes, mesh, iters, warmup, intra=intra, repeat=repeat,
+        wire=wire, group_size=group_size)
+    return bench_row(
+        op=op, bytes=int(size), wire_bytes=int(wire_bytes),
+        latency_us=lat * 1e6, iqr_us=iqr * 1e6, repeat=int(repeat),
+        wire_dtype=(wire if "quant" in op else "fp32"),
+        algbw_gbps=algbw, busbw_gbps=busbw)
 
 
 # ------------------------------------------------------------ overlap sweep
@@ -490,13 +551,15 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         iters=20, warmup=3, print_fn=print, intra=0, json_path=None,
         trace_dir=None, overlap=False, overlap_total_mb=8.0,
         overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES,
-        overlap_directions=OVERLAP_DIRECTIONS):
+        overlap_directions=OVERLAP_DIRECTIONS, repeat=3):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
-    (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps); with
-    ``json_path``, also writes them as machine-readable JSON; with
-    ``trace_dir``, archives telemetry artifacts (chrome trace + per-variant
-    comm attribution) alongside the sweep output so a BENCH_*.json row can
-    be traced back to what actually ran."""
+    (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps, iqr_s) —
+    latency is the MEDIAN over ``repeat`` timed blocks, iqr their
+    interquartile range (see ``_timed_stats``); with ``json_path``, also
+    writes them as machine-readable JSON; with ``trace_dir``, archives
+    telemetry artifacts (chrome trace + per-variant comm attribution)
+    alongside the sweep output so a BENCH_*.json row can be traced back to
+    what actually ran."""
     from ..utils import groups
     if mesh_spec:
         kw = {}
@@ -516,32 +579,33 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         recorder = TraceRecorder(trace_dir, rank=0)
     rows = []
     print_fn(f"# mesh={dict(mesh.shape)} axis={axis} dtype=fp32 "
-             f"wire={WIRE_FORMAT}")
+             f"wire={WIRE_FORMAT} repeat={repeat}")
     print_fn(f"{'op':<28}{'bytes':>12}{'wire_bytes':>12}{'latency_us':>14}"
-             f"{'algbw_Gbps':>12}{'busbw_Gbps':>12}")
+             f"{'iqr_us':>10}{'algbw_Gbps':>12}{'busbw_Gbps':>12}")
     for op in ops:
         for p in range(minsize, maxsize + 1, 2):
             try:
                 if recorder is not None:
                     with recorder.span(f"{op}/{1 << p}", cat="bench"):
-                        size, wire, lat, algbw, busbw = _bench_one(
+                        size, wire, lat, algbw, busbw, iqr = _bench_one(
                             op, axis, 1 << p, mesh, iters, warmup,
-                            intra=intra)
+                            intra=intra, repeat=repeat)
                 else:
-                    size, wire, lat, algbw, busbw = _bench_one(
-                        op, axis, 1 << p, mesh, iters, warmup, intra=intra)
+                    size, wire, lat, algbw, busbw, iqr = _bench_one(
+                        op, axis, 1 << p, mesh, iters, warmup, intra=intra,
+                        repeat=repeat)
             except UnsplittableAxis as e:
                 # hier_* on an unsplittable axis: note and keep sweeping the
                 # other ops (any other error still fails the bench loudly)
                 print_fn(f"# {op}: skipped ({e})")
                 break
-            rows.append((op, size, wire, lat, algbw, busbw))
+            rows.append((op, size, wire, lat, algbw, busbw, iqr))
             if recorder is not None:
                 base, variant = _TRACE_VARIANTS.get(op, (op, None))
                 recorder.comm_event(base, variant, size, wire, lat,
                                     world_size=mesh.shape[axis])
             print_fn(f"{op:<28}{size:>12}{wire:>12}{lat * 1e6:>14.1f}"
-                     f"{algbw:>12.2f}{busbw:>12.2f}")
+                     f"{iqr * 1e6:>10.1f}{algbw:>12.2f}{busbw:>12.2f}")
     overlap_rows = []
     if overlap:
         overlap_rows = run_overlap_sweep(
@@ -550,17 +614,20 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             iters=max(2, iters // 2), warmup=warmup, print_fn=print_fn,
             recorder=recorder, directions=overlap_directions)
     if json_path:
-        # uniform row schema: overlap fields present on every row so
-        # BENCH_* aggregation (tools/fold_sweeps.py) never key-errors
-        json_rows = [{"op": op, "bytes": int(size), "wire_bytes": int(wire),
-                      "latency_us": lat * 1e6, "algbw_gbps": algbw,
-                      "busbw_gbps": busbw, "bucket_mb": None,
-                      "direction": None,
-                      "overlap_efficiency": None, "exposed_comm_frac": None}
-                     for op, size, wire, lat, algbw, busbw in rows]
+        # uniform row schema (bench_row): overlap/stat fields present on
+        # every row so BENCH_* aggregation (fold_sweeps) never key-errors
+        json_rows = [bench_row(op=op, bytes=int(size),
+                               wire_bytes=int(wire), latency_us=lat * 1e6,
+                               iqr_us=iqr * 1e6, repeat=repeat,
+                               wire_dtype=(WIRE_FORMAT if "quant" in op
+                                           else "fp32"),
+                               algbw_gbps=algbw, busbw_gbps=busbw)
+                     for op, size, wire, lat, algbw, busbw, iqr in rows]
         for c in overlap_rows:
-            json_rows.append(dict(c, latency_us=c["step_ms"] * 1e3,
-                                  algbw_gbps=None, busbw_gbps=None))
+            # overlap candidates time single blocks, not `repeat` medians —
+            # stamping the op sweep's repeat here would let downstream
+            # aggregation weigh them as multi-block medians they are not
+            json_rows.append(bench_row(**c, latency_us=c["step_ms"] * 1e3))
         payload = {
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "axis": axis,
@@ -603,6 +670,10 @@ def cli_main(argv=None):
                     help="log2 of largest message (default 26 = 64MiB)")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="timed blocks per row; reported latency is their "
+                    "MEDIAN and iqr_us their interquartile range (small-"
+                    "message single-shot timings are noise-dominated)")
     ap.add_argument("--intra", type=int, default=0,
                     help="intra-node size for hier_* ops (0 = topology "
                     "auto-detect, falling back to an even split)")
@@ -634,7 +705,8 @@ def cli_main(argv=None):
     default_ops = () if args.overlap else ALL_OPS
     run(ops=(args.op, ) if args.op else default_ops, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
-        iters=args.iters, warmup=args.warmup, intra=args.intra,
+        iters=args.iters, warmup=args.warmup, repeat=args.repeat,
+        intra=args.intra,
         json_path=args.json, trace_dir=args.trace, overlap=args.overlap,
         overlap_total_mb=args.overlap_total_mb,
         overlap_bucket_mbs=(tuple(float(x) for x in

@@ -11,7 +11,8 @@ Layers:
 """
 
 from .config import CommOptimizations
-from .engine import CollectivesEngine, clear_jit_caches
+from .engine import (LADDER_FP, CollectivesEngine, build_wire_ladder,
+                     clear_jit_caches, resolve_in_ladder)
 from .quantized import (DEFAULT_GROUP_SIZE, WIRE_FORMATS,
                         all_to_all_quant_reduce, effective_group_size,
                         hierarchical_quant_reduce_scatter,

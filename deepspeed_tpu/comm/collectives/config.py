@@ -55,6 +55,15 @@ class CommOptimizations:
     quantized_gradients: bool = False
     # wire format for quantized payloads: int8 | int4 | fp8 | fp6 | fp12
     wire_dtype: str = "int8"
+    # per-message-size wire-format ladder (EQuARX: the optimal quantization
+    # varies by message size).  List of [max_bytes, wire] rungs, ascending;
+    # a payload of n logical bytes takes the first rung with n <= max_bytes
+    # (null/None max_bytes = catch-all), sizes above every rung fall back to
+    # the global ``wire_dtype``.  "fp32" as a rung wire means "do not
+    # quantize this size band" (flat path).  None/absent (default) keeps
+    # the global ``wire_dtype`` for every size — bit-identical to the
+    # pre-ladder engine.  Emitted by the autotuner (docs/autotuning.md).
+    wire_dtype_by_size: list = None
     # elements per quantization scale group (lane-aligned down to ≥128)
     quantization_group_size: int = DEFAULT_GROUP_SIZE
     # devices per node for the hierarchy split; 0 = auto-detect from device

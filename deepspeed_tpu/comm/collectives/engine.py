@@ -126,6 +126,69 @@ def clear_jit_caches():
 
 
 # ------------------------------------------------------------------- engine
+#: ladder rung meaning "do not quantize this size band" — flat fp path
+LADDER_FP = "fp32"
+
+
+def build_wire_ladder(raw):
+    """Normalize a ``wire_dtype_by_size`` value into an ascending tuple of
+    ``(max_bytes, wire)`` rungs, or None when absent/empty (= global
+    ``wire_dtype`` everywhere, the pre-ladder behavior).
+
+    Accepts ``[max_bytes, wire]`` pairs or ``{"max_bytes":, "wire_dtype":}``
+    dicts; ``max_bytes`` of null/None is the catch-all rung (at most one,
+    necessarily last).  Rejects unknown wire formats, non-positive or
+    duplicate bounds loudly — a mistyped ladder must never silently tune
+    the wrong band."""
+    if not raw:
+        return None
+    rungs = []
+    for entry in raw:
+        if isinstance(entry, dict):
+            mb, wire = entry.get("max_bytes"), entry.get("wire_dtype")
+        else:
+            if len(entry) != 2:
+                raise ValueError(
+                    f"wire_dtype_by_size entry {entry!r} is not a "
+                    "[max_bytes, wire_dtype] pair")
+            mb, wire = entry
+        if wire != LADDER_FP and wire not in Q.WIRE_FORMATS:
+            raise ValueError(
+                f"wire_dtype_by_size wire {wire!r} unknown "
+                f"(have {LADDER_FP}, {', '.join(Q.WIRE_FORMATS)})")
+        if mb is not None:
+            mb = int(mb)
+            if mb <= 0:
+                raise ValueError(
+                    f"wire_dtype_by_size max_bytes {mb} must be positive "
+                    "(use null for the catch-all rung)")
+        rungs.append((mb, str(wire)))
+    bounded = [r for r in rungs if r[0] is not None]
+    catchall = [r for r in rungs if r[0] is None]
+    if len(catchall) > 1:
+        raise ValueError("wire_dtype_by_size has multiple catch-all "
+                         "(max_bytes: null) rungs")
+    if len({mb for mb, _ in bounded}) != len(bounded):
+        raise ValueError("wire_dtype_by_size has duplicate max_bytes bounds")
+    bounded.sort(key=lambda r: r[0])
+    return tuple(bounded + catchall)
+
+
+def resolve_in_ladder(ladder, nbytes, default):
+    """THE rung walk: first rung admitting ``nbytes`` wins (inclusive
+    bounds, None = catch-all), ``default`` when the ladder is absent or
+    every bounded rung is smaller.  Shared by the eager dispatch
+    (:meth:`CollectivesEngine.resolve_wire_dtype`) and the ZeRO hot paths
+    (``ZeroPartitionPlan.wire_for_size``) so rung semantics can never
+    diverge between them."""
+    if ladder is None:
+        return default
+    for bound, wire in ladder:
+        if bound is None or nbytes <= bound:
+            return wire
+    return default
+
+
 class CollectivesEngine:
     """Per-op variant selection over a duck-typed ``comm_optimizations``
     options object (the pydantic config model or
@@ -138,6 +201,15 @@ class CollectivesEngine:
             raise ValueError(
                 f"comm_optimizations.wire_dtype {fmt!r} unknown "
                 f"(have {', '.join(Q.WIRE_FORMATS)})")
+        self._ladder = build_wire_ladder(
+            getattr(self.opts, "wire_dtype_by_size", None))
+
+    def resolve_wire_dtype(self, nbytes):
+        """Wire format for a payload of ``nbytes`` logical bytes: the first
+        ladder rung that admits it, the global ``wire_dtype`` when the
+        ladder is absent or every bounded rung is smaller.  May return
+        ``"fp32"`` — the caller must fall through to the flat path."""
+        return resolve_in_ladder(self._ladder, nbytes, self.opts.wire_dtype)
 
     @property
     def enabled(self):
@@ -208,7 +280,9 @@ class CollectivesEngine:
         n = group.size()
         if n <= 1 or x.shape[axis] % n != 0:
             return None
-        fmt = o.wire_dtype
+        fmt = self.resolve_wire_dtype(x.size * x.dtype.itemsize)
+        if fmt == LADDER_FP:
+            return None  # ladder says: this size band rides the flat path
         gs = getattr(o, "quantization_group_size", Q.DEFAULT_GROUP_SIZE)
         fn = _jit_quant_all_gather(group.mesh, group.axis_names, axis,
                                    x.ndim, fmt, gs)
@@ -222,7 +296,9 @@ class CollectivesEngine:
         n = group.size()
         if n <= 1 or x.shape[axis] % n != 0:
             return None
-        fmt = o.wire_dtype
+        fmt = self.resolve_wire_dtype(x.size * x.dtype.itemsize)
+        if fmt == LADDER_FP:
+            return None  # ladder says: this size band rides the flat path
         gs = getattr(o, "quantization_group_size", Q.DEFAULT_GROUP_SIZE)
         h = self._hierarchy(group)
         if h is not None:

@@ -70,7 +70,10 @@ def quantized_wire_bytes(n_elements, wire_format, group_size):
     """Actual transported bytes for a quantized payload of ``n_elements``:
     quantized values + one f32 scale per (lane-aligned) group.  This is what
     the comms logger / ds_bench report as wire size — NOT the logical fp
-    tensor size."""
+    tensor size.  ``"fp32"`` (a wire-ladder rung meaning "don't quantize")
+    is the logical size: no scales travel."""
+    if wire_format == "fp32":
+        return int(n_elements) * 4
     gs = effective_group_size(group_size)
     groups = -(-n_elements // gs)
     return int(math.ceil(n_elements * PAYLOAD_BYTES[wire_format])) + groups * 4
@@ -137,7 +140,15 @@ def quantized_all_gather(x, ax_names, dim, wire_format="int8",
     ``ax_names``, reassembling the full dim in axis-index order (matches GSPMD
     tiling order).  The wire payload is quantized values + one f32 scale per
     ``group_size`` elements (reference qwZ, csrc/quantization/quantize.cu;
-    fp formats via csrc/fp_quantizer analog)."""
+    fp formats via csrc/fp_quantizer analog).
+
+    ``wire_format="fp32"`` (the wire ladder's "don't quantize this size
+    band" rung, docs/autotuning.md) keeps the identical gather schedule
+    with the raw fp payload — bit-exact, so a per-size ladder can route
+    latency-bound leaves flat without changing placement semantics."""
+    if wire_format == "fp32":
+        parts = jax.lax.all_gather(x, ax_names)
+        return jnp.concatenate(list(parts), axis=dim)
     quant, dequant = wire_codec(wire_format, group_size)
     q, s, meta = quant(x)
     qg = jax.lax.all_gather(q, ax_names)
@@ -175,8 +186,18 @@ def all_to_all_quant_reduce(g, ax_names, dim, n, num_bits=8,
     Returns this rank's partition — the mean over ranks by default, the sum
     with ``mean=False`` (reference ``all_to_all_quant_reduce``,
     runtime/comm/coalesced_collectives.py:31 — single-hop on ICI, see
-    ``runtime/zero/zeropp.py`` module docstring)."""
+    ``runtime/zero/zeropp.py`` module docstring).
+
+    ``wire_format="fp32"`` (the wire ladder's "don't quantize this size
+    band" rung) keeps the identical split/all-to-all/sum schedule and
+    output placement with the raw fp payload — no codec, no grid error."""
     fmt = wire_format or ("int8" if num_bits == 8 else "int4")
+    if fmt == "fp32":
+        chunks = jnp.stack(jnp.split(g, n, axis=dim))
+        parts = jax.lax.all_to_all(chunks, ax_names, split_axis=0,
+                                   concat_axis=0)
+        out = jnp.sum(parts.astype(jnp.float32), axis=0)
+        return out / n if mean else out
     quant, dequant = wire_codec(fmt, group_size)
     chunks = jnp.stack(jnp.split(g, n, axis=dim))  # [n, ...chunk]
     _, _, meta = quant(chunks[0])

@@ -142,6 +142,14 @@ class CommOptimizationsConfig(DeepSpeedConfigModel):
     quantized_gradients: bool = False
     # wire format: int8 | int4 | fp8 | fp6 | fp12
     wire_dtype: str = "int8"
+    # per-message-size wire-format ladder: ascending [max_bytes, wire]
+    # rungs ([null, wire] = catch-all, "fp32" = keep that band flat); sizes
+    # above every rung use the global wire_dtype.  None (default) = global
+    # wire_dtype everywhere, bit-identical to the pre-ladder engine.
+    # Typically emitted by the autotuner (docs/autotuning.md) from measured
+    # per-size probes — the EQuARX lesson that optimal quantization varies
+    # by message size.
+    wire_dtype_by_size: Optional[list] = None
     # elements per quantization scale group (lane-aligned down, min 128)
     quantization_group_size: int = Field(2048, ge=128)
     # devices per node for the hierarchy split; 0 = auto-detect from device
@@ -481,12 +489,21 @@ class DeepSpeedConfig:
                                         and {"comms_logger": pd.get("comms_logger")})
         self.comm_optimizations_config = CommOptimizationsConfig(
             **pd.get("comm_optimizations", {}) or {})
-        from ..comm.collectives import WIRE_FORMATS
+        from ..comm.collectives import WIRE_FORMATS, build_wire_ladder
         if self.comm_optimizations_config.wire_dtype not in WIRE_FORMATS:
             raise DeepSpeedConfigError(
                 f"comm_optimizations.wire_dtype "
                 f"{self.comm_optimizations_config.wire_dtype!r} unknown "
                 f"(have {', '.join(WIRE_FORMATS)})")
+        try:
+            # normalize/validate the per-size ladder at config load, not at
+            # first dispatch — a mistyped rung must fail bring-up loudly
+            build_wire_ladder(
+                self.comm_optimizations_config.wire_dtype_by_size)
+        except ValueError as e:
+            raise DeepSpeedConfigError(
+                f"comm_optimizations.wire_dtype_by_size invalid: {e}") \
+                from e
         # reference-compat: ``zero_optimization.overlap_comm: true`` (the
         # DeepSpeed knob for overlapping gradient reduction with backward)
         # arms the bucketed overlap scheduler unless the user pinned the
@@ -547,6 +564,25 @@ class DeepSpeedConfig:
             **pd.get("resilience", {}) or {})
         self.telemetry_config = TelemetryConfig(
             **pd.get("telemetry", {}) or {})
+        # "autotuning" block: validated strictly here (unknown keys fail
+        # bring-up loudly — autotuning/config.py forbids extras) so a
+        # mistyped search knob never silently tunes the default space.
+        # enabled: false (default) changes nothing; enabled: true is a
+        # declaration consumed by ``autotuning.run_autotuning`` — the
+        # engine itself never starts a search mid-initialize.
+        from ..autotuning.config import AutotuningConfig
+        try:
+            self.autotuning_config = AutotuningConfig(
+                **pd.get("autotuning", {}) or {})
+        except Exception as e:
+            raise DeepSpeedConfigError(f"autotuning config invalid: {e}") \
+                from e
+        if self.autotuning_config.enabled:
+            logger.info(
+                "autotuning.enabled: run the search via "
+                "deepspeed_tpu.autotuning.run_autotuning(...) (or "
+                "tools/autotune_smoke.py); initialize() itself does not "
+                "start trials")
 
         self.gradient_accumulation_dtype = self.data_types_config.grad_accum_dtype
 

@@ -311,6 +311,26 @@ class ZeroPartitionPlan:
         return self._co_wire("quantized_weights") or \
             (fallback_format, DEFAULT_GROUP_SIZE)
 
+    def wire_for_size(self, default_fmt, nbytes):
+        """Per-leaf wire format through the ``wire_dtype_by_size`` ladder
+        (docs/autotuning.md): the first rung admitting ``nbytes`` logical
+        bytes wins — ``"fp32"`` means this leaf rides the unquantized
+        schedule — and ``default_fmt`` covers no-ladder configs and sizes
+        above every rung.  This is the ZeRO-hot-path twin of
+        ``CollectivesEngine.resolve_wire_dtype``: the same ladder the
+        eager dispatch honors steers the qgZ/qwZ micro-step leaves, so an
+        autotuned per-size choice is applied where the training traffic
+        actually flows."""
+        co = self.comm_opts
+        if co is None or not getattr(co, "enabled", False):
+            return default_fmt
+        from ...comm.collectives.engine import (build_wire_ladder,
+                                                resolve_in_ladder)
+        if not hasattr(self, "_wire_ladder"):
+            self._wire_ladder = build_wire_ladder(
+                getattr(co, "wire_dtype_by_size", None))
+        return resolve_in_ladder(self._wire_ladder, nbytes, default_fmt)
+
     def hierarchical_reduce(self):
         """True when comm_optimizations asks gradient reduction to run the
         2-hop (intra fp → inter quantized) scheme where the ZeRO group spans

@@ -100,10 +100,15 @@ def quantized_weight_gather(params, plan, wire_format="int8",
         if dim is None:
             return x
         out_spec = _gathered_spec(spec, plan.param_axes)
+        # per-leaf wire through the autotuned size ladder — x is the
+        # GLOBAL array in GSPMD mode, so x.size is the logical (gathered)
+        # message size the probes/dispatch key on; "fp32" rungs take the
+        # plain gather inside the same straight-through wrapper
+        fmt = plan.wire_for_size(wire_format,
+                                 x.size * x.dtype.itemsize)
         # positional call: custom_vjp rejects kwargs for nondiff argnums
         fn = shard_map(
-            lambda t: qdq_all_gather_st(t, axes, dim, wire_format,
-                                        group_size),
+            lambda t: qdq_all_gather_st(t, axes, dim, fmt, group_size),
             mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
         return fn(x)
 
@@ -189,6 +194,13 @@ def build_manual_dp_micro(engine):
                                                   False))
     qw_fmt, qw_gs = plan.param_wire(zc.zero_quantized_weights_format)
     qg_fmt, qg_gs = plan.grad_wire()
+
+    def _grad_leaf_fmt(g):
+        # per-leaf wire through the autotuned size ladder; inside the
+        # manual body g carries the FULL gradient shape (each rank reduces
+        # its whole-gradient copy), so g.size is the logical message size
+        # — the same quantity the eager dispatch and the probes key on
+        return plan.wire_for_size(qg_fmt, g.size * g.dtype.itemsize)
     hier = plan.hierarchical_reduce()
     # bucketed overlap scheduler: pipeline the quantized inter-node hop of
     # bucket k with the intra-node work of bucket k+1 (docs/overlap.md)
@@ -307,6 +319,13 @@ def build_manual_dp_micro(engine):
             from .overlap import (bucket_bytes_of, pipelined_bucket_reduce,
                                   tree_buckets)
             buckets, _, _ = tree_buckets(grads, bucket_bytes_of(ov))
+            # ladder formats key on the FULL leaf size stage1 sees, not the
+            # intra-scattered piece stage2 receives for hier leaves
+            from .partition import path_str as _ps
+            fmt_by_path = {
+                _ps(kp): _grad_leaf_fmt(g)
+                for kp, g in
+                jax.tree_util.tree_flatten_with_path(grads)[0]}
 
             def stage1(path, g):
                 info = _leaf_hier(reduce_specs[path])
@@ -325,6 +344,7 @@ def build_manual_dp_micro(engine):
                 dim, axes = _zero_dim(spec, dp_axes)
                 if dim is None:
                     return jax.lax.pmean(h, dp_axes).astype(grad_dtype)
+                fmt = fmt_by_path[path]
                 info = _leaf_hier(spec)
                 if info is not None:
                     _, outer, inner = info
@@ -335,7 +355,7 @@ def build_manual_dp_micro(engine):
                     for a in inner:
                         n_in *= mesh.shape[a]
                     out = all_to_all_quant_reduce(h, outer, dim, n_out,
-                                                  wire_format=qg_fmt,
+                                                  wire_format=fmt,
                                                   group_size=qg_gs,
                                                   mean=False)
                     out = out / (n_in * n_out)
@@ -344,7 +364,7 @@ def build_manual_dp_micro(engine):
                     for a in axes:
                         n *= mesh.shape[a]
                     out = all_to_all_quant_reduce(h, axes, dim, n,
-                                                  wire_format=qg_fmt,
+                                                  wire_format=fmt,
                                                   group_size=qg_gs)
                 rest = tuple(a for a in dp_axes if a not in axes)
                 if rest:
@@ -363,7 +383,14 @@ def build_manual_dp_micro(engine):
                 if dim is None:
                     return x
                 if qw:
-                    return quantized_all_gather(x, axes, dim, qw_fmt, qw_gs)
+                    # per-leaf ladder keys on the GATHERED (logical) size —
+                    # x here is this rank's 1/n shard
+                    n_g = 1
+                    for a in axes:
+                        n_g *= mesh.shape[a]
+                    fmt = plan.wire_for_size(
+                        qw_fmt, x.size * n_g * x.dtype.itemsize)
+                    return quantized_all_gather(x, axes, dim, fmt, qw_gs)
                 return jax.lax.all_gather(x, axes, axis=dim, tiled=True)
 
             if pf_buckets:
@@ -386,6 +413,7 @@ def build_manual_dp_micro(engine):
                 dim, axes = _zero_dim(spec, dp_axes)
                 if dim is None:
                     return jax.lax.pmean(g, dp_axes).astype(grad_dtype)
+                fmt = _grad_leaf_fmt(g)
                 info = _leaf_hier(spec)
                 if info is not None:
                     _, outer, inner = info
@@ -397,13 +425,13 @@ def build_manual_dp_micro(engine):
                         n_in *= mesh.shape[a]
                     out = hierarchical_quant_reduce_scatter(
                         g, inner, outer, dim, n_in, n_out,
-                        wire_format=qg_fmt, group_size=qg_gs)
+                        wire_format=fmt, group_size=qg_gs)
                 else:
                     n = 1
                     for a in axes:
                         n *= mesh.shape[a]
                     out = all_to_all_quant_reduce(g, axes, dim, n,
-                                                  wire_format=qg_fmt,
+                                                  wire_format=fmt,
                                                   group_size=qg_gs)
                 # average over any remaining dp axes not in this dim
                 rest = tuple(a for a in dp_axes if a not in axes)

@@ -1,0 +1,82 @@
+"""tools/autotune_smoke.py — the ISSUE-12 tier-1 gate, driven in-process
+(bench-gate convention: loaded via importlib, no subprocess)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "..", "tools")
+
+
+def _load_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "autotune_smoke", os.path.join(TOOLS, "autotune_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("with_priors", (False, True))
+def test_autotune_smoke_gate(tmp_path, with_priors):
+    """End-to-end acceptance: probe → budgeted search → autotuned config's
+    measured step time ≤ the hand-written default's, chosen config passes
+    the comm_smoke loss-parity gate, and the emit-stage artifacts land
+    with the round-tripped block."""
+    smoke = _load_smoke()
+    priors_file = ""
+    if with_priors:
+        # a priors file seeds the search without changing the verdict
+        priors_file = str(tmp_path / "priors.json")
+        with open(priors_file, "w") as f:
+            json.dump({"schema": "ds_tpu_autotune_priors/1",
+                       "generated_from": [],
+                       "overlap": [{"direction": "reduce",
+                                    "bucket_mb": 0.0005,
+                                    "wire_dtype": "int8",
+                                    "overlap_efficiency": 0.9,
+                                    "exposed_comm_frac": 0.05,
+                                    "runs": 2}]}, f)
+    results = tmp_path / "results"
+    r = smoke.run_autotune_smoke(trials=8, results_dir=str(results),
+                                 priors_file=priors_file)
+    assert r["pass"], r
+    assert r["beats_default"] and r["best_step_ms"] <= r["default_step_ms"]
+    assert r["parity_delta"] <= r["tolerance"] and r["converged"]
+    # emit-stage artifacts: trials in the uniform ds_bench row schema,
+    # probes + topology, and the ready-to-paste round-tripped block
+    trials = json.loads((results / "trials.json").read_text())
+    assert trials["metric"] == "step_time"
+    for row in trials["rows"]:
+        assert {"op", "latency_us", "iqr_us", "repeat", "wire_dtype",
+                "bucket_mb", "direction", "exposed_comm_frac"} <= set(row)
+        assert row["op"] == "trial"
+    probes = json.loads((results / "probes.json").read_text())
+    assert probes["rows"] and "reduce_scatter" in probes["wire_ladders"]
+    topo = json.loads((results / "topology.json").read_text())
+    assert topo["world"] == 8
+    block = json.loads((results / "tuned_block.json").read_text())
+    # the emitted block is itself a loadable engine config
+    import deepspeed_tpu
+    cfg = deepspeed_tpu.DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1, **block})
+    assert cfg is not None
+
+
+def test_ladder_row_record_schema(tmp_path, monkeypatch):
+    """The bench-ladder record rides the bench schema and marks CPU runs
+    untrusted (same gate update_ladder/fold_sweeps apply everywhere)."""
+    smoke = _load_smoke()
+    monkeypatch.setattr(smoke, "REPO", str(tmp_path))
+    rec = smoke._record_ladder_row({
+        "best_name": "z2_ladder", "best_step_ms": 4.0,
+        "default_step_ms": 5.0, "trials": 6})
+    assert rec["metric"] == "autotune_step_time_ms"
+    assert rec["vs_baseline"] == 1.25
+    assert "backend=cpu" in rec["unit"]        # CPU leg marks itself
+    on_disk = json.loads(
+        (tmp_path / ".bench_runs" / "autotune.json").read_text())
+    assert on_disk == rec
+    from deepspeed_tpu.autotuning.priors import untrustworthy
+    assert untrustworthy(rec) is not None      # refused by the trust gate

@@ -269,3 +269,103 @@ def test_comms_logger_reports_wire_bytes_and_variant():
     assert wire < msg_size
     dist.log_summary()  # renders with the wire column without raising
     comms_logger.comms_dict = {}
+
+
+# ------------------------------------------- per-size wire-dtype ladder
+def test_wire_ladder_boundary_sizes_route_to_right_codec():
+    """ISSUE-12: a wire_dtype_by_size ladder routes each message to the
+    rung admitting it — boundary sizes inclusive, above-all-rungs falls
+    back to the global wire_dtype (no catch-all case)."""
+    dist.init_distributed()
+    g = dist.new_group(("dp", ))
+    eng = CollectivesEngine(CommOptimizations(
+        enabled=True, quantized_weights=True, quantized_gradients=True,
+        hierarchical_allreduce=False, quantization_group_size=128,
+        wire_dtype="int8",
+        wire_dtype_by_size=[[8192, "fp8"], [None, "int4"]]))
+    # 64×32 fp32 = exactly 8192 bytes → first rung (boundary inclusive)
+    x_small = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    _, variant, _ = eng.dispatch("all_gather", x_small, g)
+    assert variant == "q_fp8"
+    # 128×32 fp32 = 16384 bytes → catch-all rung
+    x_big = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
+    _, variant, _ = eng.dispatch("all_gather", x_big, g)
+    assert variant == "q_int4"
+    # reduce_scatter resolves through the same ladder
+    _, variant, _ = eng.dispatch("reduce_scatter", x_big.reshape(-1), g)
+    assert variant == "q_int4"
+    # bounded-rungs-only ladder: sizes above every rung → global wire
+    eng2 = CollectivesEngine(CommOptimizations(
+        enabled=True, quantized_weights=True, hierarchical_allreduce=False,
+        quantization_group_size=128, wire_dtype="int8",
+        wire_dtype_by_size=[[8192, "fp8"]]))
+    _, variant, _ = eng2.dispatch("all_gather", x_big, g)
+    assert variant == "q_int8"
+
+
+def test_wire_ladder_fp32_rung_stays_flat():
+    """An "fp32" rung means "do not quantize this band": dispatch declines
+    and the facade takes the flat path — bit-exact for those sizes."""
+    dist.init_distributed()
+    g = dist.new_group(("dp", ))
+    eng = CollectivesEngine(CommOptimizations(
+        enabled=True, quantized_weights=True, hierarchical_allreduce=False,
+        quantization_group_size=128,
+        wire_dtype_by_size=[[8192, "fp32"], [None, "int8"]]))
+    x_small = jax.random.normal(jax.random.PRNGKey(2), (64, 32))
+    assert eng.dispatch("all_gather", x_small, g) is None
+    dist.set_collectives_engine(eng)
+    out = dist.all_gather(x_small)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x_small))
+    dist.set_collectives_engine(None)
+
+
+def test_wire_ladder_absent_is_global_wire():
+    """No ladder (default) resolves every size to the global wire_dtype —
+    the pre-ladder engine behavior, bit-identical by code path."""
+    eng = CollectivesEngine(CommOptimizations(
+        enabled=True, quantized_weights=True, wire_dtype="fp8"))
+    assert eng._ladder is None
+    for nbytes in (1, 8192, 1 << 30):
+        assert eng.resolve_wire_dtype(nbytes) == "fp8"
+
+
+def test_wire_ladder_validation():
+    from deepspeed_tpu.comm.collectives import build_wire_ladder
+    assert build_wire_ladder(None) is None
+    assert build_wire_ladder([]) is None
+    # unsorted input is normalized ascending, catch-all last
+    assert build_wire_ladder([[None, "int8"], [4096, "fp32"]]) == \
+        ((4096, "fp32"), (None, "int8"))
+    # dict rungs accepted (JSON-friendly alternative)
+    assert build_wire_ladder(
+        [{"max_bytes": 4096, "wire_dtype": "fp8"}]) == ((4096, "fp8"), )
+    with pytest.raises(ValueError, match="unknown"):
+        build_wire_ladder([[4096, "int7"]])
+    with pytest.raises(ValueError, match="duplicate"):
+        build_wire_ladder([[4096, "fp8"], [4096, "int8"]])
+    with pytest.raises(ValueError, match="catch-all"):
+        build_wire_ladder([[None, "fp8"], [None, "int8"]])
+    with pytest.raises(ValueError, match="positive"):
+        build_wire_ladder([[0, "fp8"]])
+    with pytest.raises(ValueError, match="pair"):
+        build_wire_ladder([[4096]])
+
+
+def test_config_rejects_bad_wire_ladder():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    with pytest.raises(DeepSpeedConfigError, match="wire_dtype_by_size"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "comm_optimizations": {
+                             "wire_dtype_by_size": [[4096, "bf7"]]}})
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "comm_optimizations": {
+                               "enabled": True, "quantized_weights": True,
+                               "wire_dtype_by_size": [[4096, "fp8"],
+                                                      [None, "int8"]]}})
+    dist.init_distributed(config=cfg)
+    eng = dist.get_collectives_engine()
+    assert eng is not None and eng.resolve_wire_dtype(4096) == "fp8"
+    assert eng.resolve_wire_dtype(4097) == "int8"
+    dist.set_collectives_engine(None)

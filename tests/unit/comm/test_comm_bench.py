@@ -11,9 +11,10 @@ def test_sweep_all_ops():
     rows = run(axis="dp", minsize=12, maxsize=12, iters=2, warmup=1,
                print_fn=lambda *a: None)
     assert len(rows) == len(ALL_OPS)  # one size, every op incl. engine ops
-    for op, size, wire, lat, algbw, busbw in rows:
+    for op, size, wire, lat, algbw, busbw, iqr in rows:
         assert size >= 4096 and wire > 0 and lat > 0 and algbw > 0 \
             and busbw > 0
+        assert iqr >= 0  # repeat>1 default: IQR measured, non-negative
 
 
 def test_quantized_ops_report_reduced_wire_bytes():
@@ -38,14 +39,38 @@ def test_json_output(tmp_path):
     import json
     out = tmp_path / "bench.json"
     run(ops=("all_reduce", "quant_reduce_scatter"), axis="dp", minsize=12,
-        maxsize=12, iters=1, warmup=1, print_fn=lambda *a: None,
+        maxsize=12, iters=1, warmup=1, repeat=2, print_fn=lambda *a: None,
         json_path=str(out))
     payload = json.loads(out.read_text())
     assert payload["axis"] == "dp" and payload["mesh"]["dp"] == 8
     assert len(payload["rows"]) == 2
     for row in payload["rows"]:
+        # uniform schema incl. the repeat/median/IQR stats fields
         assert set(row) >= {"op", "bytes", "wire_bytes", "latency_us",
-                            "algbw_gbps", "busbw_gbps"}
+                            "algbw_gbps", "busbw_gbps", "iqr_us", "repeat",
+                            "wire_dtype"}
+        assert row["repeat"] == 2 and row["iqr_us"] >= 0
+    by_op = {r["op"]: r for r in payload["rows"]}
+    assert by_op["all_reduce"]["wire_dtype"] == "fp32"
+    assert by_op["quant_reduce_scatter"]["wire_dtype"] == "int8"
+
+
+def test_probe_op_single_row_schema():
+    """The in-process probe API the autotuner's probe stage rides: one
+    uniform-schema row per call, wire format selectable per probe."""
+    from deepspeed_tpu.benchmarks.comm_bench import probe_op
+    flat = probe_op("reduce_scatter", 1 << 12, iters=1, warmup=0, repeat=2)
+    q = probe_op("quant_reduce_scatter", 1 << 12, iters=1, warmup=0,
+                 repeat=2, wire="fp8", group_size=128)
+    for row in (flat, q):
+        assert {"op", "bytes", "wire_bytes", "latency_us", "iqr_us",
+                "repeat", "wire_dtype", "algbw_gbps", "busbw_gbps",
+                "bucket_mb", "direction", "overlap_efficiency",
+                "exposed_comm_frac"} <= set(row)
+        assert row["latency_us"] > 0 and row["repeat"] == 2
+    assert flat["wire_dtype"] == "fp32"
+    assert q["wire_dtype"] == "fp8"
+    assert q["wire_bytes"] < flat["wire_bytes"]  # fp8 payload + scales
 
 
 def test_overlap_sweep_rows_and_schema(tmp_path):

@@ -2,7 +2,13 @@
 """Summarize on-chip runs: ladder legs + sweeps, ranked, with suggested
 default folds.  Run after tools/bench_retry.sh has chained the sweeps.
 
-Usage: python tools/fold_sweeps.py
+Usage: python tools/fold_sweeps.py [--priors OUT.json]
+
+``--priors OUT.json`` additionally exports the aggregated (direction,
+bucket_mb, wire_dtype) overlap-sweep bests as an autotuner priors file —
+``deepspeed_tpu.autotuning`` (``autotuning.priors_file`` config or
+``tools/autotune_smoke.py --priors``) ingests it to seed the search with
+measured ground truth.
 """
 
 import glob
@@ -113,10 +119,40 @@ def aggregate_serve(paths):
     return out
 
 
-def main():
+# keep in sync with deepspeed_tpu/autotuning/priors.py:PRIORS_SCHEMA (a
+# unit test asserts they match; duplicated so this summarizer stays
+# importable without pulling jax via the package __init__)
+PRIORS_SCHEMA = "ds_tpu_autotune_priors/1"
+
+
+def export_priors(paths, out_path):
+    """Write the aggregated overlap bests as an autotuner priors file.
+    Returns the payload (empty ``overlap`` list when no archive carries
+    overlap rows — still a valid, ingestible file)."""
+    payload = {
+        "schema": PRIORS_SCHEMA,
+        "generated_from": [os.path.basename(p) for p in paths],
+        "overlap": aggregate_overlap(paths),
+    }
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(f"wrote {len(payload['overlap'])} overlap priors to {out_path}")
+    return payload
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    priors_out = None
+    if "--priors" in argv:
+        i = argv.index("--priors")
+        if i + 1 >= len(argv):
+            raise SystemExit("--priors needs an output path")
+        priors_out = argv[i + 1]
     runs = os.path.join(ROOT, ".bench_runs")
     paths = sorted(glob.glob(os.path.join(runs, "*.json")) +
                    glob.glob(os.path.join(runs, "sweeps", "*.json")))
+    if priors_out:
+        export_priors(paths, priors_out)
     rows = []
     for path in paths:
         rec = _load(path)

@@ -15,7 +15,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 import bench  # noqa: E402  (no jax at module level)
 
-MODES = ("bert", "gpt2", "hostopt", "offload", "fpdt", "serve")
+MODES = ("bert", "gpt2", "hostopt", "offload", "fpdt", "serve", "autotune")
 
 
 def main():
