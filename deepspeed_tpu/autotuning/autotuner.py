@@ -289,6 +289,28 @@ class Autotuner:
                 ds["comm_optimizations"] = json.loads(json.dumps(user_co))
                 stage_exps.insert(1, {"name": f"z{stage}_user",
                                       "ds_config": ds, "pinned": True})
+            moe_user = self.base_config.get("moe") or {}
+            if moe_user.get("enabled"):
+                # MoE dispatch-wire candidates: expert dispatch is the
+                # hardest collective in the stack — when the model runs
+                # MoE, sweep the quantized-dispatch wire next to the comm
+                # blocks (docs/moe.md).  The user's own moe block rides
+                # every other candidate unchanged; these vary ONLY the
+                # dispatch wire — and the wire the base config ALREADY
+                # runs is skipped (a byte-identical duplicate would burn
+                # one measured trial per stage under a budget).
+                base_wire = (moe_user.get("wire_dtype", "int8")
+                             if moe_user.get("quantized_dispatch")
+                             else None)
+                for w in list(self.cfg.probe_wires) + ["fp32"]:
+                    if w == base_wire:
+                        continue
+                    ds = self._base_trial_config()
+                    ds.setdefault("zero_optimization", {})["stage"] = stage
+                    ds["moe"] = dict(json.loads(json.dumps(moe_user)),
+                                     quantized_dispatch=True, wire_dtype=w)
+                    stage_exps.append({"name": f"z{stage}_moed_{w}",
+                                       "ds_config": ds})
             exps.extend(stage_exps)
         if not exps:
             raise AutotuningError("comm tuning space is empty — check "
@@ -327,6 +349,12 @@ class Autotuner:
         # trials are hermetic: the surrounding session's accumulated comm
         # stats come back after the trial, not an empty table
         prev_dict = comms_logger.comms_dict
+        # ... and so does the MoE dispatcher: each trial engine's bring-up
+        # reconfigures the module-global dispatch options (incl. the
+        # z*_moed_* wire candidates) — the LAST trial's choice must not
+        # silently steer the session's expert dispatch afterwards
+        from ..moe import engine as _moe_engine
+        prev_moe = _moe_engine.snapshot()
         try:
             with _telemetry.span(f"autotune/trial/{exp['name']}",
                                  cat="autotune"):
@@ -393,6 +421,7 @@ class Autotuner:
             (comms_logger.enabled, comms_logger.prof_all,
              comms_logger.sync_timing) = prev_log
             comms_logger.comms_dict = prev_dict
+            _moe_engine.restore(prev_moe)
             groups.reset_mesh()
             deepspeed_tpu.comm.destroy_process_group()
         self.results.append({"name": exp["name"], "result": result,

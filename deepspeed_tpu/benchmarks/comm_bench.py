@@ -537,6 +537,178 @@ def run_overlap_sweep(axis="dp", mesh=None, bucket_mbs=OVERLAP_BUCKET_MBS,
     return out
 
 
+# ---------------------------------------------------------------- moe sweep
+# Expert-dispatch candidates (E × capacity_factor × wire dtype): how much
+# does the quantized/hierarchical a2a exchange save over the GSPMD
+# constraint reshard for the hardest collective in the stack?  Feeds
+# ``moe.wire_dtype`` the way the op sweep feeds ``wire_dtype`` (docs/moe.md).
+
+MOE_EXPERTS = (8, 16)
+MOE_CAPACITY_FACTORS = (1.0, 2.0)
+MOE_WIRES = ("fp32", "int8")
+MOE_TOKENS = 4096
+MOE_HIDDEN = 256
+
+
+def _moe_candidate(mesh, experts, capacity_factor, wire, tokens, hidden,
+                   iters, warmup, repeat):
+    """Measure one (E, capacity_factor, wire) expert-dispatch candidate:
+    the full dispatch → (trivial) expert → combine round trip, GSPMD
+    constraint path for wire None vs the manual exchange at ``wire``."""
+    import jax
+    import jax.numpy as jnp
+    from ..moe import engine as moe_engine
+    from ..moe.engine import MoeOptions, expert_dispatch_wire_bytes
+    from ..moe.sharded_moe import top1gating
+
+    ep = mesh.shape.get("ep", 1)
+    E = experts - experts % ep if experts % ep else experts
+    if E < ep:
+        # experts < ep rounds to 0 and the gate's capacity math divides by
+        # E — skip with guidance instead of a cryptic ZeroDivisionError
+        raise UnsplittableAxis(
+            f"experts={experts} cannot shard over ep={ep} (need >= ep, "
+            "divisible) — raise --moe-experts or shrink the ep axis")
+    rngk = jax.random.PRNGKey(0)
+    x = jax.random.normal(rngk, (tokens, hidden), jnp.float32)
+    logits = jax.random.normal(jax.random.fold_in(rngk, 1), (tokens, E),
+                               jnp.float32)
+    l_aux, combine, dispatch, counts = top1gating(
+        logits, capacity_factor=capacity_factor)
+    C = combine.shape[-1]
+    kept = float(jnp.sum(dispatch.astype(jnp.float32)))
+    drop_fraction = 1.0 - kept / tokens
+    mean_c = max(1e-9, kept / E)
+    imbalance = float(jnp.max(counts.astype(jnp.float32))) / mean_c
+    expert_fn = lambda d: d * 1.0009765625  # trivial: comm-dominant
+
+    # snapshot the FULL dispatcher state (options + comm view): a live
+    # engine may have installed a wire ladder this sweep must hand back
+    prev = moe_engine.snapshot()
+    opts = None if wire is None else MoeOptions(
+        enabled=True, quantized_dispatch=True, wire_dtype=wire,
+        quantization_group_size=GROUP_SIZE)
+    moe_engine.configure(opts)
+    payload = E * C * hidden
+    try:
+        if opts is not None:
+            # report what the timed exchange ACTUALLY moves: the same
+            # resolution the dispatcher uses (ladder rung + hierarchy —
+            # the 2-hop variant crosses the bottleneck link with 1/n_inner
+            # of the data)
+            _, _, _, wire_bytes = moe_engine.resolve_exchange(
+                mesh, opts, "ep", payload)
+        else:
+            wire_bytes = expert_dispatch_wire_bytes(payload, "fp32",
+                                                    GROUP_SIZE)
+        fn = jax.jit(lambda t, cm, dm: moe_engine.dispatch_combine(
+            t, cm, dm, expert_fn, mesh=mesh))
+        lat, iqr = _timed_stats(fn, (x, combine, dispatch), iters, warmup,
+                                repeat=repeat)
+    finally:
+        moe_engine.restore(prev)
+    return bench_row(
+        op="moe_dispatch", direction="moe",
+        wire_dtype=(wire if wire is not None else "gspmd"),
+        bytes=int(payload * 4), wire_bytes=int(wire_bytes),
+        latency_us=lat * 1e6, iqr_us=iqr * 1e6, repeat=int(repeat),
+        experts=int(E), capacity_factor=float(capacity_factor),
+        capacity=int(C), tokens=int(tokens),
+        drop_fraction=float(drop_fraction),
+        load_imbalance=float(imbalance),
+        aux_loss=float(l_aux))
+
+
+def run_moe_sweep(mesh=None, experts=MOE_EXPERTS,
+                  capacity_factors=MOE_CAPACITY_FACTORS, wires=MOE_WIRES,
+                  tokens=MOE_TOKENS, hidden=MOE_HIDDEN, iters=10, warmup=2,
+                  repeat=3, print_fn=print, recorder=None):
+    """E × capacity_factor × wire sweep of the expert-dispatch exchange.
+    Every candidate also runs the GSPMD constraint baseline once per (E,
+    cf) so the manual variants have an in-row comparison.  Returns uniform
+    ``bench_row`` dicts tagged ``direction: "moe"``."""
+    from ..utils import groups
+    if mesh is None:
+        mesh = groups.get_mesh_state().mesh
+    if mesh.shape.get("ep", 1) < 2:
+        raise SystemExit(
+            f"moe sweep needs an expert-parallel mesh (ep >= 2), got "
+            f"{dict(mesh.shape)} — pass e.g. --mesh dp=2,ep=4")
+    print_fn(f"# moe dispatch sweep: mesh={dict(mesh.shape)} "
+             f"tokens={tokens} hidden={hidden}")
+    print_fn(f"{'experts':>8}{'cf':>6}{'wire':>8}{'capacity':>10}"
+             f"{'drop_frac':>11}{'imbalance':>11}{'wire_bytes':>12}"
+             f"{'latency_us':>12}{'iqr_us':>9}")
+    rows = []
+    ep = mesh.shape.get("ep", 1)
+    for E in experts:
+        if E - E % ep < ep:
+            # experts < ep rounds to an empty expert stack — skip the whole
+            # E loudly instead of dying in the gate's capacity division
+            print_fn(f"# E={E}: skipped (cannot shard over ep={ep}; "
+                     "raise --moe-experts or shrink the ep axis)")
+            continue
+        if E % ep:
+            # no silent caps: the rounded-down count is what actually runs
+            # (and what the emitted rows carry as `experts`)
+            print_fn(f"# E={E}: rounded down to {E - E % ep} "
+                     f"(must divide ep={ep})")
+        for cf in capacity_factors:
+            for wire in (None, ) + tuple(wires):
+                span = (recorder.span(
+                    f"moe_dispatch/{E}x{cf:g}/{wire or 'gspmd'}",
+                    cat="bench") if recorder is not None else None)
+                if span is not None:
+                    with span:
+                        c = _moe_candidate(mesh, E, cf, wire, tokens,
+                                           hidden, iters, warmup, repeat)
+                else:
+                    c = _moe_candidate(mesh, E, cf, wire, tokens, hidden,
+                                       iters, warmup, repeat)
+                rows.append(c)
+                if recorder is not None and wire is not None:
+                    recorder.comm_event(
+                        "all_to_all", f"moe_q_{wire}", c["bytes"],
+                        c["wire_bytes"], c["latency_us"] / 1e6,
+                        world_size=mesh.shape.get("ep", 1))
+                print_fn(f"{c['experts']:>8}{c['capacity_factor']:>6g}"
+                         f"{c['wire_dtype']:>8}{c['capacity']:>10}"
+                         f"{c['drop_fraction']:>11.3f}"
+                         f"{c['load_imbalance']:>11.2f}"
+                         f"{c['wire_bytes']:>12}"
+                         f"{c['latency_us']:>12.1f}{c['iqr_us']:>9.1f}")
+    best = best_moe_candidate(rows)
+    if best is not None:
+        r, speedup = best
+        print_fn(f"# best manual dispatch: wire={r['wire_dtype']} "
+                 f"E={r['experts']} cf={r['capacity_factor']:g} "
+                 f"({speedup:.2f}x vs gspmd)")
+    return rows
+
+
+def best_moe_candidate(rows):
+    """(row, speedup) of the manual-dispatch wire with the best PER-CELL
+    speedup over its own (E, capacity_factor) gspmd baseline, or None when
+    no manual wire beats its baseline — raw cross-cell latency would let
+    the smallest-payload cell decide (same rule as
+    ``fold_sweeps.aggregate_moe``'s suggestion)."""
+    baselines = {(r.get("experts"), r.get("capacity_factor")):
+                 r.get("latency_us")
+                 for r in rows if r.get("wire_dtype") == "gspmd"}
+    best, best_speedup = None, 1.0
+    for r in rows:
+        if r.get("wire_dtype") in ("gspmd", None):
+            continue
+        base = baselines.get((r.get("experts"), r.get("capacity_factor")))
+        lat = r.get("latency_us")
+        if not base or not lat:
+            continue
+        speedup = base / lat
+        if speedup > best_speedup:
+            best, best_speedup = r, speedup
+    return None if best is None else (best, best_speedup)
+
+
 # engine-variant op → (facade op, comms-logging variant tag) so traced
 # sweeps use the same ``op[variant]`` vocabulary as training traces
 _TRACE_VARIANTS = {
@@ -551,7 +723,9 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         iters=20, warmup=3, print_fn=print, intra=0, json_path=None,
         trace_dir=None, overlap=False, overlap_total_mb=8.0,
         overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES,
-        overlap_directions=OVERLAP_DIRECTIONS, repeat=3):
+        overlap_directions=OVERLAP_DIRECTIONS, repeat=3, moe=False,
+        moe_experts=MOE_EXPERTS, moe_capacity_factors=MOE_CAPACITY_FACTORS,
+        moe_wires=MOE_WIRES, moe_tokens=MOE_TOKENS):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
     (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps, iqr_s) —
     latency is the MEDIAN over ``repeat`` timed blocks, iqr their
@@ -569,7 +743,10 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         groups.reset_mesh()
         groups.initialize_mesh(**kw)
     mesh = groups.get_mesh_state().mesh
-    if mesh.shape.get(axis, 1) < 2:
+    # the op/overlap sweeps run collectives over `axis`; a moe-only
+    # invocation keys on the ep axis instead (run_moe_sweep guards it)
+    needs_axis = bool(ops) or overlap
+    if needs_axis and mesh.shape.get(axis, 1) < 2:
         raise SystemExit(
             f"axis {axis!r} has size {mesh.shape.get(axis, 1)} on mesh "
             f"{dict(mesh.shape)} — nothing to benchmark (pass --mesh)")
@@ -613,6 +790,13 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             wires=overlap_wires, total_mb=overlap_total_mb,
             iters=max(2, iters // 2), warmup=warmup, print_fn=print_fn,
             recorder=recorder, directions=overlap_directions)
+    moe_rows = []
+    if moe:
+        moe_rows = run_moe_sweep(
+            mesh=mesh, experts=moe_experts,
+            capacity_factors=moe_capacity_factors, wires=moe_wires,
+            tokens=moe_tokens, iters=max(2, iters // 2), warmup=warmup,
+            repeat=repeat, print_fn=print_fn, recorder=recorder)
     if json_path:
         # uniform row schema (bench_row): overlap/stat fields present on
         # every row so BENCH_* aggregation (fold_sweeps) never key-errors
@@ -628,6 +812,7 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             # stamping the op sweep's repeat here would let downstream
             # aggregation weigh them as multi-block medians they are not
             json_rows.append(bench_row(**c, latency_us=c["step_ms"] * 1e3))
+        json_rows.extend(moe_rows)  # already uniform bench_row dicts
         payload = {
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "axis": axis,
@@ -646,6 +831,8 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
                    "axis": axis, "ops": recorder.comm_summary()}
         if overlap_rows:
             summary["overlap"] = overlap_rows
+        if moe_rows:
+            summary["moe"] = moe_rows
         with open(summary_path, "w") as fh:
             json.dump(summary, fh, indent=2)
         recorder.close()
@@ -699,10 +886,23 @@ def cli_main(argv=None):
     ap.add_argument("--overlap-wires", default=None, metavar="W,W",
                     help="comma-separated wire dtypes for the overlap "
                     "sweep (default fp32,int8)")
+    ap.add_argument("--moe", action="store_true",
+                    help="also sweep the expert-dispatch exchange "
+                    "(experts × capacity_factor × wire dtype on the ep "
+                    "axis; needs an ep>=2 mesh — docs/moe.md)")
+    ap.add_argument("--moe-experts", default=None, metavar="E,E",
+                    help="comma-separated expert counts (default 8,16)")
+    ap.add_argument("--moe-capacity-factors", default=None, metavar="F,F",
+                    help="comma-separated capacity factors (default 1,2)")
+    ap.add_argument("--moe-wires", default=None, metavar="W,W",
+                    help="comma-separated dispatch wire dtypes "
+                    "(default fp32,int8; the GSPMD baseline always runs)")
+    ap.add_argument("--moe-tokens", type=int, default=MOE_TOKENS,
+                    help="tokens per dispatch for the moe sweep")
     args = ap.parse_args(argv)
-    # --overlap alone sweeps just the scheduler; add --op to also run the
-    # collective op sweep in the same invocation
-    default_ops = () if args.overlap else ALL_OPS
+    # --overlap/--moe alone sweep just their lane; add --op to also run
+    # the collective op sweep in the same invocation
+    default_ops = () if (args.overlap or args.moe) else ALL_OPS
     run(ops=(args.op, ) if args.op else default_ops, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
         iters=args.iters, warmup=args.warmup, repeat=args.repeat,
@@ -716,7 +916,16 @@ def cli_main(argv=None):
                        if args.overlap_wires else OVERLAP_WIRES),
         overlap_directions=(tuple(args.overlap_directions.split(","))
                             if args.overlap_directions
-                            else OVERLAP_DIRECTIONS))
+                            else OVERLAP_DIRECTIONS),
+        moe=args.moe,
+        moe_experts=(tuple(int(x) for x in args.moe_experts.split(","))
+                     if args.moe_experts else MOE_EXPERTS),
+        moe_capacity_factors=(
+            tuple(float(x) for x in args.moe_capacity_factors.split(","))
+            if args.moe_capacity_factors else MOE_CAPACITY_FACTORS),
+        moe_wires=(tuple(args.moe_wires.split(","))
+                   if args.moe_wires else MOE_WIRES),
+        moe_tokens=args.moe_tokens)
 
 
 if __name__ == "__main__":

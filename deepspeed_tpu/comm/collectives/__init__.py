@@ -16,7 +16,7 @@ from .engine import (LADDER_FP, CollectivesEngine, build_wire_ladder,
 from .quantized import (DEFAULT_GROUP_SIZE, WIRE_FORMATS,
                         all_to_all_quant_reduce, effective_group_size,
                         hierarchical_quant_reduce_scatter,
-                        quantized_all_gather, quantized_wire_bytes,
-                        wire_codec)
+                        quantized_all_gather, quantized_all_to_all,
+                        quantized_wire_bytes, wire_codec)
 from .topology import (Hierarchy, axis_intra_size, detect_intra_node_size,
                        factor_group, split_mesh)

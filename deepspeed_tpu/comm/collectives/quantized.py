@@ -177,6 +177,30 @@ def _qdq_bwd(ax_names, dim, wire_format, group_size, _, dy):
 qdq_all_gather_st.defvjp(_qdq_fwd, _qdq_bwd)
 
 
+def quantized_all_to_all(x, ax_names, split_axis, concat_axis, n,
+                         wire_format="int8", group_size=DEFAULT_GROUP_SIZE):
+    """Inside-shard_map: *permuting* quantized all-to-all — rank i sends
+    chunk j of ``split_axis`` to rank j and concatenates what it receives
+    along ``concat_axis``.  This is the expert-dispatch exchange (reference
+    ``_AllToAll``, moe/sharded_moe.py:23): unlike
+    :func:`all_to_all_quant_reduce` nothing is summed — each rank's
+    capacity block survives verbatim, just on a quantized wire.
+
+    ``wire_format="fp32"`` keeps the identical exchange with the raw fp
+    payload (the wire ladder's flat rung) — bit-exact."""
+    if wire_format == "fp32":
+        return jax.lax.all_to_all(x, ax_names, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    quant, dequant = wire_codec(wire_format, group_size)
+    chunks = jnp.stack(jnp.split(x, n, axis=split_axis))  # [n, ...chunk]
+    _, _, meta = quant(chunks[0])
+    q, s = jax.vmap(lambda c: quant(c)[:2])(chunks)
+    qx = jax.lax.all_to_all(q, ax_names, split_axis=0, concat_axis=0)
+    sx = jax.lax.all_to_all(s, ax_names, split_axis=0, concat_axis=0)
+    parts = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qx, sx)
+    return jnp.concatenate(list(parts), axis=concat_axis).astype(x.dtype)
+
+
 def all_to_all_quant_reduce(g, ax_names, dim, n, num_bits=8,
                             group_size=DEFAULT_GROUP_SIZE, wire_format=None,
                             mean=True):

@@ -1,5 +1,7 @@
 from .checkpoint import (is_expert_path, load_moe_expert_files,
                          save_moe_expert_files)
+from .engine import (MoeOptions, dispatch_combine, dispatch_wire,
+                     ep_hierarchy, expert_dispatch_wire_bytes)
 from .experts import ExpertFFN, Experts, expert_sharding_rules
 from .layer import MoE
 from .sharded_moe import TopKGate, top1gating, top2gating, topkgating

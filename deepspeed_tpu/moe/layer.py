@@ -18,8 +18,9 @@ import jax.numpy as jnp
 
 from ..utils import groups
 from ..utils.logging import logger
+from . import engine as moe_engine
 from .experts import ExpertFFN, Experts
-from .sharded_moe import TopKGate, dispatch_combine
+from .sharded_moe import TopKGate
 
 
 class MoE(nn.Module):
@@ -80,7 +81,16 @@ class MoE(nn.Module):
             mesh = groups.get_global_mesh()
         except Exception:
             mesh = None
-        out = dispatch_combine(tokens, combine, dispatch, experts, mesh=mesh)
+        # routed-token accounting on the telemetry spine (drop fraction,
+        # overflow, expert-load imbalance, aux loss) — one attribute read
+        # while telemetry is off
+        moe_engine.record_routing(self._layer_id(), self.k, combine,
+                                  dispatch, exp_counts, l_aux)
+        # THE dispatch point: flat GSPMD constraints by default (bit-
+        # identical), the manual quantized/hierarchical a2a when the ``moe``
+        # config block arms it (docs/moe.md)
+        out = moe_engine.dispatch_combine(tokens, combine, dispatch, experts,
+                                          mesh=mesh)
 
         if self.use_residual:
             # PR-MoE: dense residual MLP + learned 2-way mixing coefficient
@@ -96,3 +106,14 @@ class MoE(nn.Module):
                    mlp_out.astype(jnp.float32) * coef[..., 1:2]).astype(out.dtype)
 
         return out.reshape(orig_shape), l_aux, exp_counts
+
+    def _layer_id(self):
+        """Stable per-layer identity for the routed-token metric families —
+        the flax scope path when available, the module name otherwise."""
+        try:
+            path = self.scope.path
+            if path:
+                return "/".join(str(p) for p in path)
+        except Exception:
+            pass
+        return self.name or "moe"

@@ -29,7 +29,10 @@ def _one_hot(x, n, dtype=jnp.float32):
 def _capacity(num_tokens, num_experts, capacity_factor, min_capacity=4):
     cap = int(num_tokens * capacity_factor / num_experts)
     cap = max(cap, min_capacity)
-    return cap
+    # clamp at T: an expert can never receive more than every token, but for
+    # tiny token counts min_capacity used to exceed T — silently inflating
+    # the [E, C, D] dispatch buffer (and the a2a payload) with dead slots
+    return min(cap, num_tokens)
 
 
 def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=None,

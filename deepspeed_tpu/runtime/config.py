@@ -161,6 +161,42 @@ class CommOptimizationsConfig(DeepSpeedConfigModel):
     overlap: OverlapConfig = OverlapConfig()
 
 
+class MoeConfig(DeepSpeedConfigModel):
+    """``"moe"`` section — the expert-parallel MoE engine
+    (``moe/engine.py``, docs/moe.md).
+
+    ``enabled: false`` (default) and ``quantized_dispatch: false`` are both
+    bit-identical to the plain GSPMD constraint dispatch (normalized-jaxpr
+    contract, same as ``comm_optimizations``).  Enabled, the engine threads
+    the noisy-gate rngs (per step, per layer) through flax apply and books
+    routed-token accounting on the telemetry spine; ``quantized_dispatch``
+    additionally routes the expert dispatch/return all-to-all through the
+    manual-SPMD quantized exchange (blockwise codecs from
+    ``comm/collectives/quantized.py``; hierarchical ICI/DCN variants picked
+    by ``topology.factor_group``)."""
+    enabled: bool = False
+    # manual-SPMD quantized expert exchange (dispatch reduce + return
+    # gather); False = the GSPMD constraint path, program-identical
+    quantized_dispatch: bool = False
+    # wire format of the quantized exchange: int8 | int4 | fp8 | fp6 |
+    # fp12 | fp32 ("fp32" = the manual schedule with the raw fp payload).
+    # A comm_optimizations.wire_dtype_by_size ladder, when present,
+    # overrides this per payload size (the autotuner's per-size choice
+    # applies to expert dispatch too).
+    wire_dtype: str = "int8"
+    # elements per quantization scale group (lane-aligned down, min 128)
+    quantization_group_size: int = Field(2048, ge=128)
+    # 2-hop dispatch (fp intra-node psum-scatter, quantized inter-node
+    # all-to-all) when the ep axis spans a topology hierarchy
+    hierarchical_dispatch: bool = True
+    # devices per node for the ep-axis hierarchy split; 0 = auto-detect
+    # (device metadata / DS_TPU_INTRA_NODE_SIZE), like the other collectives
+    intra_node_size: int = Field(0, ge=0)
+    # base seed for the per-step, per-layer noisy-gate rng fold-in
+    # (RSample/Jitter policies); None = the config-level "seed"
+    gating_seed: Optional[int] = None
+
+
 class MonitorConfig(DeepSpeedConfigModel):
     """Reference ``monitor/config.py``: tensorboard/wandb/comet/csv."""
 
@@ -544,6 +580,20 @@ class DeepSpeedConfig:
                                       or self.bfloat16_enabled) else 4
                     _pf.bucket_mb = (self.zero_config.prefetch_bucket_size
                                      * _itemsize / float(1 << 20))
+        # "moe" block: the expert-parallel MoE engine (docs/moe.md).  Wire
+        # format validated at config load like comm_optimizations — a
+        # mistyped dispatch wire must fail bring-up, not first dispatch.
+        self.moe_config = MoeConfig(**pd.get("moe", {}) or {})
+        # "fp32" = manual schedule with the raw fp payload (the ladder's
+        # flat rung).  Deliberately NOT imported from
+        # moe.engine.DISPATCH_WIRES: importing the moe package here would
+        # pull flax into every config parse; a sync test guards the
+        # duplication instead
+        _dispatch_wires = ("fp32", ) + tuple(WIRE_FORMATS)
+        if self.moe_config.wire_dtype not in _dispatch_wires:
+            raise DeepSpeedConfigError(
+                f"moe.wire_dtype {self.moe_config.wire_dtype!r} unknown "
+                f"(have {', '.join(_dispatch_wires)})")
         self.flops_profiler_config = FlopsProfilerConfig(
             **pd.get("flops_profiler", {}) or {})
         self.hybrid_engine = HybridEngineConfig(

@@ -281,6 +281,28 @@ class DeepSpeedEngine:
         self.zero_stage = zc.stage
         if tp_rules is None:
             tp_rules = getattr(model, "tp_sharding_rules", None)
+        # ------------------------------------------------------------- MoE
+        # (docs/moe.md) — install the expert-parallel dispatch options and
+        # make expert stacks shard over "ep" without hand-plumbed rules.
+        # moe.enabled: false resets the dispatcher to the flat GSPMD path
+        # (bit-identical program).
+        from ..moe import engine as moe_engine
+        moe_cfg = config.moe_config
+        moe_engine.configure(moe_cfg if moe_cfg.enabled else None,
+                             comm_opts=config.comm_optimizations_config)
+        if moe_cfg.enabled:
+            from ..moe.experts import expert_sharding_rules
+            tp_rules = {**expert_sharding_rules(), **(tp_rules or {})}
+        # per-step noisy-gate rng threaded through flax apply (the RSample/
+        # Jitter policies were a silent no-op unless callers hand-plumbed an
+        # rng); the key rides the input tail like PLD's, folded per layer by
+        # flax's scope-path mixing in make_rng
+        self._moe_gating_tail = bool(moe_cfg.enabled and _is_flax_module(
+            model))
+        self._moe_gating_key = jax.random.PRNGKey(
+            moe_cfg.gating_seed if moe_cfg.gating_seed is not None
+            else config._param_dict.get("seed", 1234)) \
+            if self._moe_gating_tail else None
         self.plan = ZeroPartitionPlan(
             stage=zc.stage, mesh=self.mesh, zero_axes=zero_axes,
             tp_rules=tp_rules,
@@ -427,12 +449,15 @@ class DeepSpeedEngine:
                 theta=pld_cfg.theta, gamma=pld_cfg.gamma)
         else:
             self.progressive_layer_drop = None
-        # the PLD theta scalar + rng key ride the END of the micro's input
-        # tuple and are replicated (not dp-sharded) by the manual-SPMD
-        # micros (qgZ / 1-bit) — reference composes PLD with comm
-        # compression the same way (engine-level curriculum, orthogonal)
+        # the PLD theta scalar + rng key (and the MoE gating key before
+        # them) ride the END of the micro's input tuple and are replicated
+        # (not dp-sharded) by the manual-SPMD micros (qgZ / 1-bit) —
+        # reference composes PLD with comm compression the same way
+        # (engine-level curriculum, orthogonal)
         self._n_replicated_batch_tail = (
             2 if self.progressive_layer_drop is not None else 0)
+        if self._moe_gating_tail:
+            self._n_replicated_batch_tail += 1
 
         # ----------------------------------------------- eigenvalue (compression)
         eig_cfg = getattr(config, "eigenvalue_config", None)
@@ -1093,6 +1118,20 @@ class DeepSpeedEngine:
         for t in self._param_transforms:
             fn = (lambda inner, t: lambda params, *i, **k: inner(
                 t(params), *i, **k))(fn, t)
+        if self._moe_gating_tail and self.training and with_pld:
+            # the per-step MoE gating key rides the input tail (before the
+            # PLD pair); deliver it as the flax "gating" rng collection so
+            # make_rng folds in each layer's scope path — per-step,
+            # per-layer seeding without hand-plumbing.  Gated on the same
+            # flag as the PLD strip: with_pld=False callers (eigenvalue
+            # probe) pass RAW inputs with no appended tails, and popping
+            # i[-1] there would eat a real model input
+            inner_g = fn
+
+            def fn(params, *i, rngs=None, **k):
+                r = dict(rngs or {})
+                r["gating"] = i[-1]
+                return inner_g(params, *i[:-1], rngs=r, **k)
         if with_pld and self.progressive_layer_drop is not None \
                 and self.training:
             inner = fn
@@ -1399,6 +1438,11 @@ class DeepSpeedEngine:
             self._tel_step_tokens += (int(np.prod(shape[:2]))
                                       if len(shape) >= 2
                                       else int(shape[0]) if shape else 0)
+        if self._moe_gating_tail:
+            # per-step fold-in: same compiled program, fresh key each
+            # micro-step; flax make_rng folds in the layer path per layer
+            inputs = (*inputs, jax.random.fold_in(self._moe_gating_key,
+                                                  self.micro_steps))
         if self.progressive_layer_drop is not None:
             inputs = (*inputs,
                       np.float32(self.progressive_layer_drop.get_theta()),
@@ -1647,6 +1691,15 @@ class DeepSpeedEngine:
         if tokens:
             metrics["tokens"] = tokens
         metrics["lr"] = self.get_lr()[0]
+        # MoE routed-token stats arrive via jax.debug.callback whenever
+        # telemetry is on and the model contains MoE layers (record_routing
+        # gates on telemetry, not the moe block) — drain the effect queue
+        # so this step's stats land in THIS step's record, not the next
+        # one's.  No-op (and cheap) when nothing is pending.
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
         record = _telemetry.end_step(metrics=metrics)
         reg = _telemetry.get_registry()
         if reg is not None:

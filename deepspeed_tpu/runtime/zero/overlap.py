@@ -349,7 +349,9 @@ def gather_items(params, plan):
         p = path_str(kp)
         items.append((p, x))
         spec = plan.param_spec(getattr(x, "shape", ()), p)
-        dim, _axes = zero_dim(spec, plan.param_axes)
+        # per-leaf axes: a rule-claimed axis (the expert "ep" dim, tp) is
+        # model parallelism, not a gatherable ZeRO shard
+        dim, _axes = zero_dim(spec, plan.leaf_zero_axes(p))
         if dim is None:
             persistent.add(p)
     return items, persistent

@@ -339,6 +339,40 @@ class ZeroPartitionPlan:
         return bool(co is not None and getattr(co, "enabled", False)
                     and getattr(co, "hierarchical_allreduce", False))
 
+    # per-leaf axis bookkeeping ---------------------------------------------
+    def rule_claimed_axes(self, path):
+        """Mesh axes the matched tp rule pins for ``path`` — the expert
+        stack's "ep" dim (``expert_sharding_rules``), tensor-parallel "tp"
+        dims, ….  Those axes are MODEL parallelism for that leaf, not ZeRO
+        data sharding: the stage-3 gather must not reassemble experts
+        across ranks, and grad reduction must not average distinct experts
+        (the reference's expert-DP split, ``moe/utils.py is_moe_param``)."""
+        if not self.tp_rules or path is None:
+            return ()
+        rule = match_tp_rule(self.tp_rules, path)
+        if rule is None:
+            return ()
+        names = []
+        for entry in rule:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry, )):
+                if a is not None and a != "zero" and a not in names:
+                    names.append(a)
+        return tuple(names)
+
+    def leaf_zero_axes(self, path, axes=None):
+        """The ZeRO axes that actually apply to ``path``: the plan's axes
+        minus the ones its rule claims (for non-rule leaves this is exactly
+        ``param_axes`` — zero behavior change).  THE per-leaf notion every
+        gather/reduce walker must key on (``zeropp``, the prefetch
+        partitioner, ``gather_shardings``)."""
+        axes = tuple(self.param_axes if axes is None else axes)
+        claimed = self.rule_claimed_axes(path)
+        if not claimed:
+            return axes
+        return tuple(a for a in axes if a not in claimed)
+
     # specs -----------------------------------------------------------------
     def _expand_rule(self, spec, shape, zero_axes, mesh):
         """Expand ``"zero"`` placeholders in a rule spec and sanitize.
@@ -490,11 +524,16 @@ class ZeroPartitionPlan:
         their spec).  The forward-prefetch markers constrain to these, so
         XLA emits the stage-3 all-gather at the marker instead of at first
         use."""
-        return jax.tree_util.tree_map_with_path(
-            lambda kp, x: NamedSharding(
+        def one(kp, x):
+            p = path_str(kp)
+            # per-leaf axes: rule-claimed axes (expert "ep", tp) survive the
+            # gather — only the leaf's own ZeRO axes are stripped
+            return NamedSharding(
                 self.param_mesh,
-                gathered_spec(self.param_spec(x.shape, path_str(kp)),
-                              self.param_axes)), params)
+                gathered_spec(self.param_spec(x.shape, p),
+                              self.leaf_zero_axes(p)))
+
+        return jax.tree_util.tree_map_with_path(one, params)
 
     def param_specs(self, params):
         return jax.tree_util.tree_map_with_path(

@@ -96,10 +96,13 @@ def quantized_weight_gather(params, plan, wire_format="int8",
 
     def gather_one(path, x):
         spec = plan.param_spec(x.shape, path)
-        dim, axes = _zero_dim(spec, plan.param_axes)
+        # per-leaf axes: a rule-claimed axis (the expert "ep" dim, tp) is
+        # model parallelism — never gathered here
+        leaf_axes = plan.leaf_zero_axes(path, plan.param_axes)
+        dim, axes = _zero_dim(spec, leaf_axes)
         if dim is None:
             return x
-        out_spec = _gathered_spec(spec, plan.param_axes)
+        out_spec = _gathered_spec(spec, leaf_axes)
         # per-leaf wire through the autotuned size ladder — x is the
         # GLOBAL array in GSPMD mode, so x.size is the logical (gathered)
         # message size the probes/dispatch key on; "fp32" rungs take the
@@ -228,13 +231,16 @@ def build_manual_dp_micro(engine):
         return P(*[_collapse(tuple(a for a in _entry_names(e)
                                    if a in manual_axes)) for e in spec])
 
-    def _leaf_hier(spec):
+    def _leaf_hier(spec, leaf_axes=None):
         """(dim, outer_axes, inner_axes) when this leaf's reduction should
         run the 2-hop scheme, else None.  Mesh axis order is major→minor, so
-        the FIRST effective axis crosses the slower fabric."""
+        the FIRST effective axis crosses the slower fabric.  ``leaf_axes``
+        restricts the search to the leaf's OWN reducible axes (expert
+        leaves exclude their claimed "ep" dim)."""
         if not hier:
             return None
-        dim, axes = _zero_dim(spec, dp_axes)
+        dim, axes = _zero_dim(spec, dp_axes if leaf_axes is None
+                              else leaf_axes)
         if dim is None:
             return None
         eff = tuple(a for a in axes if mesh.shape[a] > 1)
@@ -242,12 +248,12 @@ def build_manual_dp_micro(engine):
             return None
         return dim, eff[:1], eff[1:]
 
-    def _hier_spec(spec):
+    def _hier_spec(spec, leaf_axes=None):
         """Reorder a hier leaf's zero-dim axes to the inner-major tiling the
         2-hop reduce-scatter produces (see
         ``hierarchical_quant_reduce_scatter``); the apply step reshards to
         the canonical master layout at the gas boundary."""
-        info = _leaf_hier(spec)
+        info = _leaf_hier(spec, leaf_axes)
         if info is None:
             return spec
         dim, outer, inner = info
@@ -259,6 +265,40 @@ def build_manual_dp_micro(engine):
         out[dim] = _collapse(new_entry)
         return P(*out)
 
+    def _claimed_divisor(leaf_axes):
+        n = 1
+        for a in dp_axes:
+            if a not in leaf_axes:
+                n *= mesh.shape[a]
+        return n
+
+    def _finish_reduce(out, reduced_axes, leaf_axes):
+        """Close a leaf's reduction: mean over the leaf's remaining
+        reducible axes, then the extra divisor for claimed (model-parallel)
+        axes — those ranks' loss terms already arrived through the forward
+        collectives' transposes (the expert dispatch), but the global-mean
+        loss normalization still counts them."""
+        rest = tuple(a for a in leaf_axes if a not in reduced_axes)
+        if rest:
+            out = jax.lax.pmean(out, rest)
+        extra = _claimed_divisor(leaf_axes)
+        if extra > 1:
+            out = out / extra
+        return out
+
+    def _unsharded_reduce(g, leaf_axes):
+        """Reduction of a leaf with no reducible sharded dim.  The common
+        (no claimed axes) case keeps the exact historical pmean; claimed
+        leaves sum over their own group only and divide by the full loss
+        normalization."""
+        if tuple(leaf_axes) == tuple(dp_axes):
+            return jax.lax.pmean(g, dp_axes)
+        out = jax.lax.pmean(g, leaf_axes) if leaf_axes else g
+        extra = _claimed_divisor(leaf_axes)
+        if extra > 1:
+            out = out / extra
+        return out
+
     def micro(params, scale, inputs):
         # specs must come from the GLOBAL shapes, captured here where params
         # are still global arrays — inside the shard_map body the leaves are
@@ -269,14 +309,31 @@ def build_manual_dp_micro(engine):
         # never depends on in-body shapes.
         gather_specs = {}
         reduce_specs = {}
+        # per-leaf reducible/gatherable axes: rule-claimed model axes (the
+        # expert stack's "ep", tp dims) are NOT ZeRO shards — expert params
+        # must stay local to their ep rank through the gather, and expert
+        # grads reduce over the expert-DP ("dp") group only (reference
+        # engine.py:2510 _reduce_expert_gradients)
+        gather_axes = {}
+        reduce_axes = {}
 
         def _record(kp, x):
             p = path_str(kp)
+            claimed = plan.rule_claimed_axes(p)
+            if hpz_active and any(a in ("dp", "ep") for a in claimed):
+                raise ValueError(
+                    f"hpZ/MiCS shard groups cannot compose with a tp rule "
+                    f"claiming the dp/ep axes (leaf {p!r} claims "
+                    f"{claimed}): the zp translation would fold the expert "
+                    "axis into the shard group; drop "
+                    "zero_hpz_partition_size/mics_shard_size or the rule")
             gather_specs[p] = plan.param_spec(x.shape, p)
+            gather_axes[p] = plan.leaf_zero_axes(p, plan.param_axes)
             spec = _translate(plan.master_spec(x.shape, p))
             if manual_only:
                 spec = _manual_spec(spec)
             reduce_specs[p] = spec
+            reduce_axes[p] = plan.leaf_zero_axes(p, dp_axes)
 
         jax.tree_util.tree_map_with_path(_record, params)
         param_specs = jax.tree_util.tree_map(_translate,
@@ -295,8 +352,9 @@ def build_manual_dp_micro(engine):
                 _manual_spec, master_specs,
                 is_leaf=lambda x: isinstance(x, P))
         # hier leaves come out of the 2-hop reduce tiled inner-major
-        grad_out_specs = jax.tree_util.tree_map(
-            _hier_spec, master_specs, is_leaf=lambda x: isinstance(x, P))
+        grad_out_specs = jax.tree_util.tree_map_with_path(
+            lambda kp, s: _hier_spec(s, reduce_axes.get(path_str(kp))),
+            master_specs, is_leaf=lambda x: isinstance(x, P))
         from ..utils import batch_input_specs
         batch_specs = batch_input_specs(inputs, dp_axes,
                                         engine._n_replicated_batch_tail)
@@ -328,7 +386,7 @@ def build_manual_dp_micro(engine):
                 jax.tree_util.tree_flatten_with_path(grads)[0]}
 
             def stage1(path, g):
-                info = _leaf_hier(reduce_specs[path])
+                info = _leaf_hier(reduce_specs[path], reduce_axes[path])
                 if info is None:
                     return g
                 dim, _, inner = info
@@ -341,11 +399,12 @@ def build_manual_dp_micro(engine):
 
             def stage2(path, h):
                 spec = reduce_specs[path]
-                dim, axes = _zero_dim(spec, dp_axes)
+                leaf_axes = reduce_axes[path]
+                dim, axes = _zero_dim(spec, leaf_axes)
                 if dim is None:
-                    return jax.lax.pmean(h, dp_axes).astype(grad_dtype)
+                    return _unsharded_reduce(h, leaf_axes).astype(grad_dtype)
                 fmt = fmt_by_path[path]
-                info = _leaf_hier(spec)
+                info = _leaf_hier(spec, leaf_axes)
                 if info is not None:
                     _, outer, inner = info
                     n_out = 1
@@ -366,10 +425,8 @@ def build_manual_dp_micro(engine):
                     out = all_to_all_quant_reduce(h, axes, dim, n,
                                                   wire_format=fmt,
                                                   group_size=qg_gs)
-                rest = tuple(a for a in dp_axes if a not in axes)
-                if rest:
-                    out = jax.lax.pmean(out, rest)
-                return out.astype(grad_dtype)
+                return _finish_reduce(out, axes, leaf_axes).astype(
+                    grad_dtype)
 
             return pipelined_bucket_reduce(
                 grads, buckets, stage1, stage2,
@@ -379,7 +436,10 @@ def build_manual_dp_micro(engine):
             # stage-3: reassemble full params from local shards (int8 when qwZ)
             def gather_one(path, x):
                 spec = gather_specs[path]
-                dim, axes = _zero_dim(spec, plan.param_axes)
+                # per-leaf axes: rule-claimed model axes (the expert "ep"
+                # dim) are NOT ZeRO shards — expert params stay local to
+                # their ep rank and the dispatch a2a moves tokens instead
+                dim, axes = _zero_dim(spec, gather_axes[path])
                 if dim is None:
                     return x
                 if qw:
@@ -408,13 +468,17 @@ def build_manual_dp_micro(engine):
 
             def reduce_leaf(kp, g):
                 # translated spec lives in manual-mode axis space (dp_axes ∪
-                # zp), so searching dp_axes covers plain/hpZ/MiCS alike
-                spec = reduce_specs[path_str(kp)]
-                dim, axes = _zero_dim(spec, dp_axes)
+                # zp), so searching dp_axes covers plain/hpZ/MiCS alike;
+                # per-leaf axes keep expert ("ep"-claimed) leaves on their
+                # expert-DP reduction group
+                p = path_str(kp)
+                spec = reduce_specs[p]
+                leaf_axes = reduce_axes[p]
+                dim, axes = _zero_dim(spec, leaf_axes)
                 if dim is None:
-                    return jax.lax.pmean(g, dp_axes).astype(grad_dtype)
+                    return _unsharded_reduce(g, leaf_axes).astype(grad_dtype)
                 fmt = _grad_leaf_fmt(g)
-                info = _leaf_hier(spec)
+                info = _leaf_hier(spec, leaf_axes)
                 if info is not None:
                     _, outer, inner = info
                     n_out = 1
@@ -433,11 +497,8 @@ def build_manual_dp_micro(engine):
                     out = all_to_all_quant_reduce(g, axes, dim, n,
                                                   wire_format=fmt,
                                                   group_size=qg_gs)
-                # average over any remaining dp axes not in this dim
-                rest = tuple(a for a in dp_axes if a not in axes)
-                if rest:
-                    out = jax.lax.pmean(out, rest)
-                return out.astype(grad_dtype)
+                return _finish_reduce(out, axes, leaf_axes).astype(
+                    grad_dtype)
 
             if overlap_on:
                 grads = _overlapped_reduce(grads)

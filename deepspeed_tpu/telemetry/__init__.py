@@ -149,6 +149,14 @@ def record_comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
                              world_size, exposed=exposed)
 
 
+def record_moe_stats(layer, stats):
+    """Per-layer routed-token accounting (drop fraction, overflow, expert
+    load imbalance, aux loss) into the open step window — the ``moe``
+    section of the step record (``moe/engine.record_routing`` emits)."""
+    if _recorder is not None:
+        _recorder.moe_stat(layer, stats)
+
+
 def metadata(name, payload):
     if _recorder is not None:
         _recorder.metadata(name, payload)

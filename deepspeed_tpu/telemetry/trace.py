@@ -118,6 +118,7 @@ class TraceRecorder:
         self._step_annotation = None
         self._phase_s = {}
         self._bucket_s = {}
+        self._moe_s = {}             # layer → accumulated routing stats
         self._step_comm = CommAttribution()
         self._run_comm = CommAttribution()
         self.steps_recorded = 0
@@ -225,6 +226,7 @@ class TraceRecorder:
         self._step_t0 = time.perf_counter()
         self._phase_s = {}
         self._bucket_s = {}
+        self._moe_s = {}
         self._step_comm.reset()
         if self.device_annotations:
             try:
@@ -277,6 +279,24 @@ class TraceRecorder:
                 "bucket_ms": {k: v * 1e3
                               for k, v in sorted(self._bucket_s.items())},
             }
+        if self._moe_s:
+            layers = {}
+            for name, acc in sorted(self._moe_s.items()):
+                n = max(1, acc.pop("_n", 1))
+                layers[name] = {k: (v / n if k != "k" else v)
+                                for k, v in acc.items()}
+            # aggregate defensively: a client may book a partial stats
+            # payload, and telemetry must never kill a step over it
+            record["moe"] = {
+                "layers": layers,
+                "drop_fraction_mean": (sum(l.get("drop_fraction", 0.0)
+                                           for l in layers.values())
+                                       / len(layers)),
+                "load_imbalance_max": max(l.get("load_imbalance", 0.0)
+                                          for l in layers.values()),
+                "aux_loss_total": sum(l.get("aux_loss", 0.0)
+                                      for l in layers.values()),
+            }
         if metrics:
             record["metrics"] = {k: v for k, v in metrics.items()
                                  if v is not None}
@@ -301,6 +321,21 @@ class TraceRecorder:
         reduce, ``param_gather`` for the forward prefetch).  Lands in the
         step record's ``overlap`` section, not the phase columns."""
         return self.span(f"{kind}/{index}", cat="comm", **args)
+
+    def moe_stat(self, layer, stats):
+        """Accumulate one MoE layer's routed-token stats into the open step
+        window (mean over the gas window's micro-batches at end_step).
+        ``stats``: drop_fraction / overflow_tokens / load_imbalance /
+        aux_loss floats plus the integer ``k``."""
+        if self._closed or self._step is None:
+            return
+        acc = self._moe_s.setdefault(str(layer), {"_n": 0})
+        acc["_n"] += 1
+        for key, val in stats.items():
+            if key == "k":
+                acc["k"] = int(val)
+            else:
+                acc[key] = acc.get(key, 0.0) + float(val)
 
     def comm_event(self, op, variant, msg_bytes, wire_bytes, latency_s,
                    world_size=1, exposed=True):

@@ -143,9 +143,17 @@ def initialize_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None,
             raise ValueError(f"device count {total} not divisible by pp*sp*tp={rem}")
         dp = total // rem
     _check_sizes(total, pp, dp, sp, tp)
+    if ep < 1:
+        raise ValueError(f"expert parallel size ep={ep} must be >= 1")
     if dp % ep != 0:
-        raise ValueError(f"expert parallel size ep={ep} must divide dp={dp} "
-                         f"(reference moe/layer.py:89 semantics)")
+        # loud, BEFORE the grid reshape: a bad factorization used to be
+        # reachable as a cryptic numpy "cannot reshape array" error from
+        # mesh construction paths that skipped this function
+        raise ValueError(
+            f"expert parallel size (ep_size) ep={ep} must divide the "
+            f"data-parallel world size dp={dp} — the mesh factors dp into "
+            f"(dp/ep, ep) = ({dp}/{ep}, {ep}) (reference moe/layer.py:89 "
+            "semantics); pick ep from the divisors of dp")
 
     shape = (pp, dp // ep, ep, sp, tp)
     if explicit_devices:

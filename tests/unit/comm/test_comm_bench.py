@@ -262,3 +262,82 @@ def test_group_rank_introspection():
     assert len(dist.get_all_ranks_from_group()) == 8
     groups.reset_mesh()
     dist.destroy_process_group()
+
+
+def test_moe_sweep_rows_and_schema(tmp_path):
+    """ds_bench --moe: uniform bench_row schema (E × capacity_factor ×
+    wire), GSPMD baseline per cell, quantized rows moving fewer wire
+    bytes, and archived into the --json payload + comm_summary."""
+    import json
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.initialize_mesh(ep=4)
+    out = tmp_path / "moe.json"
+    trace = tmp_path / "trace"
+    run(ops=(), mesh_spec=None, iters=1, warmup=0, repeat=1,
+        print_fn=lambda *a: None, json_path=str(out), trace_dir=str(trace),
+        moe=True, moe_experts=(8, ), moe_capacity_factors=(1.0, ),
+        moe_wires=("fp32", "int8"), moe_tokens=256)
+    payload = json.loads(out.read_text())
+    rows = [r for r in payload["rows"] if r.get("direction") == "moe"]
+    assert len(rows) == 3  # gspmd baseline + fp32 + int8
+    for row in rows:
+        assert set(row) >= {"op", "bytes", "wire_bytes", "latency_us",
+                            "iqr_us", "repeat", "wire_dtype", "direction",
+                            "experts", "capacity_factor", "capacity",
+                            "drop_fraction", "load_imbalance"}
+        assert row["op"] == "moe_dispatch"
+        assert 0.0 <= row["drop_fraction"] <= 1.0
+        assert row["load_imbalance"] >= 1.0 - 1e-6
+    by_wire = {r["wire_dtype"]: r for r in rows}
+    assert by_wire["int8"]["wire_bytes"] < by_wire["fp32"]["wire_bytes"]
+    assert by_wire["gspmd"]["wire_bytes"] == by_wire["fp32"]["wire_bytes"]
+    summary = json.loads((trace / "comm_summary.json").read_text())
+    assert len(summary["moe"]) == 3
+    groups.reset_mesh()
+
+
+def test_moe_sweep_needs_ep_mesh():
+    from deepspeed_tpu.benchmarks.comm_bench import run_moe_sweep
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.initialize_mesh()  # ep=1
+    with pytest.raises(SystemExit, match="ep"):
+        run_moe_sweep(print_fn=lambda *a: None)
+    groups.reset_mesh()
+
+
+def test_fold_sweeps_aggregates_moe(tmp_path):
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "fold_sweeps", os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "..", "tools", "fold_sweeps.py"))
+    fold = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fold)
+    rows = [{"op": "moe_dispatch", "direction": "moe", "experts": 8,
+             "capacity_factor": 1.0, "wire_dtype": "int8",
+             "latency_us": 100.0, "drop_fraction": 0.1,
+             "load_imbalance": 1.5, "wire_bytes": 1000},
+            {"op": "moe_dispatch", "direction": "moe", "experts": 8,
+             "capacity_factor": 1.0, "wire_dtype": "int8",
+             "latency_us": 300.0, "drop_fraction": 0.3,
+             "load_imbalance": 2.5, "wire_bytes": 1000},
+            {"op": "moe_dispatch", "direction": "moe", "experts": 8,
+             "capacity_factor": 1.0, "wire_dtype": "gspmd",
+             "latency_us": 50.0, "drop_fraction": 0.1,
+             "load_imbalance": 1.5, "wire_bytes": 4000},
+            # non-moe rows must be skipped, not crash the fold
+            {"op": "overlap", "direction": "reduce", "bucket_mb": 4.0,
+             "overlap_efficiency": 0.5, "exposed_comm_frac": 0.1}]
+    p = tmp_path / "a.json"
+    p.write_text(json.dumps({"rows": rows}))
+    agg = fold.aggregate_moe([str(p)])
+    assert len(agg) == 2
+    cell = next(r for r in agg if r["wire_dtype"] == "int8")
+    assert cell["runs"] == 2
+    assert abs(cell["latency_us"] - 200.0) < 1e-9
+    assert abs(cell["drop_fraction"] - 0.2) < 1e-9
+    # fastest-first within (E, cf)
+    assert agg[0]["wire_dtype"] == "gspmd"

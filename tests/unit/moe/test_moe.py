@@ -222,3 +222,34 @@ def test_moe_param_group_utils():
     updates, _ = tx.update(grads, state, params)
     assert jax.tree_util.tree_structure(updates) == \
         jax.tree_util.tree_structure(params)
+
+
+def test_capacity_clamped_at_token_count():
+    """ISSUE-13 satellite: for tiny token counts ``min_capacity`` used to
+    exceed T, silently inflating the [E, C, D] dispatch buffer (and the
+    a2a payload) with dead slots — C is now clamped at T."""
+    from deepspeed_tpu.moe.sharded_moe import _capacity
+    assert _capacity(2, 4, 1.0, min_capacity=4) == 2   # was 4 > T
+    assert _capacity(100, 4, 1.0, min_capacity=4) == 25
+    assert _capacity(8, 4, 1.0, min_capacity=4) == 4   # min_capacity holds
+    T, E = 2, 4
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((T, E)),
+                         jnp.float32)
+    _, combine, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                         min_capacity=4)
+    assert combine.shape == (T, E, T)
+    # still routes every token (capacity T is the physical maximum)
+    per_tok = jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2))
+    assert int(per_tok.min()) == 1
+
+
+def test_capacity_clamp_no_drop_unaffected():
+    """drop_tokens=False already used C=T; the clamp must not change it."""
+    T, E, K = 16, 4, 2
+    logits = jnp.asarray(np.random.default_rng(1).standard_normal((T, E)),
+                         jnp.float32)
+    _, combine, dispatch, _ = topkgating(logits, K, capacity_factor=1.0,
+                                         drop_tokens=False)
+    assert combine.shape[-1] == T
+    per_tok = jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2))
+    assert int(per_tok.min()) == K

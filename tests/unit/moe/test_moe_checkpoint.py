@@ -1,0 +1,159 @@
+"""Expert-parameter checkpoint round-trip on an ep>1 mesh (ISSUE-13
+satellite): save → restore → step parity through the PR-3
+integrity-manifest path, expert shards landing back on the right ranks."""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.moe import MoE
+from deepspeed_tpu.utils import groups
+
+HIDDEN = 32
+
+
+class MoEModel(nn.Module):
+    hidden: int = HIDDEN
+    num_experts: int = 4
+
+    @nn.compact
+    def __call__(self, x, y):
+        h = nn.Dense(self.hidden, name="in_proj")(x)
+        moe_out, l_aux, _ = MoE(hidden_size=self.hidden,
+                                num_experts=self.num_experts, k=1,
+                                capacity_factor=2.0, name="moe")(h)
+        h = h + moe_out
+        out = nn.Dense(self.hidden, name="out_proj")(h)
+        return jnp.mean((out - y) ** 2) + 0.01 * l_aux
+
+
+def _engine(ep=2):
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
+    groups.initialize_mesh(ep=ep)
+    model = MoEModel()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, HIDDEN)).astype(np.float32)
+    y = np.tanh(x * 0.5).astype(np.float32)
+    params = jax.tree_util.tree_map(
+        np.asarray, model.init(jax.random.PRNGKey(0), x, y)["params"])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "moe": {"enabled": True},
+                "mesh": {"dp": -1, "ep": ep}})
+    return engine, x, y
+
+
+def _teardown():
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
+
+
+def _expert_leaf(params):
+    return params["moe"]["deepspeed_moe"]["experts"]["fc1"]["kernel"]
+
+
+def test_moe_checkpoint_roundtrip_step_parity(tmp_path):
+    """Train → save (manifest committed); a FRESH ep>1 engine restores the
+    tag BIT-EXACTLY (params, fp32 master, optimizer moments — the strongest
+    step-parity guarantee: identical state implies an identical future),
+    reproduces the pre-save loss to float tolerance, and keeps training.
+
+    Deliberately NOT a float comparison of compiled optimizer steps across
+    engine instances: on this box the XLA disk-cache/donated-buffer class
+    (tests/conftest.py) intermittently corrupts compiled-apply numerics of
+    *either* engine when other packages ran first, which would flake this
+    gate without measuring the checkpoint path at all."""
+    engine, x, y = _engine(ep=2)
+    try:
+        losses = []
+        for _ in range(3):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        engine.save_checkpoint(str(tmp_path), tag="moe_ck")
+        # PR-3 integrity manifest present and valid for the tag
+        manifest = os.path.join(str(tmp_path), "moe_ck", "manifest.json")
+        assert os.path.exists(manifest)
+        man = json.load(open(manifest))
+        assert man.get("files"), man
+        loss_ref = float(engine(x, y))
+        saved = {
+            "params": jax.tree_util.tree_map(np.asarray, engine.params),
+            "master": (None if engine.master is None else
+                       jax.tree_util.tree_map(np.asarray, engine.master)),
+            "opt": jax.tree_util.tree_map(np.asarray, engine.opt_state),
+        }
+    finally:
+        _teardown()
+
+    engine2, x, y = _engine(ep=2)
+    try:
+        engine2.load_checkpoint(str(tmp_path), tag="moe_ck")
+        # bit-exact state restore, expert leaves included
+        for name, tree in (("params", engine2.params),
+                           ("master", engine2.master),
+                           ("opt", engine2.opt_state)):
+            if saved[name] is None:
+                continue
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)), tree, saved[name])
+        loss2 = float(engine2(x, y))
+        assert abs(loss2 - loss_ref) <= 1e-6, (loss2, loss_ref)
+        # and the restored engine steps without raising.  The step's
+        # NUMERIC value is deliberately unasserted: the compiled apply of
+        # any engine in this process can mis-execute under the pre-existing
+        # donated-buffer corruption when other packages compiled first
+        # (tests/conftest.py cache notes) — the bit-exact state compare
+        # above already carries the save→restore→step parity guarantee.
+        engine2.backward(loss2)
+        engine2.step()
+        float(engine2(x, y))
+    finally:
+        _teardown()
+
+
+def test_restored_expert_shards_land_on_their_ranks(tmp_path):
+    """After restore on an ep=2 mesh, each expert leaf keeps its P("ep")
+    sharding and each device holds exactly its expert block (device
+    assignment matches the saved engine's)."""
+    engine, x, y = _engine(ep=2)
+    try:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        engine.save_checkpoint(str(tmp_path), tag="shards")
+        want = np.asarray(_expert_leaf(engine.params))
+        want_map = {
+            d.id: np.asarray(s.data)
+            for d, s in zip(
+                [s.device for s in
+                 _expert_leaf(engine.params).addressable_shards],
+                _expert_leaf(engine.params).addressable_shards)}
+    finally:
+        _teardown()
+
+    engine2, x, y = _engine(ep=2)
+    try:
+        engine2.load_checkpoint(str(tmp_path), tag="shards")
+        leaf = _expert_leaf(engine2.params)
+        spec = leaf.sharding.spec
+        names = spec[0] if isinstance(spec[0], tuple) else (spec[0], )
+        assert "ep" in names, spec
+        np.testing.assert_allclose(np.asarray(leaf), want)
+        for s in leaf.addressable_shards:
+            np.testing.assert_allclose(np.asarray(s.data),
+                                       want_map[s.device.id])
+    finally:
+        _teardown()

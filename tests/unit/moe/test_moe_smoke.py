@@ -1,0 +1,68 @@
+"""ISSUE-13 acceptance gate: the expert-parallel MoE engine holds loss
+parity — ep=1 vs ep>1 ≤1e-6 (fp dispatch), flat vs int8 quantized dispatch
+≤1e-2 with convergence, and ``moe.enabled: false`` / ``quantized_dispatch:
+false`` are program-identical to the pre-engine micro-step.  Drives
+``tools/moe_smoke.py`` in-process (same importlib convention as
+``test_comm_smoke.py``)."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "moe_smoke", os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                              "tools", "moe_smoke.py"))
+moe_smoke = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(moe_smoke)
+
+
+def test_moe_loss_parity_gate(monkeypatch):
+    """ep parity, manual-fp parity, int8 dispatch tolerance + convergence,
+    wire-bytes reduction — and the manual dispatch path actually engages
+    for the quantized runs (not a silent fallback to the constraint
+    path)."""
+    from deepspeed_tpu.moe import engine as moe_engine
+    engaged = []
+    orig = moe_engine._quantized_dispatch_combine
+
+    def spy(*a, **k):
+        engaged.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(moe_engine, "_quantized_dispatch_combine", spy)
+    r = moe_smoke.run_moe_smoke(steps=6)
+    assert engaged, "manual quantized dispatch never engaged"
+    assert r["ep_parity_delta"] <= 1e-6, (r["ep1_losses"], r["ep4_losses"])
+    assert r["manual_fp_delta"] <= 1e-6, r["manual_fp_losses"]
+    assert r["quant_final_delta"] <= r["tolerance"], r["quant_losses"]
+    assert r["converged"] and r["dense_sanity"]
+    assert r["wire_reduced"]
+    assert r["pass"]
+
+
+def test_moe_disabled_program_identity():
+    """moe.enabled: false / quantized_dispatch: false == absent block
+    (normalized jaxpr) — the bit-identical contract."""
+    d = moe_smoke.run_disabled_identity()
+    assert d["disabled_identical"]
+    assert d["quantized_dispatch_off_identical"]
+    assert d["pass"]
+
+
+def test_moe_hierarchical_dispatch_gate(monkeypatch):
+    """The 2-hop (split-ep) dispatch engages under a forced intra split
+    and stays within the quantized tolerance."""
+    from deepspeed_tpu.moe import engine as moe_engine
+    hier_picks = []
+    orig = moe_engine.ep_hierarchy
+
+    def spy(mesh, opts=None, ep_axis="ep"):
+        h = orig(mesh, opts, ep_axis)
+        if h is not None:
+            hier_picks.append(h)
+        return h
+
+    monkeypatch.setattr(moe_engine, "ep_hierarchy", spy)
+    h = moe_smoke.run_hier_smoke(steps=6)
+    assert hier_picks, "topology.factor_group never produced a hierarchy"
+    assert h["final_delta"] <= h["tolerance"], h["hier_losses"]
+    assert h["pass"]

@@ -332,7 +332,12 @@ def test_offload_lp_grads_mid_accumulation():
             assert engine.grad_acc is None
         l2 = engine(ids2, ids2); engine.backward(l2); engine.step()
         assert engine.global_steps == 1
-        finals.append(jax.tree_util.tree_map(np.asarray, engine.params))
+        # OWNING copies: np.asarray on the CPU backend returns views that
+        # alias the jax buffers — comparing them after the engine (and its
+        # donated buffers) is torn down is a use-after-free that
+        # intermittently aborts the whole suite (the PR-3 aliasing class)
+        finals.append(jax.tree_util.tree_map(
+            lambda p: np.array(p, copy=True), engine.params))
         groups.reset_mesh()
         dist.destroy_process_group()
     jax.tree_util.tree_map(

@@ -246,3 +246,42 @@ def test_gather_bucket_spans_share_overlap_section(tmp_path):
     assert set(record["overlap"]["bucket_ms"]) == {
         "param_gather/0", "bucket_reduce/0"}
     assert set(record["phases"]) == {"forward"}
+
+
+def test_moe_stats_land_in_step_record(tmp_path):
+    """Routed-token accounting: per-layer stats accumulate over the gas
+    window's micro-batches (mean), land under the record's ``moe`` section
+    with the cross-layer aggregates, and reset at the next step."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.moe_stat("layers_0/moe", {"k": 1, "drop_fraction": 0.2,
+                                  "overflow_tokens": 4.0,
+                                  "load_imbalance": 2.0, "aux_loss": 1.0})
+    rec.moe_stat("layers_0/moe", {"k": 1, "drop_fraction": 0.4,
+                                  "overflow_tokens": 8.0,
+                                  "load_imbalance": 4.0, "aux_loss": 1.2})
+    rec.moe_stat("layers_1/moe", {"k": 2, "drop_fraction": 0.0,
+                                  "overflow_tokens": 0.0,
+                                  "load_imbalance": 1.0, "aux_loss": 0.9})
+    record = rec.end_step()
+    moe = record["moe"]
+    l0 = moe["layers"]["layers_0/moe"]
+    assert abs(l0["drop_fraction"] - 0.3) < 1e-9  # mean of 2 micro-batches
+    assert abs(l0["overflow_tokens"] - 6.0) < 1e-9
+    assert l0["k"] == 1
+    assert moe["layers"]["layers_1/moe"]["k"] == 2
+    assert abs(moe["drop_fraction_mean"] - 0.15) < 1e-9
+    assert abs(moe["load_imbalance_max"] - 3.0) < 1e-9
+    assert abs(moe["aux_loss_total"] - (1.1 + 0.9)) < 1e-9
+    # next step starts clean
+    rec.begin_step(1)
+    record = rec.end_step()
+    assert "moe" not in record
+
+
+def test_moe_stats_without_step_are_dropped(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.moe_stat("moe", {"k": 1, "drop_fraction": 0.5})  # no open step
+    rec.begin_step(0)
+    record = rec.end_step()
+    assert "moe" not in record

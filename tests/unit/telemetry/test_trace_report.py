@@ -214,3 +214,63 @@ def test_gather_sweep_renders_own_table(tmp_path, capsys):
     assert rc == 0
     dirs = [c.get("direction") for c in out["overlap_sweep"]]
     assert dirs.count("gather") == 2
+
+
+MOE_FIXTURE = [
+    {"step": 0, "wall_ms": 10.0, "phases": {"forward": 5.0},
+     "comm": {"total_ms": 0.0, "exposed_ms": 0.0, "ops": {}},
+     "moe": {"layers": {"layers_0/moe": {
+         "k": 1, "drop_fraction": 0.2, "overflow_tokens": 4.0,
+         "load_imbalance": 2.0, "aux_loss": 1.0}},
+         "drop_fraction_mean": 0.2, "load_imbalance_max": 2.0,
+         "aux_loss_total": 1.0}},
+    {"step": 1, "wall_ms": 10.0, "phases": {"forward": 5.0},
+     "comm": {"total_ms": 0.0, "exposed_ms": 0.0, "ops": {}},
+     "moe": {"layers": {"layers_0/moe": {
+         "k": 1, "drop_fraction": 0.4, "overflow_tokens": 8.0,
+         "load_imbalance": 4.0, "aux_loss": 1.2}},
+         "drop_fraction_mean": 0.4, "load_imbalance_max": 4.0,
+         "aux_loss_total": 1.2}},
+]
+
+
+def test_moe_table_rendered_and_summarized(tmp_path):
+    """Step records carrying the ``moe`` section render the routed-token
+    table (per-layer means across steps) and export it in --json."""
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in MOE_FIXTURE))
+    steps = trace_report.load_steps(str(path))
+    summary = trace_report.summarize(steps)
+    layer = summary["moe_layers"]["layers_0/moe"]
+    assert abs(layer["drop_fraction"] - 0.3) < 1e-9
+    assert abs(layer["load_imbalance"] - 3.0) < 1e-9
+    assert summary["moe_steps"] == 2
+    lines = []
+    trace_report.render_report(steps, summary,
+                               print_fn=lambda *a: lines.append(" ".join(
+                                   str(x) for x in a)))
+    text = "\n".join(lines)
+    assert "MoE routed-token accounting" in text
+    assert "layers_0/moe" in text
+    assert "0.300" in text  # mean drop fraction
+
+
+def test_moe_sweep_table_from_comm_summary(tmp_path, capsys):
+    """A ds_bench --moe --trace archive (comm_summary.json ``moe``
+    section) renders the dispatch-sweep table even with no step
+    records."""
+    (tmp_path / "comm_summary.json").write_text(json.dumps({
+        "ops": {}, "moe": [
+            {"op": "moe_dispatch", "direction": "moe", "experts": 8,
+             "capacity_factor": 1.0, "wire_dtype": "gspmd",
+             "drop_fraction": 0.1, "load_imbalance": 1.2,
+             "wire_bytes": 4000, "latency_us": 120.0},
+            {"op": "moe_dispatch", "direction": "moe", "experts": 8,
+             "capacity_factor": 1.0, "wire_dtype": "int8",
+             "drop_fraction": 0.1, "load_imbalance": 1.2,
+             "wire_bytes": 1000, "latency_us": 80.0}]}))
+    rc = trace_report.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "moe dispatch sweep" in out
+    assert "best manual dispatch: wire=int8" in out

@@ -119,6 +119,47 @@ def aggregate_serve(paths):
     return out
 
 
+def aggregate_moe(paths):
+    """Merge expert-dispatch sweep rows (``direction: "moe"`` — ds_bench
+    --moe) across runs: mean latency / drop-fraction / load-imbalance per
+    (experts, capacity_factor, wire_dtype) candidate, fastest first.
+    Coexists with overlap/serve/op rows in mixed archives (their
+    ``direction`` differs and they are skipped here)."""
+    cells = {}
+    for path in paths:
+        payload = _load_ds_bench(path)
+        if payload is None:
+            continue
+        for row in payload["rows"]:
+            if row.get("direction") != "moe":
+                continue
+            # tokens is part of the cell key: archives swept with different
+            # --moe-tokens carry ~payload-proportional latencies and must
+            # not be averaged into one number (the overlap aggregator keys
+            # on its full parameter tuple for the same reason)
+            key = (int(row.get("experts") or 0),
+                   float(row.get("capacity_factor") or 0.0),
+                   int(row.get("tokens") or 0),
+                   row.get("wire_dtype") or "?")
+            c = cells.setdefault(key, {"n": 0, "lat": 0.0, "drop": 0.0,
+                                       "imb": 0.0, "wire_bytes": 0})
+            c["n"] += 1
+            c["lat"] += float(row.get("latency_us") or 0.0)
+            c["drop"] += float(row.get("drop_fraction") or 0.0)
+            c["imb"] += float(row.get("load_imbalance") or 0.0)
+            c["wire_bytes"] = int(row.get("wire_bytes") or 0)
+    out = [{"experts": e, "capacity_factor": cf, "tokens": tok,
+            "wire_dtype": wd,
+            "runs": c["n"], "latency_us": c["lat"] / c["n"],
+            "drop_fraction": c["drop"] / c["n"],
+            "load_imbalance": c["imb"] / c["n"],
+            "wire_bytes": c["wire_bytes"]}
+           for (e, cf, tok, wd), c in cells.items()]
+    out.sort(key=lambda r: (r["experts"], r["capacity_factor"],
+                            r["tokens"], r["latency_us"]))
+    return out
+
+
 # keep in sync with deepspeed_tpu/autotuning/priors.py:PRIORS_SCHEMA (a
 # unit test asserts they match; duplicated so this summarizer stays
 # importable without pulling jax via the package __init__)
@@ -173,6 +214,42 @@ def main(argv=None):
                   f"{r['tbt_p99_ms']:.2f}ms"
                   f"  preempt={r['preemptions']}"
                   f" (n={r['runs']}, {r['requests']} reqs)")
+        print()
+    moe = aggregate_moe(paths)
+    if moe:
+        print("moe dispatch sweep (direction=moe), per (E, cf) fastest "
+              "wire first:")
+        for r in moe:
+            print(f"  E={r['experts']:<4} cf={r['capacity_factor']:<4g} "
+                  f"wire={r['wire_dtype']:<6}"
+                  f" lat={r['latency_us']:10.1f}us"
+                  f" drop={r['drop_fraction']:.3f}"
+                  f" imb={r['load_imbalance']:.2f}"
+                  f" (n={r['runs']})")
+        # suggest the wire with the best PER-CELL speedup over that cell's
+        # own gspmd baseline (raw cross-cell latency would let the
+        # smallest-payload cell decide); "the measurements say keep the
+        # default" must never print an enable-me block
+        baselines = {(r["experts"], r["capacity_factor"], r["tokens"]):
+                     r["latency_us"]
+                     for r in moe if r["wire_dtype"] == "gspmd"}
+        best, best_speedup = None, 1.0
+        for r in moe:
+            if r["wire_dtype"] in ("gspmd", "fp32"):
+                continue
+            base = baselines.get((r["experts"], r["capacity_factor"],
+                                  r["tokens"]))
+            if not base or r["latency_us"] <= 0:
+                continue
+            speedup = base / r["latency_us"]
+            if speedup > best_speedup:
+                best, best_speedup = r, speedup
+        if best is not None:
+            print(f"  → suggested moe block: {{\"enabled\": true, "
+                  f"\"quantized_dispatch\": true, "
+                  f"\"wire_dtype\": \"{best['wire_dtype']}\"}} "
+                  f"({best_speedup:.2f}x vs gspmd at E={best['experts']} "
+                  f"cf={best['capacity_factor']:g})")
         print()
     overlap = aggregate_overlap(paths)
     if overlap:

@@ -113,6 +113,28 @@ def summarize(steps):
             agg["wire_bytes"] += row.get("wire_bytes", 0)
             agg["hidden_ms"] += row.get("hidden_ms", 0.0)
         tokens_total += rec.get("metrics", {}).get("tokens", 0)
+    # MoE routed-token accounting: per-layer means across steps
+    moe_layers = {}
+    moe_steps = 0
+    for rec in steps:
+        layers = rec.get("moe", {}).get("layers")
+        if not layers:
+            continue
+        moe_steps += 1
+        for name, st in layers.items():
+            agg = moe_layers.setdefault(name, {
+                "n": 0, "k": int(st.get("k", 1)), "drop_fraction": 0.0,
+                "overflow_tokens": 0.0, "load_imbalance": 0.0,
+                "aux_loss": 0.0})
+            agg["n"] += 1
+            for key in ("drop_fraction", "overflow_tokens",
+                        "load_imbalance", "aux_loss"):
+                agg[key] += float(st.get(key, 0.0))
+    for agg in moe_layers.values():
+        n = max(1, agg.pop("n"))
+        for key in ("drop_fraction", "overflow_tokens", "load_imbalance",
+                    "aux_loss"):
+            agg[key] /= n
     for agg in comm_ops.values():
         agg["avg_ms"] = agg["total_ms"] / max(1, agg["count"])
         comm_ms = agg["total_ms"] + agg.get("hidden_ms", 0.0)
@@ -134,6 +156,8 @@ def summarize(steps):
         "fused_steps": fused_steps,
         "comm_attribution_unavailable": bool(n and fused_steps == n),
         "comm_ops": comm_ops,
+        "moe_layers": moe_layers,
+        "moe_steps": moe_steps,
         "tokens_total": tokens_total,
         "tokens_per_sec": (tokens_total / (wall_total / 1e3)
                            if wall_total > 0 and tokens_total else 0.0),
@@ -208,6 +232,53 @@ def render_report(steps, summary, last=None, print_fn=print):
     for key, agg in sorted(summary["comm_ops"].items()):
         print_fn(f"{key:<34}{agg['count']:>7}{agg['avg_ms']:>10.3f}"
                  f"{_fmt_bytes(agg['wire_bytes']):>10}{agg['gbps']:>10.2f}")
+    moe_layers = summary.get("moe_layers") or {}
+    if moe_layers:
+        print_fn("")
+        print_fn(f"== MoE routed-token accounting "
+                 f"(mean over {summary.get('moe_steps', 0)} steps) ==")
+        print_fn(f"{'layer':<28}{'k':>3}{'drop_frac':>11}{'overflow':>10}"
+                 f"{'imbalance':>11}{'aux_loss':>10}")
+        for name, st in sorted(moe_layers.items()):
+            print_fn(f"{name:<28}{st.get('k', 1):>3}"
+                     f"{st['drop_fraction']:>11.3f}"
+                     f"{st['overflow_tokens']:>10.1f}"
+                     f"{st['load_imbalance']:>11.2f}"
+                     f"{st['aux_loss']:>10.4f}")
+    moe_sweep = summary.get("moe_sweep") or []
+    if moe_sweep:
+        print_fn("")
+        print_fn("== moe dispatch sweep (E × capacity_factor × wire) ==")
+        print_fn(f"{'experts':>8}{'cf':>6}{'wire':>8}{'drop_frac':>11}"
+                 f"{'imbalance':>11}{'wire_bytes':>12}{'latency_us':>12}")
+        for c in moe_sweep:
+            print_fn(f"{c.get('experts', 0):>8}"
+                     f"{c.get('capacity_factor', 0):>6g}"
+                     f"{c.get('wire_dtype', '-'):>8}"
+                     f"{c.get('drop_fraction', 0.0):>11.3f}"
+                     f"{c.get('load_imbalance', 0.0):>11.2f}"
+                     f"{c.get('wire_bytes', 0):>12}"
+                     f"{c.get('latency_us', 0.0):>12.1f}")
+        # best = the wire with the best PER-CELL speedup over its own
+        # (E, cf) gspmd baseline — raw cross-cell latency would let the
+        # smallest-payload cell decide (same rule as fold_sweeps)
+        baselines = {(c.get("experts"), c.get("capacity_factor")):
+                     c.get("latency_us")
+                     for c in moe_sweep if c.get("wire_dtype") == "gspmd"}
+        best, best_speedup = None, 1.0
+        for c in moe_sweep:
+            if c.get("wire_dtype") in ("gspmd", None):
+                continue
+            base = baselines.get((c.get("experts"),
+                                  c.get("capacity_factor")))
+            lat = c.get("latency_us")
+            if base and lat and base / lat > best_speedup:
+                best, best_speedup = c, base / lat
+        if best is not None:
+            print_fn(f"best manual dispatch: wire={best.get('wire_dtype')} "
+                     f"E={best.get('experts')} "
+                     f"cf={best.get('capacity_factor') or 0:g} "
+                     f"({best_speedup:.2f}x vs gspmd)")
     sweep = summary.get("overlap_sweep") or []
     # one table per sweep direction; rows predating the gather direction
     # have no "direction" field and count as reduce
@@ -267,6 +338,9 @@ def main(argv=None):
         # ds_bench overlap sweep: per-bucket-size overlap-efficiency rows
         # (the autotuner's bucket-size feed)
         summary["overlap_sweep"] = archived["overlap"]
+    if archived.get("moe"):
+        # ds_bench --moe sweep: expert-dispatch candidates
+        summary["moe_sweep"] = archived["moe"]
     if not steps:
         # steps-less trace (ds_bench --trace): report from the archived
         # comm attribution alone instead of bailing
