@@ -330,6 +330,75 @@ class Autotuner:
                         f"{self.cfg.priors_file}")
         return exps
 
+    # ---------------------------------------------- memory-feasibility filter
+    def memory_feasibility_filter(self, exps):
+        """Drop candidates whose STATIC model-state estimate already
+        exceeds per-chip device memory — a trial that is guaranteed to OOM
+        is a wasted slot in the budget (``profiling/mem_estimator``, the
+        reference ``estimate_zero*_model_states_mem_needs`` put to work).
+        Pinned candidates (the hand-written default, the user's own block)
+        are NEVER dropped: they anchor the ≤-default acceptance even when
+        the filter thinks they are doomed — in that case it warns and lets
+        the measured trial deliver the verdict.  No-op when the model size
+        or the memory limit is unknown (CPU smoke boxes report host RAM,
+        which tiny models never exceed)."""
+        n = (self.model_info or {}).get("num_params", 0)
+        try:
+            from ..accelerator import get_accelerator
+            total = get_accelerator().total_memory()
+        except Exception:
+            total = 0
+        if not n or not total:
+            return exps
+        from ..profiling.mem_estimator import estimate_zero_states
+        import jax
+        world = max(1, len(jax.devices()))
+        kept, dropped = [], []
+        for exp in exps:
+            ds = exp.get("ds_config") or {}
+            stage = int((ds.get("zero_optimization") or {}).get("stage", 0))
+            mesh = ds.get("mesh") or {}
+            model_par = 1
+            for ax in ("tp", "sp", "pp"):
+                model_par *= max(1, int(mesh.get(ax, 1) or 1))
+            ep = max(1, int(mesh.get("ep", 1) or 1))
+            dp = max(1, world // (model_par * ep))
+            cb = 2 if ((ds.get("fp16") or {}).get("enabled")
+                       or (ds.get("bfloat16") or {}).get("enabled")
+                       or (ds.get("bf16") or {}).get("enabled")) else 4
+            # model parallelism divides the resident dense states too
+            est = estimate_zero_states(
+                n, stage, dp, ep=ep,
+                compute_dtype=cb)["total_bytes"] / model_par
+            if est > total and not exp.get("pinned"):
+                dropped.append((exp["name"], est))
+                continue
+            if est > total:
+                logger.warning(
+                    "autotuning: pinned candidate %s statically needs "
+                    "%.2f GiB of %.2f GiB HBM — kept (it anchors the "
+                    "baseline) but expect the trial to OOM",
+                    exp["name"], est / 2**30, total / 2**30)
+            kept.append(exp)
+        if dropped:
+            logger.warning(
+                "autotuning: memory-feasibility filter rejected %d of %d "
+                "candidates before trials (model states exceed %.2f GiB "
+                "per chip): %s", len(dropped), len(exps), total / 2**30,
+                ", ".join(f"{name} ({est / 2**30:.2f} GiB)"
+                          for name, est in dropped[:8])
+                + (" …" if len(dropped) > 8 else ""))
+        if not kept and exps:
+            # never hand the tuner an empty space: keep the first
+            # candidate (highest-stage spaces shard the most — the legacy
+            # grid orders by stage) and let the measured trial decide
+            logger.warning(
+                "autotuning: every candidate failed the memory-"
+                "feasibility estimate — keeping %s so the search can "
+                "still report a measured verdict", exps[0]["name"])
+            kept = [exps[0]]
+        return kept
+
     # ----------------------------------------------------------- experiment
     def _run_experiment(self, exp):
         import jax
@@ -441,6 +510,7 @@ class Autotuner:
             exps = self.build_tuning_space()
             metric, tie = c.metric, None
             mode = "min" if metric in MIN_METRICS else "max"
+        exps = self.memory_feasibility_filter(exps)
         tuner_cls = TUNERS.get(c.tuner_type, GridSearchTuner)
         kw = {}
         if tuner_cls is ModelBasedTuner:

@@ -197,7 +197,8 @@ def bench_row(**fields):
            "latency_us": None, "iqr_us": None, "repeat": None,
            "wire_dtype": None, "algbw_gbps": None, "busbw_gbps": None,
            "bucket_mb": None, "direction": None,
-           "overlap_efficiency": None, "exposed_comm_frac": None}
+           "overlap_efficiency": None, "exposed_comm_frac": None,
+           "mfu": None, "peak_hbm_bytes": None}
     row.update(fields)
     return row
 
@@ -316,8 +317,9 @@ def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
     out_grads = P(axis)  # both hops scatter the reduced shard over axis
     args = (x, w, tuple(grads))
     t_compute = _timed(sm(compute_only, P()), args, iters, warmup)
-    t_step = _timed(sm(overlapped, (P(), tuple(out_grads for _ in grads))),
-                    args, iters, warmup)
+    fn_step, step_analysis = _aot_with_analysis(
+        sm(overlapped, (P(), tuple(out_grads for _ in grads))), args)
+    t_step = _timed(fn_step, args, iters, warmup)
     t_mono = _timed(sm(monolithic, (P(), tuple(out_grads for _ in grads))),
                     args, iters, warmup)
     # comm-only, per bucket — the trace carries real per-bucket costs
@@ -342,17 +344,49 @@ def _overlap_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
         wire_bytes = Q.quantized_wire_bytes(elems, wire, GROUP_SIZE) * layers
     return _candidate_row("reduce", bucket_mb, wire, len(buckets), elems,
                           layers, wire_bytes, t_compute, t_comm, t_step,
-                          t_mono)
+                          t_mono,
+                          cost_fields=_step_cost_fields(step_analysis,
+                                                        t_step))
+
+
+def _aot_with_analysis(fn, args):
+    """Compile a candidate's stepped program ONCE (ahead-of-time) and
+    return ``(executable, analysis)`` — the SAME executable is then timed,
+    so the cost fields describe exactly what ran and the sweep pays no
+    second analysis compile (jit's lazy path + a separate ``analyze_fn``
+    would compile every candidate twice).  Falls back to the lazy-jit
+    callable with empty analysis where AOT is unavailable."""
+    from ..profiling import cost_model
+    try:
+        compiled = fn.lower(*args).compile()
+        return compiled, cost_model.analyze_compiled(compiled)
+    except Exception:
+        return fn, {"flops": None, "peak_hbm_bytes": None}
+
+
+def _step_cost_fields(analysis, t_step):
+    """Row fields from a stepped program's analysis: mfu = XLA's per-chip
+    flop count over the measured step time ÷ peak, plus the static
+    peak-HBM estimate (None-safe on backends without the cost model)."""
+    from ..profiling import cost_model
+    flops = analysis.get("flops")
+    return {
+        "mfu": cost_model.mfu(flops / t_step
+                              if flops and t_step > 0 else None),
+        "peak_hbm_bytes": analysis.get("peak_hbm_bytes"),
+    }
 
 
 def _candidate_row(direction, bucket_mb, wire, n_buckets, elems, layers,
-                   wire_bytes, t_compute, t_comm, t_step, t_mono):
+                   wire_bytes, t_compute, t_comm, t_step, t_mono,
+                   cost_fields=None):
     """Shared overlap-candidate accounting: exposed = step − compute,
     hidden = comm − exposed, efficiency = hidden / comm — identical for
     the reduce (backward) and gather (forward prefetch) directions."""
     exposed = max(0.0, t_step - t_compute)
     hidden = min(t_comm, max(0.0, t_comm - exposed))
-    return {
+    row = dict(cost_fields or {})
+    row.update({
         "op": "overlap",
         "direction": direction,
         "bucket_mb": float(bucket_mb),
@@ -369,7 +403,8 @@ def _candidate_row(direction, bucket_mb, wire, n_buckets, elems, layers,
         "exposed_ms": exposed * 1e3,
         "exposed_comm_frac": (exposed / t_step if t_step > 0 else 0.0),
         "overlap_efficiency": (hidden / t_comm if t_comm > 0 else 1.0),
-    }
+    })
+    return row
 
 
 def _gather_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
@@ -444,7 +479,9 @@ def _gather_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
     out_full = tuple(P() for _ in params)  # gathered: replicated over axis
     args = (x, w, tuple(params))
     t_compute = _timed(sm(compute_only, P()), args, iters, warmup)
-    t_step = _timed(sm(prefetched, (P(), out_full)), args, iters, warmup)
+    fn_step, step_analysis = _aot_with_analysis(
+        sm(prefetched, (P(), out_full)), args)
+    t_step = _timed(fn_step, args, iters, warmup)
     t_mono = _timed(sm(monolithic, (P(), out_full)), args, iters, warmup)
     t_comm = 0.0
     for b in buckets:
@@ -468,7 +505,9 @@ def _gather_candidate(mesh, axis, bucket_mb, wire, total_bytes, layers,
         wire_bytes = Q.quantized_wire_bytes(elems, wire, GROUP_SIZE) * layers
     return _candidate_row("gather", bucket_mb, wire, len(buckets), elems,
                           layers, wire_bytes, t_compute, t_comm, t_step,
-                          t_mono)
+                          t_mono,
+                          cost_fields=_step_cost_fields(step_analysis,
+                                                        t_step))
 
 
 def run_overlap_sweep(axis="dp", mesh=None, bucket_mbs=OVERLAP_BUCKET_MBS,

@@ -398,13 +398,25 @@ class InferenceEngineV2:
         if batch is None:
             return {}
         toks, pos, slots, last_idx, finishing, layout = batch
-        logits, self._kv = self._step_fn(
-            self.params, self._kv, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(slots),
-            jnp.asarray(self.state_manager.block_table),
-            jnp.asarray(last_idx), cfg=self.model_config,
-            block_size=self.kv_cache.block_size, layout=layout,
-            use_kernel=self._tp == 1, kv_dtype=self._kv_dtype)
+        step_args = (self.params, self._kv, jnp.asarray(toks),
+                     jnp.asarray(pos), jnp.asarray(slots),
+                     jnp.asarray(self.state_manager.block_table),
+                     jnp.asarray(last_idx))
+        step_kw = dict(cfg=self.model_config,
+                       block_size=self.kv_cache.block_size, layout=layout,
+                       use_kernel=self._tp == 1, kv_dtype=self._kv_dtype)
+        from ...profiling import cost_model
+        if cost_model.capturing():
+            # compiled-cost capture of the serving prefill/decode program
+            # (one analysis compile per distinct layout, only while
+            # capture is armed — docs/observability.md "MFU & HBM");
+            # layout (0,0) is the flat/decode-heavy program, (d,a) the
+            # atom-tiled prefill one
+            cost_model.capture_jit_call(
+                f"serve/ragged_step[{layout[0]}x{layout[1]}]",
+                self._step_fn, step_args, step_kw,
+                meta={"layout": list(layout)})
+        logits, self._kv = self._step_fn(*step_args, **step_kw)
         out = {}
         if finishing:
             if do_sample:
@@ -524,14 +536,22 @@ class InferenceEngineV2:
             self._burst_key, key = jax.random.split(self._burst_key)
         else:
             key = None
-        toks_out, self._kv = decode_burst(
-            self.params, self._kv, jnp.asarray(tok0), jnp.asarray(pos0),
-            jnp.asarray(act), jnp.asarray(sm.block_table),
-            step_fn=self._step_fn, cfg=self.model_config,
-            block_size=self.kv_cache.block_size, k=k,
-            use_kernel=self._tp == 1, sample=sample, key=key,
-            temperature=float(temperature), top_k=int(top_k),
-            top_p=float(top_p), kv_dtype=self._kv_dtype)
+        burst_args = (self.params, self._kv, jnp.asarray(tok0),
+                      jnp.asarray(pos0), jnp.asarray(act),
+                      jnp.asarray(sm.block_table))
+        burst_kw = dict(step_fn=self._step_fn, cfg=self.model_config,
+                        block_size=self.kv_cache.block_size, k=k,
+                        use_kernel=self._tp == 1, sample=sample, key=key,
+                        temperature=float(temperature), top_k=int(top_k),
+                        top_p=float(top_p), kv_dtype=self._kv_dtype)
+        from ...profiling import cost_model
+        if cost_model.capturing():
+            # k is static (pow2-quantized above), so the burst variants are
+            # a bounded program family worth tabulating per k
+            cost_model.capture_jit_call(
+                f"serve/decode_burst[k={k}]", decode_burst, burst_args,
+                burst_kw, meta={"k": int(k)})
+        toks_out, self._kv = decode_burst(*burst_args, **burst_kw)
         toks_out = np.asarray(toks_out)      # ONE fetch for k×seqs tokens
         self.burst_steps = getattr(self, "burst_steps", 0) + 1
         out = {}

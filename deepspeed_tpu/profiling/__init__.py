@@ -1,1 +1,2 @@
 from .flops_profiler import FlopsProfiler, get_model_profile
+from . import cost_model, mem_estimator  # noqa: F401

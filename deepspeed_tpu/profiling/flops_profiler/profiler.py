@@ -1,163 +1,33 @@
-"""Flops profiler — TPU rebuild of reference
-``profiling/flops_profiler/profiler.py`` (``FlopsProfiler`` :30,
-``print_model_profile`` :286, analytic per-op flops :518+).
+"""Flops profiler — the user-facing façade over ``profiling/cost_model``
+(TPU rebuild of reference ``profiling/flops_profiler/profiler.py``:
+``FlopsProfiler`` :30, ``print_model_profile`` :286).
 
-The reference patches ~50 torch functions and installs module hooks to count
-MACs per submodule.  Under XLA the program is a jaxpr, so the profiler walks
-the jaxpr instead: exact static shapes, no patching, and scan/remat bodies
-are counted with their trip counts.  Two complementary sources:
+The reference patches ~50 torch functions and installs module hooks to
+count MACs per submodule.  Under XLA the program is a jaxpr/HLO, so the
+canonical machinery lives in :mod:`deepspeed_tpu.profiling.cost_model`
+since PR 14 and this module is its presentation layer.  Two sources:
 
-* **analytic** — per-equation flop formulas (dot_general/conv/elementwise),
-  grouped by the function name-stack → a per-module tree like the reference's
-  module profile;
-* **compiled** — ``jit(fn).lower().compile().cost_analysis()`` gives XLA's
-  own flops + bytes-accessed estimate for the optimized HLO (post-fusion),
-  the number the MFU/TFLOPS report should use.
+* **analytic** (``cost_model.jaxpr_flops``) — per-equation flop formulas
+  grouped by the flax name-stack → the per-module tree the reference
+  builds from hooks;
+* **compiled** (``cost_model.analyze_fn``) — XLA's own ``cost_analysis``
+  (post-fusion flops + bytes accessed) and ``memory_analysis`` (static
+  peak-HBM estimate) of the optimized executable — the numbers the
+  MFU/TFLOPS report should use.  Absent on a backend → analytic fallback
+  with a once-per-process warning (never raises).
 
 Latency comes from timing the compiled step like ``ThroughputTimer``.
 """
 
 import time
-from collections import defaultdict
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
-
-# ---------------------------------------------------------------- analytic
-_ELEMENTWISE_1 = {
-    "add", "sub", "mul", "div", "max", "min", "pow", "and", "or", "xor",
-    "neg", "abs", "floor", "ceil", "round", "sign", "select_n",
-    "clamp", "rem", "nextafter",
-}
-_ELEMENTWISE_TRANSCENDENTAL = {
-    "exp", "log", "log1p", "expm1", "sin", "cos", "tan", "tanh", "logistic",
-    "erf", "erfc", "erf_inv", "rsqrt", "sqrt", "cbrt", "atan2", "sigmoid",
-}
-_REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
-           "reduce_and", "reduce_or", "argmax", "argmin", "cumsum",
-           "cumlogsumexp", "cummax", "cummin", "cumprod"}
-
-
-def _out_size(eqn):
-    if not eqn.outvars:
-        return 0
-    v = eqn.outvars[0]
-    aval = getattr(v, "aval", None)
-    if aval is None or not hasattr(aval, "shape"):
-        return 0
-    return int(np.prod(aval.shape)) if aval.shape else 1
-
-
-def _dot_general_flops(eqn):
-    a, b = eqn.invars[0].aval, eqn.invars[1].aval
-    dnums = eqn.params["dimension_numbers"]
-    (lc, rc), (lb, rb) = dnums
-    batch = int(np.prod([a.shape[i] for i in lb])) if lb else 1
-    contract = int(np.prod([a.shape[i] for i in lc])) if lc else 1
-    m = int(np.prod([a.shape[i] for i in range(a.ndim)
-                     if i not in set(lc) | set(lb)]))
-    n = int(np.prod([b.shape[i] for i in range(b.ndim)
-                     if i not in set(rc) | set(rb)]))
-    return 2 * batch * m * n * contract
-
-
-def _conv_flops(eqn):
-    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
-    out = eqn.outvars[0].aval
-    fgc = eqn.params.get("feature_group_count", 1)
-    # out_elems * (2 * kernel_spatial * in_channels/groups)
-    kernel_elems = int(np.prod(rhs.shape[2:])) if rhs.ndim > 2 else 1
-    # rhs layout: (out_c, in_c/g, *spatial) in dimension_numbers-normalized form
-    in_c_per_group = rhs.shape[1] if rhs.ndim > 1 else 1
-    return 2 * int(np.prod(out.shape)) * kernel_elems * in_c_per_group
-
-
-def _eqn_flops(eqn):
-    """(flops, macs) for one jaxpr equation."""
-    prim = eqn.primitive.name
-    if prim == "dot_general":
-        f = _dot_general_flops(eqn)
-        return f, f // 2
-    if prim in ("conv_general_dilated", ):
-        f = _conv_flops(eqn)
-        return f, f // 2
-    if prim in _ELEMENTWISE_1:
-        return _out_size(eqn), 0
-    if prim in _ELEMENTWISE_TRANSCENDENTAL:
-        return 4 * _out_size(eqn), 0  # transcendental ≈ several flops each
-    if prim in _REDUCE:
-        size = eqn.invars[0].aval
-        n = int(np.prod(size.shape)) if hasattr(size, "shape") and size.shape else 1
-        return n, 0
-    if prim == "integer_pow":
-        return _out_size(eqn), 0
-    return 0, 0
-
-
-def _walk_jaxpr(jaxpr, scale=1, scope="", acc=None):
-    """Recursively accumulate (flops, macs) per scope from a jaxpr."""
-    if acc is None:
-        acc = defaultdict(lambda: [0, 0])
-    for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
-        # nested jaxprs
-        if prim == "scan":
-            inner = eqn.params["jaxpr"].jaxpr
-            _walk_jaxpr(inner, scale * eqn.params.get("length", 1),
-                        scope, acc)
-            continue
-        if prim == "while":
-            inner = eqn.params["body_jaxpr"].jaxpr
-            _walk_jaxpr(inner, scale, scope, acc)  # trip count unknown: 1×
-            continue
-        if prim == "cond":
-            branches = eqn.params.get("branches", ())
-            if branches:  # count the largest branch
-                best = defaultdict(lambda: [0, 0])
-                for br in branches:
-                    tmp = _walk_jaxpr(br.jaxpr, scale, scope,
-                                      defaultdict(lambda: [0, 0]))
-                    if sum(v[0] for v in tmp.values()) > \
-                            sum(v[0] for v in best.values()):
-                        best = tmp
-                for k, v in best.items():
-                    acc[k][0] += v[0]
-                    acc[k][1] += v[1]
-            continue
-        if prim in ("pjit", "closed_call", "custom_jvp_call",
-                    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
-                    "checkpoint", "custom_partitioning", "shard_map"):
-            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
-                or eqn.params.get("fun_jaxpr")
-            if sub is not None:
-                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
-                name = eqn.params.get("name", "")
-                sub_scope = f"{scope}/{name}" if name and name != "<lambda>" \
-                    else scope
-                _walk_jaxpr(inner, scale, sub_scope, acc)
-            continue
-        f, m = _eqn_flops(eqn)
-        if f:
-            # group by name stack when present (flax module scopes)
-            st = str(eqn.source_info.name_stack) if hasattr(
-                eqn.source_info, "name_stack") else ""
-            key = f"{scope}/{st}" if st else (scope or "/")
-            acc[key][0] += f * scale
-            acc[key][1] += m * scale
-    return acc
-
-
-def jaxpr_flops(fn, *args, **kwargs):
-    """(total_flops, total_macs, per_scope dict) for fn(*args) by analytic
-    jaxpr walk."""
-    closed = jax.make_jaxpr(fn)(*args, **kwargs)
-    acc = _walk_jaxpr(closed.jaxpr)
-    total_f = sum(v[0] for v in acc.values())
-    total_m = sum(v[1] for v in acc.values())
-    return total_f, total_m, {k: tuple(v) for k, v in acc.items()}
+# canonical home: cost_model (re-exported here for the public API and the
+# engine's profile hook)
+from ..cost_model import analyze_fn, jaxpr_flops  # noqa: F401
 
 
 def _count_params(tree):
@@ -193,6 +63,7 @@ class FlopsProfiler:
         self.per_scope = {}
         self.xla_flops = None
         self.xla_bytes = None
+        self.xla_peak_hbm = None
         self.step_flops = None  # fused fwd+bwd+update count, when profiled
         self._started = None
 
@@ -217,22 +88,18 @@ class FlopsProfiler:
     def profile(self, fn, *args, compile_xla=True, **kwargs):
         """Analytic jaxpr walk of ``fn`` (forward counts); ``compile_xla``
         additionally compiles for XLA's own post-fusion estimate — skip it
-        when a compiled executable already exists (the engine path does)."""
+        when a compiled executable already exists (the engine path does:
+        its programs land in ``cost_model.registry()`` at compile time)."""
         self.flops, self.macs, self.per_scope = jaxpr_flops(fn, *args, **kwargs)
         params = kwargs.get("params") if kwargs else None
         if params is None and args and isinstance(args[0], dict):
             params = args[0]
         self.params = _count_params(params) if params is not None else 0
         if compile_xla:
-            try:
-                compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-                ca = compiled.cost_analysis()
-                if isinstance(ca, (list, tuple)):
-                    ca = ca[0] if ca else {}
-                self.xla_flops = ca.get("flops")
-                self.xla_bytes = ca.get("bytes accessed")
-            except Exception:
-                self.xla_flops = None
+            analysis = analyze_fn(fn, *args, **kwargs)
+            self.xla_flops = analysis.get("flops")
+            self.xla_bytes = analysis.get("bytes_accessed")
+            self.xla_peak_hbm = analysis.get("peak_hbm_bytes")
         return self.flops, self.macs, self.params
 
     def measure_latency(self, fn, *args, iters=3, **kwargs):
@@ -292,6 +159,8 @@ class FlopsProfiler:
             lines.append(f"flops (XLA optimized):     {_num_fmt(self.xla_flops, 'FLOPs')}")
         if self.xla_bytes:
             lines.append(f"HBM bytes (XLA):           {_num_fmt(self.xla_bytes, 'B')}")
+        if self.xla_peak_hbm:
+            lines.append(f"static peak HBM (XLA):     {_num_fmt(self.xla_peak_hbm, 'B')}")
         if self.latency:
             lines.append(f"latency:                   {self.get_total_duration(True)}")
             tput = self.flops / self.latency if self.latency else 0

@@ -456,6 +456,16 @@ class DeepSpeedConfig:
             "train_micro_batch_size_per_gpu", None)
         self.gradient_accumulation_steps = pd.get("gradient_accumulation_steps", None)
         self.steps_per_print = pd.get("steps_per_print", 10)
+        # tokens per sample, for the telemetry step records' token-rate
+        # metrics (docs/observability.md "MFU & HBM").  Unset, the engine
+        # assumes axis 1 of the first input is the sequence — loudly.
+        self.sequence_length = pd.get("sequence_length", None)
+        if self.sequence_length is not None:
+            if not isinstance(self.sequence_length, int) or \
+                    self.sequence_length <= 0:
+                raise DeepSpeedConfigError(
+                    f"sequence_length must be a positive int, got "
+                    f"{self.sequence_length!r}")
         self.dump_state = pd.get("dump_state", False)
         self.disable_allgather = pd.get("disable_allgather", False)
         self.communication_data_type = pd.get("communication_data_type", None)

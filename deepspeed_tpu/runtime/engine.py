@@ -161,6 +161,8 @@ class DeepSpeedEngine:
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_eval = {}
+        self._micro_cost = {}     # shape key → cost-model entry (MFU feed)
+        self._apply_cost = None
         # compression / user hooks
         self._param_transforms = []   # differentiable params→params, in fwd
         self._post_step_hooks = []    # called after each optimizer step
@@ -384,6 +386,16 @@ class DeepSpeedEngine:
         # finite-grad guard; disabled (default) every emit site below is a
         # single module-attribute check.
         self._tel_step_tokens = 0
+        self._tel_step_flops = 0.0       # Σ compiled flops this boundary
+        self._tel_flops_incomplete = False
+        self._mem_planner_emitted = False
+        # "sequence_length" (top-level config key, docs/observability.md):
+        # tokens per sample for the step records' token accounting.  Unset,
+        # the engine ASSUMES axis 1 of inputs[0] is the sequence — loudly,
+        # once (see _count_batch_tokens); token-rate metrics are omitted
+        # (None, not garbage) when no defensible count exists.
+        self.sequence_length = config.sequence_length
+        self._seq_len_warned = False
         tc = config.telemetry_config
         if tc.enabled:
             _telemetry.configure(tc, monitor=self.monitor,
@@ -1106,6 +1118,8 @@ class DeepSpeedEngine:
         self._compiled_micro = {}
         self._compiled_apply = None
         self._compiled_eval = {}
+        self._micro_cost = {}
+        self._apply_cost = None
 
     def _effective_apply_fn(self, with_pld=True):
         """apply_fn with registered param transforms composed in — the single
@@ -1286,11 +1300,54 @@ class DeepSpeedEngine:
 
         return micro
 
+    def _micro_variant(self):
+        """Short tag of which micro-step flavor is compiled — the cost
+        model's program names distinguish the overlap/prefetch/qgZ
+        variants the ISSUE-14 observability tracks."""
+        if self._onebit_opt is not None:
+            return "1bit"
+        zc = self._config.zero_config
+        co = self._config.comm_optimizations_config
+        co_on = getattr(co, "enabled", False)
+        if zc.zero_quantized_gradients or (co_on and co.quantized_gradients):
+            return "qgZ"
+        from .zero.overlap import overlap_opts, prefetch_opts
+        parts = []
+        if overlap_opts(co) is not None:
+            parts.append("overlap")
+        if prefetch_opts(co) is not None and self.zero_stage >= 3:
+            parts.append("prefetch")
+        if (zc.zero_quantized_weights or (co_on and co.quantized_weights)) \
+                and self.zero_stage >= 3:
+            parts.append("qwZ")
+        return "+".join(parts) if parts else "flat"
+
     def _get_compiled_micro(self, inputs):
         key = tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
         if key not in self._compiled_micro:
             micro = self._micro_step_fn()
-            self._compiled_micro[key] = jax.jit(micro)
+            # compile ahead-of-time (the same single compile jit would do
+            # lazily) so XLA's cost/memory analysis of the EXACT training
+            # executable lands in the cost-model registry — MFU/HBM
+            # observability and the once-per-compile OOM-margin warning
+            # (docs/observability.md "MFU & HBM"); falls back to plain jit
+            # if the AOT path is unavailable on this backend
+            from ..profiling import cost_model
+            args = (self.params, self.scale_state.scale, inputs)
+            fn, entry = cost_model.capture_jit(
+                f"train/micro_step[{self._micro_variant()}]"
+                + (f"#{len(self._compiled_micro)}"
+                   if self._compiled_micro else ""),
+                jax.jit(micro), args,
+                # the analytic walk counts the GLOBAL logical program; the
+                # registry convention is per-device flops (what each chip
+                # executes under SPMD), so scale by the device count
+                fallback_flops=lambda: cost_model.jaxpr_flops(
+                    micro, *args)[0] / max(1, jax.device_count()),
+                meta={"zero_stage": self.zero_stage,
+                      "gas": self.gradient_accumulation_steps()})
+            self._compiled_micro[key] = fn
+            self._micro_cost[key] = entry
         return self._compiled_micro[key]
 
     def _accumulate_fn(self):
@@ -1352,6 +1409,25 @@ class DeepSpeedEngine:
             new_target = sel(new_target, target)
             new_opt = sel(new_opt, opt_state)
 
+            # Pin the OUTPUT layouts to the plan: without these constraints
+            # XLA picks the master/optimizer output shardings freely and
+            # (observed on the pinned jaxlib) returns them REPLICATED — the
+            # ZeRO-1/2 state partition silently evaporated after the first
+            # boundary, inflating steady-state HBM by ~Nx and forcing a
+            # second apply-step compile on the de-sharded inputs.  Found by
+            # the PR-14 compiled-cost capture (the AOT executable rejected
+            # its own second call).
+            from .zero.partition import path_str as _path_str
+            new_target = jax.tree_util.tree_map(
+                lambda t, s: jax.lax.with_sharding_constraint(t, s),
+                new_target, plan.master_shardings(new_target))
+            new_opt = jax.tree_util.tree_map_with_path(
+                lambda kp, x: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(
+                        plan.state_mesh,
+                        plan.master_spec(x.shape, _path_str(kp)))),
+                new_opt)
+
             if has_master:
                 new_master = new_target
                 new_params = jax.tree_util.tree_map(
@@ -1369,10 +1445,30 @@ class DeepSpeedEngine:
 
         return apply
 
-    def _get_compiled_apply(self):
+    def _get_compiled_apply(self, args=None):
         if self._compiled_apply is None:
-            self._compiled_apply = jax.jit(
+            jitted = jax.jit(
                 self._apply_update_fn(), donate_argnums=(0, 1, 2, 3, 4))
+            if args is not None:
+                # AOT capture like the micro-step: the boundary update's
+                # executable is where ALL model states are live at once —
+                # its memory_analysis is the static figure the mem-
+                # estimator planner is checked against (donation aliasing
+                # is subtracted by the analysis)
+                from ..profiling import cost_model
+                apply_fn = self._apply_update_fn()
+                fn, entry = cost_model.capture_jit(
+                    "train/apply_update", jitted, args,
+                    # per-device convention, like the micro fallback —
+                    # keeps MFU available (not refused) on backends
+                    # without cost_analysis()
+                    fallback_flops=lambda: cost_model.jaxpr_flops(
+                        apply_fn, *args)[0] / max(1, jax.device_count()),
+                    meta={"zero_stage": self.zero_stage})
+                self._compiled_apply = fn
+                self._apply_cost = entry
+            else:
+                self._compiled_apply = jitted
         return self._compiled_apply
 
     def _spike_limit(self):
@@ -1433,11 +1529,7 @@ class DeepSpeedEngine:
         if _telemetry.enabled:
             _telemetry.begin_step(self.global_steps)
             _telemetry.begin_span(_telemetry.SPAN_FORWARD)
-            shape = np.shape(inputs[0]) if inputs else ()
-            # batch×seq tokens this micro-batch, for tokens/s in the record
-            self._tel_step_tokens += (int(np.prod(shape[:2]))
-                                      if len(shape) >= 2
-                                      else int(shape[0]) if shape else 0)
+            self._tel_step_tokens += self._count_batch_tokens(inputs)
         if self._moe_gating_tail:
             # per-step fold-in: same compiled program, fresh key each
             # micro-step; flax make_rng folds in the layer path per layer
@@ -1448,6 +1540,19 @@ class DeepSpeedEngine:
                       np.float32(self.progressive_layer_drop.get_theta()),
                       jax.random.PRNGKey(self.micro_steps))
         micro = self._get_compiled_micro(inputs)
+        if _telemetry.enabled:
+            key = tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
+            entry = self._micro_cost.get(key)
+            if entry is not None:
+                # the call COUNT is execution truth — it ticks even when
+                # the backend gave this program no flop figure
+                entry.calls += 1
+            if entry is not None and entry.flops is not None:
+                self._tel_step_flops += entry.flops
+            else:
+                # no flop count for this program: MFU must refuse (None),
+                # not report garbage from a partial sum
+                self._tel_flops_incomplete = True
         loss, grads = micro(self.params, self.scale_state.scale, inputs)
         from ..utils.fault_injection import fault_point
         if fault_point("engine.poison", step=self.micro_steps):
@@ -1593,11 +1698,17 @@ class DeepSpeedEngine:
                     raise RuntimeError(
                         "step() at a grad-accum boundary without any "
                         "backward() since the last boundary")
-                apply = self._get_compiled_apply()
+                apply_args = (self.params, self.master, self.opt_state,
+                              self.grad_acc, self.scale_state,
+                              self._spike_limit())
+                apply = self._get_compiled_apply(apply_args)
                 (self.params, self.master, self.opt_state,
-                 self.scale_state, skipped, gnorm) = apply(
-                    self.params, self.master, self.opt_state, self.grad_acc,
-                    self.scale_state, self._spike_limit())
+                 self.scale_state, skipped, gnorm) = apply(*apply_args)
+                if _telemetry.enabled and self._apply_cost is not None:
+                    # counted HERE (where the program ran, flops known or
+                    # not) — the host-offload branch above never executes
+                    # this executable
+                    self._apply_cost.calls += 1
                 self.grad_acc = None
                 if self._nvme_swapper is not None:
                     # updated state back to disk (async; overlaps next fwd)
@@ -1668,11 +1779,57 @@ class DeepSpeedEngine:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
 
+    def _count_batch_tokens(self, inputs):
+        """Tokens in this micro-batch for the step record's token-rate
+        metrics (``tokens``, ``tokens_per_sec_per_chip``).
+
+        With the top-level config key ``"sequence_length"`` set, tokens =
+        batch × sequence_length, cross-checked LOUDLY against axis 1 of
+        ``inputs[0]`` when it has one.  Unset, a ≥2-D first input ASSUMES
+        axis 1 is the sequence — a heuristic that silently counted feature
+        dims as tokens for non-token models, so it now warns once and
+        points at the config key; a 1-D input counts samples.  Returns 0
+        (→ rate metrics omitted as None, never garbage) when there is
+        nothing defensible to count."""
+        if not inputs:
+            return 0
+        shape = np.shape(inputs[0])
+        if not shape:
+            return 0
+        seq = self.sequence_length
+        if seq:
+            if len(shape) >= 2 and shape[1] != seq and \
+                    not self._seq_len_warned:
+                self._seq_len_warned = True
+                logger.warning(
+                    "token accounting: config sequence_length=%d but "
+                    "inputs[0] has axis-1 size %d — counting batch × "
+                    "sequence_length per the config; fix the config (or "
+                    "the batch layout) if tokens/s looks wrong", seq,
+                    shape[1])
+            return int(shape[0]) * int(seq)
+        if len(shape) >= 2:
+            if not self._seq_len_warned:
+                self._seq_len_warned = True
+                logger.warning(
+                    "token accounting: no \"sequence_length\" in the "
+                    "config — ASSUMING inputs[0] axis 1 (=%d) is the "
+                    "sequence for tokens/s; set the top-level "
+                    "sequence_length key to validate this (a feature dim "
+                    "here silently inflates token rates — "
+                    "docs/observability.md)", shape[1])
+            return int(np.prod(shape[:2]))
+        return int(shape[0])
+
     def _telemetry_step_end(self, skipped, gnorm):
         """Close the telemetry step window with the boundary's numbers and
         refresh the live-metrics registry.  Reading loss/grad-norm/skip
         forces one device sync per boundary — the documented cost of
-        telemetry ON (mirrors the finite-grad guard)."""
+        telemetry ON (mirrors the finite-grad guard).  The same sync makes
+        the ``memory_stats()`` snapshot (the record's ``hbm`` section) a
+        true boundary figure, and the compiled-cost registry prices the
+        step's executed flops for ``mfu`` (docs/observability.md
+        "MFU & HBM")."""
         metrics = {}
         ll = self._last_loss
         try:
@@ -1691,6 +1848,62 @@ class DeepSpeedEngine:
         if tokens:
             metrics["tokens"] = tokens
         metrics["lr"] = self.get_lr()[0]
+        # compiled-cost feed: Σ micro flops this window + the boundary
+        # update; refused (absent → None) when any executed program had no
+        # flop count — MFU is a measurement, not a guess
+        from ..profiling import cost_model
+        step_flops = None
+        if not self._tel_flops_incomplete and self._tel_step_flops > 0:
+            step_flops = self._tel_step_flops
+            if self._apply_cost is not None:
+                if self._apply_cost.flops is None:
+                    # the boundary update ran but has no flop figure: a
+                    # micro-only sum would be a silent partial — refuse
+                    step_flops = None
+                else:
+                    step_flops += self._apply_cost.flops
+        if step_flops is not None:
+            metrics["step_flops_per_chip"] = step_flops
+            # the recorder derives mfu = step_flops / wall / peak at
+            # end_step (it owns the wall clock); peak rides along so the
+            # spine stays generic
+            metrics["peak_flops_per_chip"] = \
+                cost_model.peak_flops_per_chip()
+        self._tel_step_flops = 0.0
+        self._tel_flops_incomplete = False
+        # device-memory snapshot on the boundary sync telemetry already
+        # pays for → the step record's "hbm" section + live gauges
+        hbm = None
+        try:
+            from .utils import memory_usage_snapshot
+            snap = memory_usage_snapshot()
+            hbm = {k: snap[k] for k in ("live_bytes", "peak_bytes",
+                                        "limit_bytes")}
+            _telemetry.record_hbm(hbm)
+        except Exception as e:   # telemetry must never kill a step
+            logger.warning("telemetry: memory_stats read failed (%s)", e)
+        # refresh the compiled-programs table in the trace metadata every
+        # boundary: entries mutate between captures too (call counts), and
+        # a version-gated snapshot shipped stale calls=1 tables.  A handful
+        # of dict writes per boundary, dwarfed by the device sync above.
+        _telemetry.metadata("compiled_programs",
+                            cost_model.registry().describe())
+        if not self._mem_planner_emitted and self.params is not None:
+            # static HBM planner figure for the trace's planner-vs-measured
+            # delta (trace_report) — once, from the live partition plan
+            self._mem_planner_emitted = True
+            try:
+                from ..profiling import mem_estimator
+                est = mem_estimator.estimate_from_plan(
+                    self.params, self.plan,
+                    compute_dtype_bytes=jnp.dtype(
+                        self.compute_dtype).itemsize,
+                    grad_bytes=jnp.dtype(self.grad_accum_dtype).itemsize,
+                    include_master=self.master is not None)
+                _telemetry.metadata("mem_planner", est)
+            except Exception as e:
+                logger.warning("telemetry: mem planner estimate failed "
+                               "(%s)", e)
         # MoE routed-token stats arrive via jax.debug.callback whenever
         # telemetry is on and the model contains MoE layers (record_routing
         # gates on telemetry, not the moe block) — drain the effect queue
@@ -1726,6 +1939,23 @@ class DeepSpeedEngine:
                         "train/tokens_per_sec_per_chip",
                         help="tokens/s/chip over the last step").set(
                             tokens / wall_s / max(1, jax.device_count()))
+                rmfu = record.get("metrics", {}).get("mfu")
+                if rmfu is not None:
+                    reg.gauge(
+                        "train/mfu",
+                        help="model-FLOPs utilization: compiled per-chip "
+                        "flops/s ÷ per-chip peak").set(rmfu)
+            if hbm is not None:
+                reg.gauge("hbm/live_bytes",
+                          help="device bytes_in_use at the boundary"
+                          ).set(hbm["live_bytes"])
+                reg.gauge("hbm/peak_bytes",
+                          help="device peak_bytes_in_use").set(
+                              hbm["peak_bytes"])
+                if hbm["limit_bytes"]:
+                    reg.gauge("hbm/limit_bytes",
+                              help="device bytes_limit").set(
+                                  hbm["limit_bytes"])
         if self.global_steps % self._config.steps_per_print == 0:
             _telemetry.export_metrics(step=self.global_samples)
 

@@ -89,15 +89,56 @@ def partition_balanced(weights, num_parts):
     return best
 
 
+def memory_usage_snapshot():
+    """The accelerator ``memory_stats()`` dict distilled to the figures
+    the HBM accounting reports everywhere (step records, gauges,
+    :func:`see_memory_usage`): live/peak/limit bytes plus a fragmentation
+    estimate — 1 − largest_free_block / free when the backend exposes the
+    largest contiguous block (XLA's BFC allocator does), else None."""
+    from ..accelerator import get_accelerator
+    stats = get_accelerator().memory_stats() or {}
+    live = int(stats.get("bytes_in_use", 0))
+    peak = int(stats.get("peak_bytes_in_use", live))
+    limit = int(stats.get("bytes_limit", 0))
+    free = max(0, limit - live)
+    largest = stats.get("largest_free_block_bytes")
+    frag = None
+    if largest is not None and free > 0:
+        frag = max(0.0, 1.0 - float(largest) / free)
+    return {"live_bytes": live, "peak_bytes": peak, "limit_bytes": limit,
+            "free_bytes": free, "fragmentation": frag}
+
+
 def see_memory_usage(message, force=False):
-    """Reference ``see_memory_usage``: device + host memory snapshot."""
+    """Reference ``see_memory_usage``: device memory snapshot — live,
+    peak, limit and fragmentation (bytes_in_use vs bytes_limit via the
+    largest free block) from the accelerator ``memory_stats()`` dict, not
+    just the two raw allocation fields.  Routed through the telemetry
+    metrics registry when the spine is enabled."""
     if not force:
         return
-    from ..accelerator import get_accelerator
-    acc = get_accelerator()
-    ga = acc.memory_allocated() / (1024**3)
-    peak = acc.max_memory_allocated() / (1024**3)
-    logger.info(f"{message} | device alloc: {ga:.2f}GB peak: {peak:.2f}GB")
+    snap = memory_usage_snapshot()
+    gib = 1024**3
+    frag = (f" frag: {snap['fragmentation']:.1%}"
+            if snap["fragmentation"] is not None else "")
+    limit = (f" limit: {snap['limit_bytes'] / gib:.2f}GB "
+             f"free: {snap['free_bytes'] / gib:.2f}GB"
+             if snap["limit_bytes"] else "")
+    logger.info(f"{message} | device alloc: {snap['live_bytes'] / gib:.2f}GB "
+                f"peak: {snap['peak_bytes'] / gib:.2f}GB{limit}{frag}")
+    from .. import telemetry
+    if telemetry.enabled:
+        for key in ("live_bytes", "peak_bytes", "limit_bytes"):
+            g = telemetry.gauge(f"hbm/{key}",
+                                help="see_memory_usage device snapshot")
+            if g is not None:
+                g.set(snap[key])
+        if snap["fragmentation"] is not None:
+            g = telemetry.gauge("hbm/fragmentation",
+                                help="1 - largest_free_block / free")
+            if g is not None:
+                g.set(snap["fragmentation"])
+    return snap
 
 
 def count_parameters(params):

@@ -365,6 +365,14 @@ class ServingScheduler:
                         help="used / usable KV blocks"
                         ).set(used / total if total else 0.0)
         if telemetry.get_recorder() is not None:
+            try:
+                from ..runtime.utils import memory_usage_snapshot
+                snap = memory_usage_snapshot()
+                telemetry.record_hbm(
+                    {k: snap[k] for k in ("live_bytes", "peak_bytes",
+                                          "limit_bytes")})
+            except Exception:
+                pass   # telemetry must never kill a serving step
             telemetry.end_step(metrics={
                 "tokens": n_tokens,
                 "serve_running": len(self._running),

@@ -149,6 +149,14 @@ def record_comm_event(op, variant, msg_bytes, wire_bytes, latency_s,
                              world_size, exposed=exposed)
 
 
+def record_hbm(stats):
+    """Device-memory snapshot (live/peak/limit bytes) into the open step
+    window — the ``hbm`` section of the step record (the engine samples
+    ``memory_stats()`` on the boundary sync it already pays for)."""
+    if _recorder is not None:
+        _recorder.hbm_stat(stats)
+
+
 def record_moe_stats(layer, stats):
     """Per-layer routed-token accounting (drop fraction, overflow, expert
     load imbalance, aux loss) into the open step window — the ``moe``

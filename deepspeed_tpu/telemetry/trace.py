@@ -119,6 +119,7 @@ class TraceRecorder:
         self._phase_s = {}
         self._bucket_s = {}
         self._moe_s = {}             # layer → accumulated routing stats
+        self._hbm = None             # memory_stats snapshot for the step
         self._step_comm = CommAttribution()
         self._run_comm = CommAttribution()
         self.steps_recorded = 0
@@ -227,6 +228,7 @@ class TraceRecorder:
         self._phase_s = {}
         self._bucket_s = {}
         self._moe_s = {}
+        self._hbm = None
         self._step_comm.reset()
         if self.device_annotations:
             try:
@@ -273,6 +275,8 @@ class TraceRecorder:
                 "ops": self._step_comm.summary(),
             },
         }
+        if self._hbm:
+            record["hbm"] = self._hbm
         if self._bucket_s:
             record["overlap"] = {
                 "buckets": len(self._bucket_s),
@@ -298,8 +302,17 @@ class TraceRecorder:
                                       for l in layers.values()),
             }
         if metrics:
-            record["metrics"] = {k: v for k, v in metrics.items()
-                                 if v is not None}
+            metrics = {k: v for k, v in metrics.items() if v is not None}
+            # MFU is derived HERE because the recorder owns the step wall
+            # clock: achieved per-chip flops/s ÷ per-chip peak.  Both
+            # inputs ride the metrics dict (the engine's compiled-cost
+            # registry supplies them) so the spine needs no profiler
+            # import; absent inputs → no mfu key (refuse, don't guess).
+            sf = metrics.get("step_flops_per_chip")
+            peak = metrics.get("peak_flops_per_chip")
+            if sf and peak and wall_s > 0 and "mfu" not in metrics:
+                metrics["mfu"] = sf / wall_s / peak
+            record["metrics"] = metrics
         self._append_step_record(record)
         self.steps_recorded += 1
         return record
@@ -321,6 +334,23 @@ class TraceRecorder:
         reduce, ``param_gather`` for the forward prefetch).  Lands in the
         step record's ``overlap`` section, not the phase columns."""
         return self.span(f"{kind}/{index}", cat="comm", **args)
+
+    def hbm_stat(self, stats):
+        """Attach the step-boundary device-memory snapshot to the open step
+        window — the ``hbm`` section of the step record (``live_bytes`` /
+        ``peak_bytes`` / ``limit_bytes`` from the accelerator's
+        ``memory_stats()``, sampled on the boundary sync telemetry already
+        pays for)."""
+        if self._closed or self._step is None or not stats:
+            return
+        clean = {}
+        for key, val in stats.items():
+            try:
+                clean[str(key)] = int(val)
+            except (TypeError, ValueError):
+                continue   # telemetry must never kill a step over a stat
+        if clean:
+            self._hbm = clean
 
     def moe_stat(self, layer, stats):
         """Accumulate one MoE layer's routed-token stats into the open step

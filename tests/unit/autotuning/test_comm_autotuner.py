@@ -480,3 +480,80 @@ def test_run_autotuning_refuses_disabled():
     from deepspeed_tpu.autotuning import run_autotuning
     with pytest.raises(AutotuningError, match="enabled"):
         run_autotuning(base_config={"autotuning": {"enabled": False}})
+
+
+# -------------------------------------------- memory-feasibility filter (PR 14)
+def _filter_autotuner(num_params):
+    at = Autotuner(lambda p, x: x, {"autotuning": {"enabled": True}})
+    at.model_info = {"num_params": num_params}
+    return at
+
+
+def test_memory_filter_rejects_infeasible_keeps_pinned(monkeypatch):
+    from deepspeed_tpu import accelerator as acc_mod
+    acc = acc_mod.get_accelerator()
+    # pretend a 16 GiB chip
+    monkeypatch.setattr(type(acc), "total_memory",
+                        lambda self, device_index=None: 16 * 2**30)
+    # 3B params fp32 Adam: 60 GB of states — stage 0 (full) and stage 2
+    # (12 GB params + 18 GB sharded-state share) cannot fit, stage 3 (/8)
+    # fits
+    at = _filter_autotuner(int(3e9))
+    exps = [
+        {"name": "z0_default", "pinned": True,
+         "ds_config": {"zero_optimization": {"stage": 0}}},
+        {"name": "z0_w8", "ds_config": {"zero_optimization": {"stage": 0}}},
+        {"name": "z2_w8", "ds_config": {"zero_optimization": {"stage": 2}}},
+        {"name": "z3_w8", "ds_config": {"zero_optimization": {"stage": 3}}},
+    ]
+    kept = at.memory_feasibility_filter(list(exps))
+    names = [e["name"] for e in kept]
+    # the doomed non-pinned candidates are gone BEFORE any trial runs …
+    assert "z0_w8" not in names and "z2_w8" not in names
+    # … the feasible one survives, and the pinned baseline is NEVER dropped
+    assert "z3_w8" in names and "z0_default" in names
+
+
+def test_memory_filter_noop_without_model_or_limit(monkeypatch):
+    exps = [{"name": "z0", "ds_config": {"zero_optimization": {"stage": 0}}}]
+    # unknown model size → untouched
+    at = _filter_autotuner(0)
+    assert at.memory_feasibility_filter(list(exps)) == exps
+    # unknown memory limit → untouched
+    from deepspeed_tpu import accelerator as acc_mod
+    acc = acc_mod.get_accelerator()
+    monkeypatch.setattr(type(acc), "total_memory",
+                        lambda self, device_index=None: 0)
+    at = _filter_autotuner(int(1e9))
+    assert at.memory_feasibility_filter(list(exps)) == exps
+
+
+def test_memory_filter_never_empties_the_space(monkeypatch):
+    from deepspeed_tpu import accelerator as acc_mod
+    acc = acc_mod.get_accelerator()
+    monkeypatch.setattr(type(acc), "total_memory",
+                        lambda self, device_index=None: 2**20)  # 1 MiB chip
+    at = _filter_autotuner(int(1e9))
+    exps = [{"name": f"z{s}", "ds_config":
+             {"zero_optimization": {"stage": s}}} for s in (0, 2, 3)]
+    kept = at.memory_feasibility_filter(list(exps))
+    # nothing fits in 1 MiB, but the tuner still gets one candidate to
+    # deliver a measured verdict
+    assert len(kept) == 1 and kept[0]["name"] == "z0"
+
+
+def test_memory_filter_prices_mesh_and_precision(monkeypatch):
+    from deepspeed_tpu import accelerator as acc_mod
+    acc = acc_mod.get_accelerator()
+    monkeypatch.setattr(type(acc), "total_memory",
+                        lambda self, device_index=None: 16 * 2**30)
+    at = _filter_autotuner(int(2e9))
+    # same stage-0, but bf16 + tp=4 divides the resident states under 16 GiB
+    exps = [
+        {"name": "z0_fp32", "ds_config": {"zero_optimization": {"stage": 0}}},
+        {"name": "z0_bf16_tp4", "ds_config": {
+            "zero_optimization": {"stage": 0},
+            "bfloat16": {"enabled": True}, "mesh": {"tp": 4}}},
+    ]
+    kept = [e["name"] for e in at.memory_feasibility_filter(list(exps))]
+    assert kept == ["z0_bf16_tp4"]

@@ -99,6 +99,10 @@ def test_overlap_sweep_rows_and_schema(tmp_path):
         assert 0.0 <= c["overlap_efficiency"] <= 1.0
         assert 0.0 <= c["exposed_comm_frac"] <= 1.0
         assert c["buckets"] >= 1 and c["comm_ms"] > 0 and c["step_ms"] > 0
+        # PR 14: compiled-cost fields on every candidate (CPU backend
+        # implements cost/memory analysis, so both are populated here)
+        assert c["mfu"] is not None and c["mfu"] > 0
+        assert c["peak_hbm_bytes"] and c["peak_hbm_bytes"] > 0
     # smaller bound → more buckets, in both directions
     eff = {(c["direction"], c["bucket_mb"], c["wire_dtype"]): c["buckets"]
            for c in over}

@@ -742,10 +742,14 @@ def test_train_step_single_compile_across_steps():
         jax.config.update("jax_log_compiles", False)
         for lg in loggers:
             lg.removeHandler(handler)
+    # count XLA compilation COMPLETIONS — the "Compiling …" announcement
+    # stopped firing on this jaxlib's dispatch logger (the guard silently
+    # counted 0 == "no recompile"), while the finish line fires on both the
+    # lazy-jit and the AOT (lower().compile()) paths the engine now uses
     n_micro = sum(1 for m in records
-                  if m.startswith("Compiling") and "jit(micro)" in m)
+                  if "Finished XLA compilation of jit(micro)" in m)
     n_apply = sum(1 for m in records
-                  if m.startswith("Compiling") and "jit(apply)" in m)
+                  if "Finished XLA compilation of jit(apply)" in m)
     assert n_micro == 1, f"micro compiled {n_micro}× across same-shape steps"
     assert n_apply == 1, f"apply compiled {n_apply}× across same-shape steps"
 

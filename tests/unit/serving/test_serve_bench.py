@@ -66,8 +66,12 @@ def test_traffic_row_schema_and_fold_aggregation(tmp_path):
     assert row["completed"] == 6
     # the uniform ds_bench keys are all present (None where n/a)
     for key in ("op", "bytes", "wire_bytes", "latency_us", "bucket_mb",
-                "overlap_efficiency", "exposed_comm_frac"):
+                "overlap_efficiency", "exposed_comm_frac", "mfu",
+                "peak_hbm_bytes"):
         assert key in row
+    # PR 14: the armed cost-model capture prices the serving programs
+    assert row["mfu"] is not None and row["mfu"] > 0
+    assert row["peak_hbm_bytes"] and row["peak_hbm_bytes"] > 0
     assert row["ttft_p50_ms"] is not None
     assert row["tokens_per_s_per_chip"] > 0
     assert row["kv_bytes_per_token"] > 0

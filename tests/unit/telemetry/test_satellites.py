@@ -184,3 +184,102 @@ def test_stale_variant_not_attributed_to_flat_op(monkeypatch):
         assert "all_reduce[q_int8]" not in log.comms_dict
     finally:
         log.enabled, log.comms_dict = saved[0], {}
+
+
+# ------------------------------------------------- ISSUE-14 satellites
+def test_see_memory_usage_reports_peak_limit_fragmentation(monkeypatch):
+    from deepspeed_tpu import accelerator as acc_mod
+    from deepspeed_tpu.runtime.utils import (memory_usage_snapshot,
+                                             see_memory_usage)
+    acc = acc_mod.get_accelerator()
+    monkeypatch.setattr(
+        type(acc), "memory_stats",
+        lambda self, device_index=None: {
+            "bytes_in_use": 600, "peak_bytes_in_use": 800,
+            "bytes_limit": 1000, "largest_free_block_bytes": 100})
+    snap = memory_usage_snapshot()
+    assert snap["live_bytes"] == 600 and snap["peak_bytes"] == 800
+    assert snap["limit_bytes"] == 1000 and snap["free_bytes"] == 400
+    # largest free block 100 of 400 free → 75% fragmented
+    assert snap["fragmentation"] == pytest.approx(0.75)
+    # force=False stays a no-op (the hot-path contract)
+    assert see_memory_usage("quiet") is None
+    assert see_memory_usage("loud", force=True) == snap
+
+
+def test_see_memory_usage_routes_gauges_through_registry(monkeypatch,
+                                                         tmp_path):
+    from deepspeed_tpu import accelerator as acc_mod, telemetry
+    from deepspeed_tpu.runtime.utils import see_memory_usage
+    acc = acc_mod.get_accelerator()
+    monkeypatch.setattr(
+        type(acc), "memory_stats",
+        lambda self, device_index=None: {
+            "bytes_in_use": 600, "peak_bytes_in_use": 800,
+            "bytes_limit": 1000, "largest_free_block_bytes": 100})
+    cfg = type("C", (), {"trace_dir": str(tmp_path), "fence": False,
+                         "device_profiler": False, "trace_steps": 0,
+                         "metrics": None})()
+    try:
+        telemetry.configure(cfg)
+        see_memory_usage("snap", force=True)
+        text = telemetry.prometheus_text()
+    finally:
+        telemetry.shutdown()
+    assert 'hbm_live_bytes{rank="0"} 600.0' in text
+    assert 'hbm_peak_bytes{rank="0"} 800.0' in text
+    assert 'hbm_fragmentation{rank="0"} 0.75' in text
+
+
+def test_sequence_length_config_validates():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "sequence_length": 128})
+    assert cfg.sequence_length == 128
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "sequence_length": -5})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "sequence_length": "long"})
+
+
+def test_token_accounting_validates_loudly(monkeypatch):
+    """Engine._count_batch_tokens: config sequence_length wins (mismatch
+    warns once); unset + 2-D input assumes axis 1 loudly; nothing
+    defensible → 0 (rate metrics omitted, not garbage)."""
+    import io
+    import logging as _logging
+
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    eng = object.__new__(DeepSpeedEngine)   # method under test is pure
+    buf = io.StringIO()
+    handler = _logging.StreamHandler(buf)
+    ds_logger.addHandler(handler)
+    try:
+        # config key set and consistent: batch × seq, silent
+        eng.sequence_length, eng._seq_len_warned = 8, False
+        x = np.zeros((4, 8, 3))
+        assert eng._count_batch_tokens((x, )) == 32
+        assert not eng._seq_len_warned
+        # mismatch against axis 1: config wins, warns once
+        eng.sequence_length, eng._seq_len_warned = 16, False
+        assert eng._count_batch_tokens((x, )) == 64
+        assert eng._seq_len_warned
+        assert "sequence_length=16" in buf.getvalue()
+        # unset + 2-D input: heuristic, loud once
+        buf.truncate(0), buf.seek(0)
+        eng.sequence_length, eng._seq_len_warned = None, False
+        assert eng._count_batch_tokens((x, )) == 32
+        assert "ASSUMING inputs[0] axis 1" in buf.getvalue()
+        assert eng._count_batch_tokens((x, )) == 32   # warned once
+        assert buf.getvalue().count("ASSUMING") == 1
+        # 1-D input counts samples; empty counts nothing
+        eng.sequence_length = None
+        assert eng._count_batch_tokens((np.zeros(5), )) == 5
+        assert eng._count_batch_tokens(()) == 0
+    finally:
+        ds_logger.removeHandler(handler)

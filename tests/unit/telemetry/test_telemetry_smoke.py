@@ -24,6 +24,11 @@ def test_telemetry_smoke_end_to_end():
     # per-variant collective attribution made it into the step records
     assert any("q_int8" in v for v in r["variant_rows"]), r["variant_rows"]
     assert r["prometheus_ok"]
+    # MFU/HBM gate (ISSUE 14): finite mfu + hbm bytes on EVERY record of
+    # the 8-virtual-CPU-device run, compiled-programs table captured
+    assert r["mfu_finite"], r["mfus"]
+    assert r["hbm_finite"]
+    assert r["compiled_programs_ok"], r["compiled_programs"]
     # the comms logger's machine-readable summary carries the same vocabulary
     assert any("[q_int8]" in op for op in r["comms_summary_ops"])
     # zero-overhead contract: disabled config == no telemetry key, to the bit

@@ -274,3 +274,102 @@ def test_moe_sweep_table_from_comm_summary(tmp_path, capsys):
     assert rc == 0
     assert "moe dispatch sweep" in out
     assert "best manual dispatch: wire=int8" in out
+
+
+# ------------------------------------------------------- MFU/HBM (ISSUE 14)
+MFU_STEPS = [
+    {"step": 0, "wall_ms": 100.0, "phases": {"forward": 50.0},
+     "comm": {"total_ms": 5.0, "exposed_ms": 5.0,
+              "exposed_comm_fraction": 0.05, "ops": {}},
+     "metrics": {"loss": 2.0, "mfu": 0.40,
+                 "step_flops_per_chip": 1e12},
+     "hbm": {"live_bytes": 2 * 2**30, "peak_bytes": 3 * 2**30,
+             "limit_bytes": 16 * 2**30}},
+    {"step": 1, "wall_ms": 100.0, "phases": {"forward": 50.0},
+     "comm": {"total_ms": 5.0, "exposed_ms": 5.0,
+              "exposed_comm_fraction": 0.05, "ops": {}},
+     "metrics": {"loss": 1.5, "mfu": 0.44},
+     "hbm": {"live_bytes": 2 * 2**30, "peak_bytes": 4 * 2**30,
+             "limit_bytes": 16 * 2**30}},
+]
+
+
+def test_mfu_hbm_columns_and_summary(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in MFU_STEPS))
+    steps = trace_report.load_steps(str(path))
+    s = trace_report.summarize(steps)
+    assert abs(s["mfu_mean"] - 0.42) < 1e-12 and s["mfu_steps"] == 2
+    assert s["hbm"]["peak_bytes_max"] == 4 * 2**30
+    assert s["hbm"]["limit_bytes"] == 16 * 2**30
+    lines = []
+    trace_report.render_report(steps, s, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "mfu" in text and "hbm_MiB" in text
+    assert "0.4000" in text and "0.4400" in text
+    assert "MFU (mean over 2 steps): 0.4200" in text
+    assert "HBM: live max" in text and "25.0% used" in text
+
+
+def test_old_records_render_without_mfu_columns(tmp_path):
+    # archives predating ISSUE 14 must render byte-stable (no new columns)
+    path = _write_fixture(tmp_path)
+    steps = trace_report.load_steps(path)
+    lines = []
+    trace_report.render_report(steps, trace_report.summarize(steps),
+                               print_fn=lines.append)
+    header = [l for l in lines if l.startswith("  step")][0]
+    assert "mfu" not in header and "hbm" not in header
+
+
+def test_compiled_programs_table_and_planner_delta(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in MFU_STEPS))
+    (tmp_path / "trace.json").write_text(json.dumps({
+        "traceEvents": [{"name": "step 0", "ph": "X", "ts": 0.0,
+                         "dur": 5.0, "pid": 0, "tid": 2}],
+        "otherData": {
+            "compiled_programs": [
+                {"name": "train/micro_step[flat]", "calls": 8,
+                 "flops": 2.5e9, "bytes_accessed": 1e6,
+                 "peak_hbm_bytes": 3 * 2**30, "source": "xla"},
+                {"name": "train/apply_update", "calls": 2,
+                 "flops": 1e7, "bytes_accessed": 5e5,
+                 "peak_hbm_bytes": 4 * 2**30, "source": "xla"}],
+            "mem_planner": {"stage": 2, "total_bytes": 2 * 2**30},
+        }}))
+    meta = trace_report.load_trace_metadata(str(tmp_path / "trace.json"))
+    delta = trace_report.planner_vs_measured(meta)
+    assert delta["measured_bytes"] == 4 * 2**30
+    assert delta["ratio"] == 2.0
+
+    rc = trace_report.main([str(tmp_path), "--json"])
+    assert rc == 0
+
+    lines = []
+    steps = trace_report.load_steps(str(path))
+    summary = trace_report.summarize(steps)
+    summary["compiled_programs"] = meta["compiled_programs"]
+    summary["mem_planner_delta"] = delta
+    trace_report.render_report(steps, summary, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "== compiled programs (XLA cost model, per chip) ==" in text
+    assert "train/micro_step[flat]" in text
+    assert "planner vs measured (stage 2)" in text and "x2.00" in text
+
+
+def test_cli_json_carries_compiled_programs(tmp_path, capsys):
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in MFU_STEPS))
+    (tmp_path / "trace.json").write_text(json.dumps({
+        "traceEvents": [],
+        "otherData": {"compiled_programs": [
+            {"name": "p", "flops": 1.0, "peak_hbm_bytes": 10,
+             "calls": 1, "source": "xla"}],
+            "mem_planner": {"stage": 3, "total_bytes": 5}}}))
+    rc = trace_report.main([str(tmp_path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert abs(out["mfu_mean"] - 0.42) < 1e-12
+    assert out["compiled_programs"][0]["name"] == "p"
+    assert out["mem_planner_delta"]["ratio"] == 2.0

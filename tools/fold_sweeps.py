@@ -60,14 +60,24 @@ def aggregate_overlap(paths):
                 continue
             key = (row.get("direction") or "reduce",
                    float(row["bucket_mb"]), row.get("wire_dtype", "?"))
-            c = cells.setdefault(key, {"n": 0, "eff": 0.0, "exposed": 0.0})
+            c = cells.setdefault(key, {"n": 0, "eff": 0.0, "exposed": 0.0,
+                                       "mfu": 0.0, "mfu_n": 0,
+                                       "peak_hbm": 0})
             c["n"] += 1
             c["eff"] += float(row["overlap_efficiency"])
             c["exposed"] += float(row.get("exposed_comm_frac") or 0.0)
+            if row.get("mfu") is not None:
+                c["mfu"] += float(row["mfu"])
+                c["mfu_n"] += 1
+            if row.get("peak_hbm_bytes"):
+                c["peak_hbm"] = max(c["peak_hbm"],
+                                    int(row["peak_hbm_bytes"]))
     out = [{"direction": d, "bucket_mb": mb, "wire_dtype": wd,
             "runs": c["n"],
             "overlap_efficiency": c["eff"] / c["n"],
-            "exposed_comm_frac": c["exposed"] / c["n"]}
+            "exposed_comm_frac": c["exposed"] / c["n"],
+            "mfu": (c["mfu"] / c["mfu_n"]) if c["mfu_n"] else None,
+            "peak_hbm_bytes": c["peak_hbm"] or None}
            for (d, mb, wd), c in cells.items()]
     out.sort(key=lambda r: (r["direction"], -r["overlap_efficiency"]))
     return out
@@ -92,11 +102,18 @@ def aggregate_serve(paths):
             c = cells.setdefault(key, {
                 "n": 0, "requests": 0, "preemptions": 0, "tok_s": 0.0,
                 "ttft_p50": 0.0, "ttft_p99": 0.0, "tbt_p50": 0.0,
-                "tbt_p99": 0.0, "lat_runs": 0})
+                "tbt_p99": 0.0, "lat_runs": 0, "mfu": 0.0, "mfu_n": 0,
+                "peak_hbm": 0})
             c["n"] += 1
             c["requests"] += int(row.get("requests") or 0)
             c["preemptions"] += int(row.get("preemptions") or 0)
             c["tok_s"] += float(row.get("tokens_per_s_per_chip") or 0.0)
+            if row.get("mfu") is not None:
+                c["mfu"] += float(row["mfu"])
+                c["mfu_n"] += 1
+            if row.get("peak_hbm_bytes"):
+                c["peak_hbm"] = max(c["peak_hbm"],
+                                    int(row["peak_hbm_bytes"]))
             if row.get("ttft_p50_ms") is not None:
                 c["lat_runs"] += 1
                 c["ttft_p50"] += float(row["ttft_p50_ms"])
@@ -114,6 +131,8 @@ def aggregate_serve(paths):
             "ttft_p99_ms": c["ttft_p99"] / lr,
             "tbt_p50_ms": c["tbt_p50"] / lr,
             "tbt_p99_ms": c["tbt_p99"] / lr,
+            "mfu": (c["mfu"] / c["mfu_n"]) if c["mfu_n"] else None,
+            "peak_hbm_bytes": c["peak_hbm"] or None,
         })
     out.sort(key=lambda r: -r["tokens_per_s_per_chip"])
     return out
@@ -213,7 +232,11 @@ def main(argv=None):
                   f"  tbt p50/p99={r['tbt_p50_ms']:.2f}/"
                   f"{r['tbt_p99_ms']:.2f}ms"
                   f"  preempt={r['preemptions']}"
-                  f" (n={r['runs']}, {r['requests']} reqs)")
+                  + (f"  mfu={r['mfu']:.4f}" if r.get("mfu") is not None
+                     else "")
+                  + (f"  peak_hbm={r['peak_hbm_bytes'] / 2**20:.0f}MiB"
+                     if r.get("peak_hbm_bytes") else "")
+                  + f" (n={r['runs']}, {r['requests']} reqs)")
         print()
     moe = aggregate_moe(paths)
     if moe:

@@ -134,56 +134,90 @@ def run_traffic(scheduler, plan, max_steps=200_000):
     """Drive the plan against the scheduler in arrival order: submit each
     request when its arrival time (relative to the run start) has passed,
     stepping the engine in between.  Returns the summary row."""
+    from deepspeed_tpu.profiling import cost_model
+    # arm compiled-cost capture so the serving programs (ragged step /
+    # decode bursts) land in the registry — feeds the row's uniform
+    # mfu/peak_hbm_bytes fields without enabling the full telemetry spine.
+    # The registry is PROCESS-WIDE (a co-resident training engine keeps
+    # its entries), so this run's accounting is a call-count DELTA, not a
+    # registry reset.
+    reg = cost_model.registry()
+    calls_before = {p.name: p.calls for p in reg.programs()}
+    cost_model.enable_capture(True)
     t0 = time.perf_counter()
     pending = list(plan)
     uids = []
     steps = 0
-    while pending or not scheduler.idle:
-        now = time.perf_counter() - t0
-        while pending and pending[0][0] <= now:
-            _, prompt, max_new = pending.pop(0)
-            uids.append(scheduler.submit(prompt,
-                                         max_new_tokens=max_new))
-        if scheduler.idle:
-            if pending:   # idle gap before the next arrival
-                time.sleep(min(0.001, pending[0][0] - now))
-            continue
-        scheduler.step()
-        steps += 1
-        if steps >= max_steps:
-            raise RuntimeError("serve_bench did not converge")
-    wall_s = time.perf_counter() - t0
+    try:
+        while pending or not scheduler.idle:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.pop(0)
+                uids.append(scheduler.submit(prompt,
+                                             max_new_tokens=max_new))
+            if scheduler.idle:
+                if pending:   # idle gap before the next arrival
+                    time.sleep(min(0.001, pending[0][0] - now))
+                continue
+            scheduler.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError("serve_bench did not converge")
+        wall_s = time.perf_counter() - t0
+    finally:
+        # an aborted drive must not leave the process paying an analysis
+        # compile per new serving layout forever
+        cost_model.enable_capture(False)
     reqs = [scheduler.query(u) for u in uids]
     ttfts = [r.ttft for r in reqs if r.ttft is not None]
     gaps = [g for r in reqs for g in r.token_gaps]
     n_chips = jax.device_count()
     toks = scheduler.tokens_generated
+    # compiled-cost fields over THIS run's executions only: MFU =
+    # Σ(program flops × call delta) over the wall against the per-chip
+    # peak — registry flops are already PER-DEVICE (the partitioned SPMD
+    # executable), so no further /n_chips.  Peak HBM is the static
+    # compiled estimate of the run's programs: the allocator's
+    # max_memory_allocated is process-lifetime (and whole-host on the CPU
+    # backend), so mixing it in would report unrelated memory as ours.
+    executed = 0.0
+    peaks = []
+    for p in reg.programs():
+        delta = p.calls - calls_before.get(p.name, 0)
+        if delta <= 0:
+            continue
+        if p.flops:
+            executed += p.flops * delta
+        if p.peak_hbm_bytes:
+            peaks.append(p.peak_hbm_bytes)
+    serve_mfu = cost_model.mfu(executed / wall_s
+                               if executed and wall_s > 0 else None)
+    from deepspeed_tpu.benchmarks.comm_bench import bench_row
     from deepspeed_tpu.inference.v2.kv_codec import kv_bytes_per_token
     mc = scheduler.engine.model_config
     kv_bytes = kv_bytes_per_token(
         mc.num_hidden_layers, mc.num_key_value_heads, mc.head_dim,
         scheduler.engine._kv_dtype,
         fp_dtype=scheduler.engine._config.dtype)
-    return {
-        "op": "serve", "direction": "serve",
-        # uniform ds_bench row fields (fold_sweeps never key-errors)
-        "bytes": None, "wire_bytes": None, "latency_us": None,
-        "algbw_gbps": None, "busbw_gbps": None, "bucket_mb": None,
-        "overlap_efficiency": None, "exposed_comm_frac": None,
-        "wire_dtype": scheduler.engine._kv_dtype or "fp",
-        "kv_cache_dtype": scheduler.engine._kv_dtype,
-        "kv_bytes_per_token": int(kv_bytes),
-        "requests": len(uids), "completed": scheduler.completed,
-        "preemptions": scheduler.preemptions,
-        "peak_running": scheduler.peak_running,
-        "engine_steps": steps, "wall_s": wall_s,
-        "tokens_total": toks,
-        "tokens_per_s_per_chip": toks / wall_s / n_chips if wall_s else 0.0,
-        "ttft_p50_ms": _pct(ttfts, 50) * 1e3 if ttfts else None,
-        "ttft_p99_ms": _pct(ttfts, 99) * 1e3 if ttfts else None,
-        "tbt_p50_ms": _pct(gaps, 50) * 1e3 if gaps else None,
-        "tbt_p99_ms": _pct(gaps, 99) * 1e3 if gaps else None,
-    }
+    # bench_row = THE uniform ds_bench schema (fold_sweeps never
+    # key-errors; new uniform fields land here without a second edit)
+    return bench_row(
+        op="serve", direction="serve",
+        mfu=serve_mfu,
+        peak_hbm_bytes=max(peaks) if peaks else None,
+        wire_dtype=scheduler.engine._kv_dtype or "fp",
+        kv_cache_dtype=scheduler.engine._kv_dtype,
+        kv_bytes_per_token=int(kv_bytes),
+        requests=len(uids), completed=scheduler.completed,
+        preemptions=scheduler.preemptions,
+        peak_running=scheduler.peak_running,
+        engine_steps=steps, wall_s=wall_s,
+        tokens_total=toks,
+        tokens_per_s_per_chip=toks / wall_s / n_chips if wall_s else 0.0,
+        ttft_p50_ms=_pct(ttfts, 50) * 1e3 if ttfts else None,
+        ttft_p99_ms=_pct(ttfts, 99) * 1e3 if ttfts else None,
+        tbt_p50_ms=_pct(gaps, 50) * 1e3 if gaps else None,
+        tbt_p99_ms=_pct(gaps, 99) * 1e3 if gaps else None)
 
 
 # ---------------------------------------------------------------- smoke gate

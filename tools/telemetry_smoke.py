@@ -140,6 +140,26 @@ def run_smoke(steps=6, lr=0.2):
     result["summary"] = summary
     result["variant_rows"] = [k for k in summary["comm_ops"] if "[" in k]
 
+    # MFU/HBM gate (ISSUE 14): every step record carries a finite mfu
+    # (compiled-cost feed) and finite hbm bytes (memory_stats snapshot),
+    # and the trace metadata carries the compiled-programs table
+    import math
+    mfus = [r.get("metrics", {}).get("mfu") for r in step_records]
+    result["mfus"] = mfus
+    result["mfu_finite"] = bool(step_records) and all(
+        isinstance(m, float) and math.isfinite(m) and m > 0 for m in mfus)
+    hbms = [r.get("hbm") or {} for r in step_records]
+    result["hbm_finite"] = bool(step_records) and all(
+        isinstance(h.get("live_bytes"), int) and h["live_bytes"] > 0
+        and isinstance(h.get("peak_bytes"), int) for h in hbms)
+    meta = trace_report.load_trace_metadata(
+        os.path.join(trace_dir, "trace.json"))
+    result["compiled_programs"] = [p.get("name") for p in
+                                   meta.get("compiled_programs") or []]
+    result["compiled_programs_ok"] = any(
+        n.startswith("train/micro_step") for n in
+        result["compiled_programs"])
+
     # metrics endpoint renders the expected families
     result["prometheus_ok"] = all(
         fam in prom for fam in ("train_steps", "train_loss",
@@ -158,6 +178,8 @@ def run_smoke(steps=6, lr=0.2):
         result["chrome_trace_valid"] and result["fractions_in_range"]
         and result["phases_present"] and result["prometheus_ok"]
         and result["variant_rows"] and result["disabled_bit_identical"]
+        and result["mfu_finite"] and result["hbm_finite"]
+        and result["compiled_programs_ok"]
         and result["step_records"] == steps)
     return result
 
@@ -176,6 +198,10 @@ def main():
           f"{['%.3f' % f for f in r['fractions']]} "
           f"(in range={r['fractions_in_range']})")
     print(f"variant rows: {r['variant_rows']}")
+    print(f"mfu finite on every record: {r['mfu_finite']} "
+          f"({['%.5f' % m if m is not None else None for m in r['mfus']]})")
+    print(f"hbm fields finite on every record: {r['hbm_finite']}")
+    print(f"compiled programs captured: {r['compiled_programs']}")
     print(f"prometheus families: {'OK' if r['prometheus_ok'] else 'FAIL'}")
     print(f"disabled == absent losses (bit-identical): "
           f"{r['disabled_bit_identical']}")

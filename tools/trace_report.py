@@ -79,10 +79,43 @@ def validate_chrome_trace(trace_path):
     return True, f"{len(events)} events"
 
 
+def load_trace_metadata(trace_path):
+    """``otherData`` from the Chrome trace: the compiled-programs table and
+    the mem-planner estimate land there (engine metadata emits).  Returns
+    {} when absent/unreadable — metadata is an enrichment, not a
+    requirement."""
+    try:
+        with open(trace_path) as f:
+            trace = json.load(f)
+        other = trace.get("otherData")
+        return other if isinstance(other, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def planner_vs_measured(meta):
+    """Planner-vs-measured delta: the mem-estimator's static state bytes
+    against the largest compiled ``memory_analysis`` peak.  None unless
+    both sides exist."""
+    planner = meta.get("mem_planner") or {}
+    planned = planner.get("total_bytes")
+    peaks = [p.get("peak_hbm_bytes")
+             for p in meta.get("compiled_programs") or []
+             if p.get("peak_hbm_bytes")]
+    if not planned or not peaks:
+        return None
+    measured = max(peaks)
+    return {"stage": planner.get("stage"),
+            "planner_bytes": float(planned),
+            "measured_bytes": float(measured),
+            "ratio": measured / planned if planned else None}
+
+
 def summarize(steps):
     """Aggregate a run: mean wall/phases, merged comm attribution, the
-    exposed-comm-fraction series, and the overlap-efficiency figure
-    (hidden / total measured comm time)."""
+    exposed-comm-fraction series, the overlap-efficiency figure
+    (hidden / total measured comm time), and the MFU/HBM series the
+    compiled-cost capture feeds (docs/observability.md "MFU & HBM")."""
     n = len(steps)
     phases = {}
     comm_ops = {}
@@ -91,7 +124,18 @@ def summarize(steps):
     hidden_comm_total = 0.0
     fused_steps = 0
     tokens_total = 0
+    mfu_vals = []
+    hbm_live_max = 0
+    hbm_peak_max = 0
+    hbm_limit = 0
     for rec in steps:
+        mfu = rec.get("metrics", {}).get("mfu")
+        if mfu is not None:
+            mfu_vals.append(float(mfu))
+        hbm = rec.get("hbm") or {}
+        hbm_live_max = max(hbm_live_max, int(hbm.get("live_bytes", 0)))
+        hbm_peak_max = max(hbm_peak_max, int(hbm.get("peak_bytes", 0)))
+        hbm_limit = max(hbm_limit, int(hbm.get("limit_bytes", 0)))
         wall_total += rec.get("wall_ms", 0.0)
         for name, ms in rec.get("phases", {}).items():
             phases[name] = phases.get(name, 0.0) + ms
@@ -158,6 +202,12 @@ def summarize(steps):
         "comm_ops": comm_ops,
         "moe_layers": moe_layers,
         "moe_steps": moe_steps,
+        "mfu_mean": (sum(mfu_vals) / len(mfu_vals)) if mfu_vals else None,
+        "mfu_steps": len(mfu_vals),
+        "hbm": ({"live_bytes_max": hbm_live_max,
+                 "peak_bytes_max": hbm_peak_max,
+                 "limit_bytes": hbm_limit or None}
+                if (hbm_live_max or hbm_peak_max) else None),
         "tokens_total": tokens_total,
         "tokens_per_sec": (tokens_total / (wall_total / 1e3)
                            if wall_total > 0 and tokens_total else 0.0),
@@ -181,10 +231,19 @@ def render_report(steps, summary, last=None, print_fn=print):
     # mixed) get their own columns so mixed archives stay readable
     cols += sorted({p for r in shown for p in r.get("phases", {})}
                    - set(PHASE_COLUMNS))
+    # MFU/HBM columns render only when some record carries them (older
+    # archives and serving-only traces stay byte-stable)
+    has_mfu = any(r.get("metrics", {}).get("mfu") is not None
+                  for r in shown)
+    has_hbm = any(r.get("hbm") for r in shown)
     header = f"{'step':>6}{'wall_ms':>10}"
     for p in cols:
         header += f"{p:>12}"
     header += f"{'comm_ms':>10}{'exposed_frac':>14}"
+    if has_mfu:
+        header += f"{'mfu':>8}"
+    if has_hbm:
+        header += f"{'hbm_MiB':>9}"
     if shown:
         print_fn("== per-step breakdown (ms) ==")
         print_fn(header)
@@ -199,6 +258,14 @@ def render_report(steps, summary, last=None, print_fn=print):
             else:
                 line += (f"{comm.get('exposed_ms', 0.0):>10.2f}"
                          f"{comm.get('exposed_comm_fraction', 0.0):>14.3f}")
+            if has_mfu:
+                mfu = rec.get("metrics", {}).get("mfu")
+                line += (f"{mfu:>8.4f}" if mfu is not None else f"{'-':>8}")
+            if has_hbm:
+                hbm = rec.get("hbm") or {}
+                live = hbm.get("live_bytes")
+                line += (f"{live / 2**20:>9.1f}" if live is not None
+                         else f"{'-':>9}")
             print_fn(line)
         print_fn("")
         print_fn(f"== run summary ({summary['steps']} steps) ==")
@@ -216,6 +283,18 @@ def render_report(steps, summary, last=None, print_fn=print):
                      "scheduled inside the compiled step and the 0.000 "
                      "exposed fraction above is a lower bound, not a "
                      "measurement")
+        if summary.get("mfu_mean") is not None:
+            print_fn(f"MFU (mean over {summary['mfu_steps']} steps): "
+                     f"{summary['mfu_mean']:.4f}")
+        hbm = summary.get("hbm")
+        if hbm:
+            limit = hbm.get("limit_bytes")
+            line = (f"HBM: live max {_fmt_bytes(hbm['live_bytes_max'])} | "
+                    f"peak {_fmt_bytes(hbm['peak_bytes_max'])}")
+            if limit:
+                line += (f" | limit {_fmt_bytes(limit)} "
+                         f"({hbm['peak_bytes_max'] / limit:.1%} used)")
+            print_fn(line)
         if summary["tokens_per_sec"]:
             print_fn(f"tokens/s (all chips): {summary['tokens_per_sec']:.0f}")
         for name, ms in summary["phases_ms_mean"].items():
@@ -310,6 +389,33 @@ def render_report(steps, summary, last=None, print_fn=print):
         print_fn(f"{suggest}: bucket_mb={best.get('bucket_mb')} "
                  f"wire={best.get('wire_dtype')} "
                  f"overlap_efficiency={best.get('overlap_efficiency', 0):.3f}")
+    programs = summary.get("compiled_programs") or []
+    if programs:
+        print_fn("")
+        print_fn("== compiled programs (XLA cost model, per chip) ==")
+        print_fn(f"{'program':<40}{'calls':>7}{'GFLOPs':>9}"
+                 f"{'bytes_acc':>11}{'peak_hbm':>10}{'src':>10}")
+        for p in programs:
+            flops = p.get("flops")
+            ba = p.get("bytes_accessed")
+            peak = p.get("peak_hbm_bytes")
+            print_fn(
+                f"{p.get('name', '?'):<40}{p.get('calls', 0):>7}"
+                + (f"{flops / 1e9:>9.3f}" if flops is not None
+                   else f"{'-':>9}")
+                + (f"{_fmt_bytes(ba):>11}" if ba is not None
+                   else f"{'-':>11}")
+                + (f"{_fmt_bytes(peak):>10}" if peak else f"{'-':>10}")
+                + f"{p.get('source') or '-':>10}")
+    delta = summary.get("mem_planner_delta")
+    if delta:
+        print_fn("")
+        print_fn(
+            f"planner vs measured (stage {delta['stage']}): states "
+            f"{_fmt_bytes(delta['planner_bytes'])} planned vs "
+            f"{_fmt_bytes(delta['measured_bytes'])} compiled peak "
+            f"(x{delta['ratio']:.2f} — the gap is activations/temp the "
+            "states planner deliberately excludes)")
 
 
 def main(argv=None):
@@ -355,6 +461,14 @@ def main(argv=None):
     if os.path.exists(trace_path):
         ok, detail = validate_chrome_trace(trace_path)
         summary["chrome_trace"] = {"valid": ok, "detail": detail}
+        meta = load_trace_metadata(trace_path)
+        if meta.get("compiled_programs"):
+            summary["compiled_programs"] = meta["compiled_programs"]
+        if meta.get("mem_planner"):
+            summary["mem_planner"] = meta["mem_planner"]
+        delta = planner_vs_measured(meta)
+        if delta:
+            summary["mem_planner_delta"] = delta
 
     if args.json:
         print(json.dumps(summary, indent=2))
