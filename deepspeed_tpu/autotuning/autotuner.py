@@ -196,16 +196,44 @@ class Autotuner:
                 for w in c.probe_wires:
                     bases.append(dict(proto, quantized_gradients=True,
                                       wire_dtype=w))
+                    # qwZ trial surface (ISSUE-15 satellite): the weight
+                    # all-gather wire is its own knob — a config can win
+                    # on qwZ alone (stage-3 gather traffic) where qgZ
+                    # loses, and vice versa.  qwZ only exists at stage ≥ 3
+                    # (the engine gates it there) — below that the
+                    # candidate would time the identical non-quantized
+                    # program and burn trial budget on a duplicate.
+                    if stage >= 3:
+                        bases.append(dict(proto, quantized_weights=True,
+                                          wire_dtype=w))
+                    for gs in (c.group_size_candidates or []):
+                        # quantization_group_size candidates: the
+                        # error/overhead trade both quantized paths share
+                        bases.append(dict(proto, quantized_gradients=True,
+                                          wire_dtype=w,
+                                          quantization_group_size=gs))
+                        if stage >= 3:
+                            bases.append(dict(proto, quantized_weights=True,
+                                              wire_dtype=w,
+                                              quantization_group_size=gs))
+                    if "flat_manual" in (c.zero_mode_candidates or []):
+                        # the zero-mode dimension (ds_bench --zero-mode's
+                        # search twin): race the legacy full-manual qgZ
+                        # micro against the GSPMD-first islands default
+                        bases.append(dict(proto, quantized_gradients=True,
+                                          wire_dtype=w,
+                                          zero_mode="flat_manual"))
                 if ladder_rs:
                     # the EQuARX candidate: per-size wire choice from the
                     # measured reduce_scatter (qgZ) probes
                     bases.append(dict(proto, quantized_gradients=True,
                                       wire_dtype_by_size=ladder_rs))
-                if ladder_ag:
+                if ladder_ag and stage >= 3:
                     # qwZ sibling: the all_gather probes' ladder carried by
                     # the weight-gather path (one ladder field serves the
                     # whole block, so the two ladders ride separate
-                    # candidates)
+                    # candidates; like the per-wire qwZ bases, stage ≥ 3
+                    # only — below that qwZ never engages)
                     bases.append(dict(proto, quantized_weights=True,
                                       wire_dtype_by_size=ladder_ag))
         blocks = []
@@ -242,8 +270,18 @@ class Autotuner:
                 parts.append("ladder")
             elif block.get("quantized_gradients"):
                 parts.append(f"w{block.get('wire_dtype', 'int8')}")
-            if block.get("quantized_weights"):
+            elif block.get("quantized_weights"):
+                # qwZ-only base: the wire must be in the name or every
+                # probe wire would collide on "qw"
+                parts.append(f"qw{block.get('wire_dtype', 'int8')}")
+            if block.get("quantized_weights") and (
+                    block.get("quantized_gradients")
+                    or block.get("wire_dtype_by_size")):
                 parts.append("qw")
+            if block.get("quantization_group_size"):
+                parts.append(f"gs{block['quantization_group_size']}")
+            if block.get("zero_mode") == "flat_manual":
+                parts.append("fm")
             if block.get("hierarchical_allreduce"):
                 parts.append("hier")
             if block.get("min_message_size"):

@@ -82,6 +82,15 @@ class AutotuningConfig(DeepSpeedConfigModel):
     max_inflight_candidates: List[int] = [2]
     min_message_sizes: List[int] = [0]
     hierarchical_candidates: List[bool] = [True]
+    # quantization_group_size candidates composed onto the quantized
+    # (qgZ/qwZ) wire bases; empty (default) keeps the block default —
+    # the space is unchanged unless the user opts into the sweep
+    group_size_candidates: List[int] = []
+    # the zero-mode search dimension (ds_bench --zero-mode's twin): when
+    # "flat_manual" is listed, every quantized-gradient wire base gets a
+    # legacy full-manual-micro sibling so the measured trial decides which
+    # micro architecture carries qgZ on THIS model/mesh (docs/zero.md)
+    zero_mode_candidates: List[str] = ["gspmd", "flat_manual"]
     # candidates within this relative step-time margin count as a tie and
     # are broken by the lower exposed_comm_frac
     tie_rtol: float = 0.02
@@ -101,6 +110,18 @@ class AutotuningConfig(DeepSpeedConfigModel):
                 raise ValueError(
                     f"autotuning.probe_wires entry {w!r} unknown "
                     f"(have {', '.join(WIRE_FORMATS)})")
+        from ..runtime.zero.gspmd import ZERO_MODES
+        for zm in self.zero_mode_candidates:
+            if zm not in ZERO_MODES:
+                raise ValueError(
+                    f"autotuning.zero_mode_candidates entry {zm!r} unknown "
+                    f"(have {', '.join(ZERO_MODES)})")
+        for gs in self.group_size_candidates:
+            if int(gs) < 128:
+                raise ValueError(
+                    "autotuning.group_size_candidates entries must be "
+                    f">= 128 (got {gs}) — the codecs lane-align scale "
+                    "groups down to 128")
         if self.start_profile_step < 1:
             raise ValueError("autotuning.start_profile_step must be >= 1")
         return self

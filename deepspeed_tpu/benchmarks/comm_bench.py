@@ -748,6 +748,173 @@ def best_moe_candidate(rows):
     return None if best is None else (best, best_speedup)
 
 
+# ------------------------------------------------------------ zero-mode lane
+# The three micro-step architectures that can carry a ZeRO training step
+# (ISSUE 15, docs/zero.md "GSPMD-first ZeRO"), measured against each other
+# on a REAL engine micro-step (not a synthetic proxy):
+#   flat_manual — the legacy full-manual shard_map qgZ micro
+#                 (comm_optimizations.zero_mode: "flat_manual");
+#   gspmd       — the pure GSPMD micro, no quantization (the flat-wire
+#                 upper bound XLA schedules end to end);
+#   gspmd_q     — the GSPMD-first micro with quantized islands (the
+#                 default qgZ path).
+# bench LANES, not config values — runtime/zero/gspmd.ZERO_MODES
+# (the comm_optimizations.zero_mode validator) accepts only
+# "gspmd"/"flat_manual"; "gspmd_q" names the quantized-islands lane
+ZERO_MODE_LANES = ("flat_manual", "gspmd", "gspmd_q")
+ZERO_MODE_WIRES = ("int8", )
+ZERO_MODE_HIDDEN = 256
+ZERO_MODE_LAYERS = 4
+
+
+def _zero_mode_config(mode, stage, wire):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
+        "mesh": {"dp": -1},
+    }
+    if mode != "gspmd":
+        cfg["comm_optimizations"] = {
+            "enabled": True, "quantized_gradients": True,
+            "wire_dtype": wire, "quantization_group_size": GROUP_SIZE,
+            **({"zero_mode": "flat_manual"} if mode == "flat_manual"
+               else {}),
+        }
+    return cfg
+
+
+def _zero_mode_candidate(mode, stage, wire, hidden, nlayers, iters, warmup,
+                         repeat):
+    """Time one zero-mode lane: build a real engine with that micro-step
+    architecture, AOT-compile its ACTUAL micro (the same executable
+    training runs) and report the median step latency + compiled-cost
+    fields.  One uniform ``bench_row`` with ``direction: "zero_mode"``."""
+    import jax
+    import deepspeed_tpu
+    from ..comm.collectives import quantized as Q
+    from ..utils import groups
+
+    groups.reset_mesh()
+    deepspeed_tpu.comm.destroy_process_group()
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": (rng.standard_normal((hidden, hidden)) * 0.05
+                  ).astype("float32"),
+            "b": np.zeros((hidden, ), "float32"),
+        }
+
+    def apply_fn(p, x, y):
+        import jax.numpy as jnp
+        h = x
+        for i in range(nlayers):
+            lp = p[f"layer_{i}"]
+            h = jnp.tanh(h @ lp["w"] + lp["b"])
+        return jnp.mean((h - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params,
+        config=_zero_mode_config(mode, stage, wire))
+    try:
+        xs = rng.standard_normal(
+            (8 * engine.dp_world_size, hidden)).astype("float32")
+        ys = np.tanh(xs * 0.5).astype("float32")
+        inputs = engine.shard_batch(xs, ys)
+        micro = engine._micro_step_fn()
+        args = (engine.params, engine.scale_state.scale, inputs)
+        fn, analysis = _aot_with_analysis(jax.jit(micro), args)
+        lat, iqr = _timed_stats(fn, args, iters, warmup, repeat=repeat)
+        variant = engine._micro_variant()
+        grad_elems = sum(int(np.prod(x.shape))
+                         for x in jax.tree_util.tree_leaves(params))
+        if mode == "gspmd":
+            wire_bytes = grad_elems * 4
+        else:
+            wire_bytes = Q.quantized_wire_bytes(grad_elems, wire,
+                                                GROUP_SIZE)
+        return bench_row(
+            op="zero_micro_step", direction="zero_mode",
+            zero_mode=mode, micro_variant=variant, stage=int(stage),
+            wire_dtype=(wire if mode != "gspmd" else "fp32"),
+            bytes=int(grad_elems * 4), wire_bytes=int(wire_bytes),
+            latency_us=lat * 1e6, iqr_us=iqr * 1e6, repeat=int(repeat),
+            # the lane ALWAYS runs on its own pure-dp mesh over all
+            # devices (the three micros differ only in the dp exchange) —
+            # recorded per row because the payload-level "mesh" describes
+            # the surrounding op sweeps, not these engines
+            mesh={"dp": int(engine.dp_world_size)},
+            **_step_cost_fields(analysis, lat))
+    finally:
+        groups.reset_mesh()
+        deepspeed_tpu.comm.destroy_process_group()
+
+
+def run_zero_mode_sweep(mesh=None, stages=(2, ), wires=ZERO_MODE_WIRES,
+                        hidden=ZERO_MODE_HIDDEN, layers=ZERO_MODE_LAYERS,
+                        iters=5, warmup=2, repeat=3, print_fn=print,
+                        recorder=None):
+    """The three-way flat-manual / GSPMD / GSPMD+quantized-islands lane
+    (``ds_bench --zero-mode``): one real engine micro-step per
+    architecture, per stage × wire.  Returns uniform ``bench_row`` dicts
+    tagged ``direction: "zero_mode"`` — ``fold_sweeps.
+    aggregate_zero_mode`` folds archives and the autotuner searches the
+    same knob (``comm_optimizations.zero_mode``)."""
+    import contextlib
+
+    import jax
+    from ..utils import groups
+    if len(jax.devices()) < 2:
+        raise SystemExit("zero-mode lane needs >= 2 devices (the three "
+                         "micros differ only in how the dp exchange runs)")
+    # the lane rebuilds engines (and thus meshes) per candidate; remember
+    # the bench mesh so the other sweeps in this invocation still see it
+    orig = (dict(mesh.shape) if mesh is not None
+            else dict(groups.get_mesh_state().mesh.shape))
+    print_fn(f"# zero-mode lane: devices={len(jax.devices())} "
+             f"hidden={hidden} layers={layers} "
+             f"(flat_manual / gspmd / gspmd_q)")
+    print_fn(f"{'stage':>6}{'mode':>13}{'wire':>7}{'variant':>18}"
+             f"{'latency_us':>12}{'iqr_us':>9}{'wire_bytes':>12}")
+    rows = []
+    try:
+        for stage in stages:
+            for wire in wires:
+                for mode in ZERO_MODE_LANES:
+                    span = (recorder.span(
+                        f"zero_mode/{stage}/{wire}/{mode}", cat="bench")
+                        if recorder is not None
+                        else contextlib.nullcontext())
+                    with span:
+                        c = _zero_mode_candidate(mode, stage, wire, hidden,
+                                                 layers, iters, warmup,
+                                                 repeat)
+                    rows.append(c)
+                    print_fn(f"{c['stage']:>6}{c['zero_mode']:>13}"
+                             f"{c['wire_dtype']:>7}"
+                             f"{c['micro_variant']:>18}"
+                             f"{c['latency_us']:>12.1f}"
+                             f"{c['iqr_us']:>9.1f}"
+                             f"{c['wire_bytes']:>12}")
+                fm = next(r for r in rows[-len(ZERO_MODE_LANES):]
+                          if r["zero_mode"] == "flat_manual")
+                for r in rows[-len(ZERO_MODE_LANES):]:
+                    if r["zero_mode"] != "flat_manual" and r["latency_us"]:
+                        print_fn(
+                            f"# z{stage}/{wire} {r['zero_mode']}: "
+                            f"{fm['latency_us'] / r['latency_us']:.2f}x "
+                            f"vs flat_manual")
+    finally:
+        # restore the bench mesh for whatever sweeps follow
+        groups.reset_mesh()
+        import deepspeed_tpu
+        deepspeed_tpu.comm.destroy_process_group()
+        groups.initialize_mesh(**{k: int(v) for k, v in orig.items()})
+    return rows
+
+
 # engine-variant op → (facade op, comms-logging variant tag) so traced
 # sweeps use the same ``op[variant]`` vocabulary as training traces
 _TRACE_VARIANTS = {
@@ -764,7 +931,8 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
         overlap_bucket_mbs=OVERLAP_BUCKET_MBS, overlap_wires=OVERLAP_WIRES,
         overlap_directions=OVERLAP_DIRECTIONS, repeat=3, moe=False,
         moe_experts=MOE_EXPERTS, moe_capacity_factors=MOE_CAPACITY_FACTORS,
-        moe_wires=MOE_WIRES, moe_tokens=MOE_TOKENS):
+        moe_wires=MOE_WIRES, moe_tokens=MOE_TOKENS, zero_mode=False,
+        zero_mode_stages=(2, ), zero_mode_wires=ZERO_MODE_WIRES):
     """Sweep collectives over powers-of-two message sizes.  Returns rows of
     (op, bytes, wire_bytes, latency_s, algbw_gbps, busbw_gbps, iqr_s) —
     latency is the MEDIAN over ``repeat`` timed blocks, iqr their
@@ -836,6 +1004,12 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             capacity_factors=moe_capacity_factors, wires=moe_wires,
             tokens=moe_tokens, iters=max(2, iters // 2), warmup=warmup,
             repeat=repeat, print_fn=print_fn, recorder=recorder)
+    zero_mode_rows = []
+    if zero_mode:
+        zero_mode_rows = run_zero_mode_sweep(
+            mesh=mesh, stages=zero_mode_stages, wires=zero_mode_wires,
+            iters=max(2, iters // 4), warmup=warmup, repeat=repeat,
+            print_fn=print_fn, recorder=recorder)
     if json_path:
         # uniform row schema (bench_row): overlap/stat fields present on
         # every row so BENCH_* aggregation (fold_sweeps) never key-errors
@@ -852,6 +1026,7 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             # aggregation weigh them as multi-block medians they are not
             json_rows.append(bench_row(**c, latency_us=c["step_ms"] * 1e3))
         json_rows.extend(moe_rows)  # already uniform bench_row dicts
+        json_rows.extend(zero_mode_rows)  # uniform, direction:"zero_mode"
         payload = {
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "axis": axis,
@@ -872,6 +1047,8 @@ def run(ops=ALL_OPS, axis="dp", minsize=16, maxsize=26, mesh_spec=None,
             summary["overlap"] = overlap_rows
         if moe_rows:
             summary["moe"] = moe_rows
+        if zero_mode_rows:
+            summary["zero_mode"] = zero_mode_rows
         with open(summary_path, "w") as fh:
             json.dump(summary, fh, indent=2)
         recorder.close()
@@ -938,10 +1115,21 @@ def cli_main(argv=None):
                     "(default fp32,int8; the GSPMD baseline always runs)")
     ap.add_argument("--moe-tokens", type=int, default=MOE_TOKENS,
                     help="tokens per dispatch for the moe sweep")
+    ap.add_argument("--zero-mode", action="store_true",
+                    help="also run the three-way ZeRO micro-step lane "
+                    "(flat-manual / GSPMD / GSPMD+quantized-islands on a "
+                    "real engine micro — docs/zero.md)")
+    ap.add_argument("--zero-mode-stages", default=None, metavar="S,S",
+                    help="comma-separated ZeRO stages for the zero-mode "
+                    "lane (default 2)")
+    ap.add_argument("--zero-mode-wires", default=None, metavar="W,W",
+                    help="comma-separated qgZ wire dtypes for the "
+                    "zero-mode lane (default int8)")
     args = ap.parse_args(argv)
-    # --overlap/--moe alone sweep just their lane; add --op to also run
-    # the collective op sweep in the same invocation
-    default_ops = () if (args.overlap or args.moe) else ALL_OPS
+    # --overlap/--moe/--zero-mode alone sweep just their lane; add --op to
+    # also run the collective op sweep in the same invocation
+    default_ops = () if (args.overlap or args.moe or args.zero_mode) \
+        else ALL_OPS
     run(ops=(args.op, ) if args.op else default_ops, axis=args.axis,
         minsize=args.minsize, maxsize=args.maxsize, mesh_spec=args.mesh,
         iters=args.iters, warmup=args.warmup, repeat=args.repeat,
@@ -964,7 +1152,13 @@ def cli_main(argv=None):
             if args.moe_capacity_factors else MOE_CAPACITY_FACTORS),
         moe_wires=(tuple(args.moe_wires.split(","))
                    if args.moe_wires else MOE_WIRES),
-        moe_tokens=args.moe_tokens)
+        moe_tokens=args.moe_tokens,
+        zero_mode=args.zero_mode,
+        zero_mode_stages=(tuple(int(x) for x in
+                                args.zero_mode_stages.split(","))
+                          if args.zero_mode_stages else (2, )),
+        zero_mode_wires=(tuple(args.zero_mode_wires.split(","))
+                         if args.zero_mode_wires else ZERO_MODE_WIRES))
 
 
 if __name__ == "__main__":

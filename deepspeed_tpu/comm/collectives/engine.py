@@ -125,6 +125,83 @@ def clear_jit_caches():
     clear_topology_caches()
 
 
+# ----------------------------------------------------------- manual islands
+def straight_through_constraint(x, sharding):
+    """``with_sharding_constraint`` whose transpose is the identity.
+
+    A plain constraint's VJP re-applies the same sharding to the cotangent
+    — correct for values whose gradient shares their layout, but wrong on
+    a quantized-gather island's *output*: the gathered param is replicated
+    over the ZeRO axes while its cotangent is the still-unreduced gradient
+    contribution, and constraining that replicated would force an eager
+    all-reduce the backward scheduler should own (the same hazard
+    ``overlap.mark_gather_tree`` documents).  Differentiated islands
+    therefore enter/exit through this straight-through flavor."""
+
+    @jax.custom_vjp
+    def _st(v):
+        return jax.lax.with_sharding_constraint(v, sharding)
+
+    _st.defvjp(lambda v: (_st(v), None), lambda _, g: (g, ))
+    return _st(x)
+
+
+def gspmd_region(body, *, mesh, in_specs, out_specs, axis_names=None,
+                 grad_transparent=False):
+    """THE enter/exit contract for shrunken manual islands inside a GSPMD
+    program (ISSUE 15, docs/zero.md "GSPMD-first ZeRO").
+
+    A ``shard_map`` call is opaque to XLA's sharding propagation: layouts
+    on either side of it are re-inferred, and a mismatch materializes as a
+    silent reshard right where the island meets the surrounding program.
+    This wrapper owns both boundaries: every operand is constrained to the
+    island's expected ``PartitionSpec`` (``with_sharding_constraint`` —
+    GSPMD materializes that layout *before* manual mode begins), the body
+    runs under ``shard_map`` with exactly those specs, and every result is
+    constrained on the way out so propagation resumes from a declared
+    layout.  XLA's latency-hiding scheduler then treats the island as one
+    schedulable op and slides independent compute around it — the reason
+    the qwZ/qgZ islands exist at all (the codec needs bespoke bytes on the
+    wire; everything else belongs to GSPMD).
+
+    ``grad_transparent=True`` uses :func:`straight_through_constraint` for
+    the boundary constraints — required when the island is differentiated
+    (the qwZ gather), see that function's docstring.  ``axis_names``
+    restricts manual mode to a subset of mesh axes (partial-manual; the
+    caller owns the legacy-jax guard — ``jax_compat.is_legacy_shard_map``
+    aborts on manual subgroups)."""
+    from jax.sharding import NamedSharding
+
+    def _is_multi(specs):
+        # PartitionSpec subclasses tuple — a bare spec is ONE operand
+        return isinstance(specs, (tuple, list)) and not isinstance(specs, P)
+
+    in_t = tuple(in_specs) if _is_multi(in_specs) else (in_specs, )
+    kw = dict(mesh=mesh, in_specs=in_t, out_specs=out_specs,
+              check_vma=False)
+    if axis_names is not None:
+        kw["axis_names"] = frozenset(axis_names)
+    inner = jax.shard_map(body, **kw)
+
+    def constrain(x, spec):
+        if spec is None:
+            return x
+        s = NamedSharding(mesh, spec)
+        if grad_transparent:
+            return straight_through_constraint(x, s)
+        return jax.lax.with_sharding_constraint(x, s)
+
+    def wrapped(*args):
+        args = tuple(constrain(x, s) for x, s in zip(args, in_t))
+        out = inner(*args)
+        if _is_multi(out_specs):
+            return tuple(constrain(o, s)
+                         for o, s in zip(out, tuple(out_specs)))
+        return constrain(out, out_specs)
+
+    return wrapped
+
+
 # ------------------------------------------------------------------- engine
 #: ladder rung meaning "do not quantize this size band" — flat fp path
 LADDER_FP = "fp32"

@@ -64,10 +64,6 @@ class InferenceEngineV2:
         # Unset (None) keeps today's fp cache and exactly today's programs.
         self._kv_dtype = resolve_kv_dtype(
             getattr(config, "kv_cache_dtype", None))
-        if self._kv_dtype is not None and tp > 1:
-            raise NotImplementedError(
-                "kv_cache_dtype does not compose with tensor parallelism "
-                "yet (the per-token scale arrays are laid out pre-shard)")
         # weight-only quantized serving (reference quantization_mode):
         # resident weights in int8/int4 wire format, dequantized INSIDE the
         # jitted ragged step (and inside decode bursts — the wrapper is
@@ -143,8 +139,17 @@ class InferenceEngineV2:
                 self._tp_mesh,
                 P() if kv_replicated
                 else P(None, None, None, None, "tp", None))
+            # quantized KV × tp (ROADMAP serving follow-on (b)): the
+            # per-(layer, k/v, token, head) f32 scales shard WITH the
+            # cache — their trailing dim IS the kv-head dim the cache
+            # shards on, so each rank holds exactly the scales of its own
+            # cache shard and the on-read dequant stays rank-local
+            self._kv_scales_sharding = NamedSharding(
+                self._tp_mesh,
+                P() if kv_replicated else P(None, None, None, None, "tp"))
         else:
             self._kv_sharding = None
+            self._kv_scales_sharding = None
 
         sm = config.state_manager
         block_size = sm.block_size
@@ -167,10 +172,16 @@ class InferenceEngineV2:
         self._kv = self.kv_cache.data if self._kv_dtype is None \
             else (self.kv_cache.data, self.kv_cache.scales)
         if self._kv_sharding is not None:
-            self._kv = jax.device_put(self._kv, self._kv_sharding)
-            # drop the replicated original — a full unsharded cache pinned
-            # to device 0 would defeat the point of sharding it
-            self.kv_cache.data = self._kv
+            if self._kv_dtype is None:
+                self._kv = jax.device_put(self._kv, self._kv_sharding)
+                # drop the replicated original — a full unsharded cache
+                # pinned to device 0 would defeat the point of sharding it
+                self.kv_cache.data = self._kv
+            else:
+                self._kv = jax.device_put(
+                    self._kv,
+                    (self._kv_sharding, self._kv_scales_sharding))
+                self.kv_cache.data, self.kv_cache.scales = self._kv
         logger.info(
             f"InferenceEngineV2: budget={self._budget} blocks={num_blocks}"
             f"×{block_size} max_seqs={self.state_manager.max_seqs}")

@@ -633,17 +633,23 @@ def dispatch_combine(x, combine, dispatch, expert_fn,
 
 # --------------------------------------------------- routed-token accounting
 def _stats_sink(layer, k, drop_fraction, overflow_tokens, load_imbalance,
-                aux_loss):
+                aux_loss, expert_util):
     """Host-side sink for the traced routing stats (jax.debug.callback
     target): per-layer ``moe/*`` metric families + the step record's
     ``moe`` section."""
     layer = str(layer)
+    util = [float(u) for u in np.asarray(expert_util).reshape(-1)]
     stats = {
         "k": int(k),
         "drop_fraction": float(drop_fraction),
         "overflow_tokens": float(overflow_tokens),
         "load_imbalance": float(load_imbalance),
         "aux_loss": float(aux_loss),
+        # per-expert capacity utilization (post-drop tokens / capacity C):
+        # the raw signal a capacity-factor autotuner dimension needs —
+        # a uniformly low vector says "shrink cf", a saturated one with
+        # drops says "grow it" (ISSUE-15 satellite / ROADMAP MoE (c))
+        "expert_util": util,
     }
     _telemetry.record_moe_stats(layer, stats)
     g = _telemetry.gauge(f"moe/{layer}/drop_fraction",
@@ -656,6 +662,15 @@ def _stats_sink(layer, k, drop_fraction, overflow_tokens, load_imbalance,
                              stats["load_imbalance"])
         _telemetry.gauge(f"moe/{layer}/aux_loss",
                          help="load-balance aux loss").set(stats["aux_loss"])
+        if util:
+            _telemetry.gauge(
+                f"moe/{layer}/expert_util",
+                help="mean per-expert capacity utilization "
+                "(post-drop tokens / capacity)").set(
+                    sum(util) / len(util))
+            _telemetry.gauge(
+                f"moe/{layer}/expert_util_max",
+                help="max per-expert capacity utilization").set(max(util))
         c = _telemetry.counter(f"moe/{layer}/overflow_tokens",
                                help="token assignments dropped at capacity")
         if stats["overflow_tokens"] > 0:
@@ -682,6 +697,11 @@ def record_routing(layer, k, combine, dispatch, exp_counts, l_aux):
     counts = exp_counts.astype(jnp.float32)
     mean = jnp.maximum(jnp.mean(counts), 1e-9)
     imbalance = jnp.max(counts) / mean
+    # per-expert capacity utilization: the POST-DROP slot occupancy of
+    # each expert's [C] buffer (dispatch sums per expert / C) — counts may
+    # exceed C pre-drop, occupancy cannot
+    C = max(1, dispatch.shape[-1])
+    occupancy = jnp.sum(dispatch.astype(jnp.float32), axis=(0, 2)) / C
     jax.debug.callback(_stats_sink, layer, k, drop, overflow, imbalance,
-                       jnp.asarray(l_aux, jnp.float32))
+                       jnp.asarray(l_aux, jnp.float32), occupancy)
 

@@ -15,7 +15,7 @@ import json
 import os
 from typing import Any, Dict, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from .config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
 from .zero.config import DeepSpeedZeroConfig
@@ -157,8 +157,27 @@ class CommOptimizationsConfig(DeepSpeedConfigModel):
     intra_node_size: int = Field(0, ge=0)
     # messages under this many bytes always take the flat path
     min_message_size: int = Field(0, ge=0)
+    # which micro-step architecture carries the quantized-gradient (qgZ)
+    # training path (ISSUE 15, docs/zero.md "GSPMD-first ZeRO"):
+    #   "gspmd" (default) — ONE jit over NamedSharding-annotated state with
+    #     shard_map islands only around the codec+collective exchanges, so
+    #     XLA's latency-hiding scheduler owns the program; compositions the
+    #     islands cannot express yet (tp>1, hpZ/MiCS, MoE, dp×ep) keep the
+    #     manual micro automatically;
+    #   "flat_manual" — force the legacy full-manual shard_map micro
+    #     (the ds_bench --zero-mode baseline lane).
+    zero_mode: str = "gspmd"
     # bucketed backward-pass gradient-reduction scheduler (own enable gate)
     overlap: OverlapConfig = OverlapConfig()
+
+    @model_validator(mode="after")
+    def _check_zero_mode(self):
+        from .zero.gspmd import ZERO_MODES
+        if self.zero_mode not in ZERO_MODES:
+            raise ValueError(
+                f"comm_optimizations.zero_mode {self.zero_mode!r} unknown "
+                f"(have {', '.join(ZERO_MODES)})")
+        return self
 
 
 class MoeConfig(DeepSpeedConfigModel):

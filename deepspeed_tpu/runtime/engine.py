@@ -1186,11 +1186,18 @@ class DeepSpeedEngine:
         co = self._config.comm_optimizations_config
         co_on = getattr(co, "enabled", False)
         if zc.zero_quantized_gradients or (co_on and co.quantized_gradients):
-            # qgZ replaces the GSPMD gradient reduction with a quantized
-            # all-to-all reduce under manual SPMD (zeropp.py) — reachable via
-            # the legacy ZeRO++ knob or the comm_optimizations block.
-            from .zero.zeropp import build_manual_dp_micro
-            return build_manual_dp_micro(self)
+            # qgZ — the path selection collapses to gspmd / gspmd+islands
+            # (ISSUE 15): the default is the GSPMD-first micro whose only
+            # manual regions are the shrunken codec+collective islands
+            # (runtime/zero/gspmd.py), so XLA schedules everything around
+            # them; compositions whose correctness still lives inside the
+            # full-manual region — and zero_mode: "flat_manual" — keep the
+            # legacy micro (docs/zero.md "GSPMD-first ZeRO").
+            from .zero.gspmd import build_gspmd_quantized_micro
+            if self._qgz_uses_manual_micro():
+                from .zero.zeropp import build_manual_dp_micro
+                return build_manual_dp_micro(self)
+            return build_gspmd_quantized_micro(self)
         from .zero.overlap import prefetch_opts, resolve_prefetch
         pf = prefetch_opts(co)
         if pf is not None and self.zero_stage < 3:
@@ -1300,6 +1307,28 @@ class DeepSpeedEngine:
 
         return micro
 
+    def _qgz_uses_manual_micro(self):
+        """THE routing gate between the two qgZ micros — one predicate
+        shared by ``_micro_step_fn`` (which micro is built) and
+        ``_micro_variant`` (what the compiled program is named), so the
+        tag can never drift from the program it labels.  True = the
+        legacy full-manual micro: forced by ``zero_mode: "flat_manual"``
+        or required by a composition ``manual_micro_reasons`` names
+        (logged once when it's the reasons, not the knob)."""
+        from .zero.gspmd import manual_micro_reasons, resolve_zero_mode
+        co = self._config.comm_optimizations_config
+        mode = resolve_zero_mode(co)
+        reasons = manual_micro_reasons(self)
+        if reasons and mode != "flat_manual" and \
+                not getattr(self, "_manual_micro_logged", False):
+            self._manual_micro_logged = True
+            logger.info(
+                "ZeRO quantized gradients: GSPMD-first micro not "
+                "available for this config (%s) — running the "
+                "flat-manual micro (docs/zero.md \"GSPMD-first "
+                "ZeRO\")", "; ".join(reasons))
+        return mode == "flat_manual" or bool(reasons)
+
     def _micro_variant(self):
         """Short tag of which micro-step flavor is compiled — the cost
         model's program names distinguish the overlap/prefetch/qgZ
@@ -1310,7 +1339,14 @@ class DeepSpeedEngine:
         co = self._config.comm_optimizations_config
         co_on = getattr(co, "enabled", False)
         if zc.zero_quantized_gradients or (co_on and co.quantized_gradients):
-            return "qgZ"
+            if self._qgz_uses_manual_micro():
+                return "qgZ_manual"
+            qv = "qgZ_islands"
+            if (zc.zero_quantized_weights or
+                    (co_on and co.quantized_weights)) and \
+                    self.zero_stage >= 3:
+                qv += "+qwZ"
+            return qv
         from .zero.overlap import overlap_opts, prefetch_opts
         parts = []
         if overlap_opts(co) is not None:
@@ -1321,6 +1357,57 @@ class DeepSpeedEngine:
                 and self.zero_stage >= 3:
             parts.append("qwZ")
         return "+".join(parts) if parts else "flat"
+
+    def _micro_jit_shardings(self, inputs):
+        """The explicit ``jit`` in/out ``NamedSharding`` set for the GSPMD
+        micro variants (``plan.micro_shardings`` — ISSUE 15's "one jit over
+        NamedSharding-annotated params/grads").  None when a variant owns
+        its own layout (1-bit, the flat-manual micro, hpZ/MiCS reshaped
+        meshes, offloaded state) or when the live arrays disagree with the
+        plan's emitted set (e.g. sp batch sharding) — the compile must
+        describe what actually runs, so disagreement falls back to
+        inference rather than forcing a reshard."""
+        if self._onebit_opt is not None:
+            return None
+        plan = self.plan
+        if plan.param_mesh is not plan.mesh or \
+                plan.state_mesh is not plan.mesh or \
+                plan.offload_param or plan.offload_optimizer:
+            return None
+        variant = self._micro_variant()
+        if variant in ("1bit", "qgZ_manual"):
+            return None
+        try:
+            in_sh, out_sh = plan.micro_shardings(
+                self.params, inputs, self._n_replicated_batch_tail,
+                grads=("master" if variant.startswith("qgZ_islands")
+                       else "grad"))
+        except Exception as e:
+            # degradation, not failure: the compile falls back to
+            # sharding inference — but say so once, or a plan bug would
+            # silently disable the explicit-sharding path everywhere
+            if not getattr(self, "_micro_shardings_warned", False):
+                self._micro_shardings_warned = True
+                logger.warning(
+                    "plan.micro_shardings unavailable for variant %s "
+                    "(%s: %s) — compiling the micro-step with inferred "
+                    "shardings", variant, type(e).__name__, e)
+            return None
+
+        def agree(x, s):
+            sh = getattr(x, "sharding", None)
+            if sh is None:
+                return False
+            try:
+                return sh.is_equivalent_to(s, getattr(x, "ndim", 0))
+            except (AttributeError, TypeError):
+                return sh == s
+        live = list(jax.tree_util.tree_leaves(self.params)) + list(inputs)
+        want = list(jax.tree_util.tree_leaves(in_sh[0])) + list(in_sh[2])
+        if len(live) != len(want) or \
+                not all(agree(x, s) for x, s in zip(live, want)):
+            return None
+        return in_sh, out_sh
 
     def _get_compiled_micro(self, inputs):
         key = tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
@@ -1334,11 +1421,15 @@ class DeepSpeedEngine:
             # if the AOT path is unavailable on this backend
             from ..profiling import cost_model
             args = (self.params, self.scale_state.scale, inputs)
+            sh = self._micro_jit_shardings(inputs)
+            jitted = (jax.jit(micro, in_shardings=sh[0],
+                              out_shardings=sh[1])
+                      if sh is not None else jax.jit(micro))
             fn, entry = cost_model.capture_jit(
                 f"train/micro_step[{self._micro_variant()}]"
                 + (f"#{len(self._compiled_micro)}"
                    if self._compiled_micro else ""),
-                jax.jit(micro), args,
+                jitted, args,
                 # the analytic walk counts the GLOBAL logical program; the
                 # registry convention is per-device flops (what each chip
                 # executes under SPMD), so scale by the device count

@@ -27,12 +27,18 @@ module is the TPU-native translation:
   scheduler targets bucket-level *graph structure*, not async-pair
   scheduling.)
 
-* :func:`pipelined_bucket_reduce` — the manual-SPMD (qgZ) hook: reduce
-  bucket *k* as two stages (intra-node hop, inter-node quantized hop) and
-  fence bucket *k*'s inter-node stage behind bucket *k−max_inflight*'s
-  completion with ``lax.optimization_barrier`` — a software pipeline where
-  the quantized DCN all-to-all of bucket *k−1* runs while bucket *k* is
-  still in its intra-node psum_scatter.
+* :func:`pipelined_bucket_reduce` — the qgZ hook: reduce bucket *k* as
+  two stages (intra-node hop, inter-node quantized hop) and fence bucket
+  *k*'s inter-node stage behind bucket *k−max_inflight*'s completion with
+  ``lax.optimization_barrier`` — a software pipeline where the quantized
+  DCN all-to-all of bucket *k−1* runs while bucket *k* is still in its
+  intra-node psum_scatter.  Both qgZ micros ride it: the flat-manual
+  micro calls it inside its ``shard_map`` body, and the GSPMD-first micro
+  (``runtime/zero/gspmd.py``, ISSUE 15) passes its per-leaf reduce
+  *islands* as stage2 — together with :func:`mark_tree` /
+  :func:`mark_gather_tree` these barrier-fenced buckets are the ONLY
+  overlap mechanism on the GSPMD path: no manual region is ever opened
+  just to schedule communication.
 
 ZeRO-3's *other* half — the parameter all-gather that precedes every
 layer's forward (and its re-gather before backward) — gets the mirrored

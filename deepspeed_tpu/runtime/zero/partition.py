@@ -258,12 +258,14 @@ class ZeroPartitionPlan:
     def describe(self):
         """JSON-safe summary of the sharding policy — trace metadata and
         the autotuner's record of what configuration produced a trace."""
+        from .gspmd import resolve_zero_mode
         from .overlap import overlap_opts, prefetch_opts
         co = self.comm_opts
         ov = overlap_opts(co)
         pf = prefetch_opts(co)
         return {
             "stage": self.stage,
+            "zero_mode": resolve_zero_mode(co),
             "zero_axes": list(self.zero_axes),
             "param_axes": list(self.param_axes),
             "state_axes": list(self.state_axes),
@@ -534,6 +536,38 @@ class ZeroPartitionPlan:
                               self.leaf_zero_axes(p)))
 
         return jax.tree_util.tree_map_with_path(one, params)
+
+    def micro_shardings(self, params, inputs=(), n_replicated_tail=0,
+                        grads="grad"):
+        """The FULL in/out ``NamedSharding`` set of ONE jitted micro-step
+        — the GSPMD-first contract (ISSUE 15, docs/zero.md "GSPMD-first
+        ZeRO"): params in their stage layout, the loss scale and
+        engine-appended input tails replicated, batch inputs sharded over
+        the ZeRO axes on their leading dim; out, the loss replicated and
+        the gradients in the accumulator layout (``grads="grad"``, the
+        GSPMD micro's constraint target) or the master partition
+        (``grads="master"``, what the qgZ reduce islands and the manual
+        micro emit).  Returned as ``((params, scale, inputs), (loss,
+        grads))`` — exactly the ``jit(in_shardings=…, out_shardings=…)``
+        pytrees for ``micro(params, scale, inputs) -> (loss, grads)``.
+
+        Only meaningful on the plan's own mesh (hpZ/MiCS micros translate
+        their own specs); the engine cross-checks the emitted set against
+        the live arrays before arming it."""
+        if grads not in ("grad", "master"):
+            raise ValueError(f"micro_shardings grads={grads!r} must be "
+                             "'grad' or 'master'")
+        from ..utils import batch_input_specs
+        mesh = self.mesh
+        axes = tuple(a for a in self.zero_axes
+                     if mesh.shape.get(a, 1) > 1) or self.zero_axes
+        rep = NamedSharding(mesh, P())
+        batch = tuple(NamedSharding(mesh, s)
+                      for s in batch_input_specs(inputs, axes,
+                                                 n_replicated_tail))
+        grad_sh = (self.grad_shardings(params) if grads == "grad"
+                   else self.master_shardings(params))
+        return ((self.param_shardings(params), rep, batch), (rep, grad_sh))
 
     def param_specs(self, params):
         return jax.tree_util.tree_map_with_path(

@@ -31,12 +31,17 @@ The quantized collective primitives themselves live in
 ``comm/collectives/quantized.py`` (shared with the eager ``dist.*`` engine
 and ``ds_bench``); this module owns the ZeRO-side orchestration.
 
-qgZ requires taking over the gradient reduction from GSPMD, so the engine
-switches its micro-step to a manual-SPMD (``shard_map``) variant — see
-:func:`build_manual_dp_micro`.  That path supports dp/ep meshes, and tp>1
-via PARTIAL-manual shard_map (manual over the dp axes, "tp" left auto so
-GSPMD keeps inserting the tensor-parallel collectives); sp/pp are rejected
-loudly (their collectives interleave with the reduction being replaced).
+qgZ requires taking over the gradient reduction from GSPMD.  Since
+ISSUE 15 the DEFAULT vehicle for that is the GSPMD-first micro
+(``runtime/zero/gspmd.py``): one jit with per-leaf codec+collective
+islands, XLA scheduling everything around them.  The full-manual
+(``shard_map``-everything) micro below — :func:`build_manual_dp_micro` —
+remains for the compositions the islands cannot express yet (tp>1 via
+PARTIAL-manual shard_map, hpZ/MiCS reshaped meshes, MoE's manual-context
+dispatch, dp×ep hierarchies) and for ``comm_optimizations.zero_mode:
+"flat_manual"`` (the ``ds_bench --zero-mode`` baseline lane); sp/pp are
+rejected loudly (their collectives interleave with the reduction being
+replaced).
 
 With ``comm_optimizations.overlap`` enabled the manual reduction runs the
 bucketed two-stage pipeline from ``runtime/zero/overlap.py`` — intra-node
@@ -109,10 +114,16 @@ def quantized_weight_gather(params, plan, wire_format="int8",
         # plain gather inside the same straight-through wrapper
         fmt = plan.wire_for_size(wire_format,
                                  x.size * x.dtype.itemsize)
-        # positional call: custom_vjp rejects kwargs for nondiff argnums
-        fn = shard_map(
+        # positional call: custom_vjp rejects kwargs for nondiff argnums.
+        # The island is a gspmd_region (ISSUE 15): entered/exited through
+        # straight-through sharding constraints so GSPMD resumes
+        # propagation from the declared layout WITHOUT the constraint's
+        # transpose forcing the gather's cotangent replicated.
+        from ...comm.collectives.engine import gspmd_region
+        fn = gspmd_region(
             lambda t: qdq_all_gather_st(t, axes, dim, fmt, group_size),
-            mesh=mesh, in_specs=(spec, ), out_specs=out_spec, check_vma=False)
+            mesh=mesh, in_specs=(spec, ), out_specs=out_spec,
+            grad_transparent=True)
         return fn(x)
 
     if prefetch is not None:

@@ -287,8 +287,13 @@ class TraceRecorder:
             layers = {}
             for name, acc in sorted(self._moe_s.items()):
                 n = max(1, acc.pop("_n", 1))
-                layers[name] = {k: (v / n if k != "k" else v)
-                                for k, v in acc.items()}
+                vec_n = {k[3:]: max(1, acc.pop(k))
+                         for k in [k for k in acc if k.startswith("_n_")]}
+                layers[name] = {
+                    k: (v if k == "k"
+                        else ([x / vec_n.get(k, n) for x in v]
+                              if isinstance(v, list) else v / n))
+                    for k, v in acc.items()}
             # aggregate defensively: a client may book a partial stats
             # payload, and telemetry must never kill a step over it
             record["moe"] = {
@@ -364,6 +369,21 @@ class TraceRecorder:
         for key, val in stats.items():
             if key == "k":
                 acc["k"] = int(val)
+            elif isinstance(val, (list, tuple)):
+                # vector stats (per-expert capacity utilization) mean
+                # elementwise over the gas window, like the scalars —
+                # with their OWN call count (a vector present in only
+                # some window calls must not be diluted by _n), and a
+                # length change (resized expert group) restarts the sum
+                # instead of zip-truncating silently
+                vals = [float(v) for v in val]
+                prev = acc.get(key)
+                if isinstance(prev, list) and len(prev) == len(vals):
+                    acc[key] = [a + b for a, b in zip(prev, vals)]
+                    acc[f"_n_{key}"] += 1
+                else:
+                    acc[key] = vals
+                    acc[f"_n_{key}"] = 1
             else:
                 acc[key] = acc.get(key, 0.0) + float(val)
 

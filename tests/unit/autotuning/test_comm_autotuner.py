@@ -557,3 +557,61 @@ def test_memory_filter_prices_mesh_and_precision(monkeypatch):
     ]
     kept = [e["name"] for e in at.memory_feasibility_filter(list(exps))]
     assert kept == ["z0_bf16_tp4"]
+
+
+def test_comm_space_qwz_group_size_and_zero_mode_candidates():
+    """ISSUE-15 satellite: the trial surface covers qwZ
+    (quantized_weights bases per probe wire, stage ≥ 3 only — below that
+    qwZ never engages and the trial would duplicate its flat sibling),
+    quantization_group_size candidates composed onto BOTH quantized
+    families, and a flat-manual zero-mode sibling for every
+    quantized-gradient wire base — all of it priced through the same
+    space the memory filter sees."""
+    at = Autotuner(lambda p, x: x, {
+        "zero_optimization": {"stage": 3},
+        "autotuning": {"enabled": True, "tune_comm": True,
+                       "zero_stages": [2, 3],
+                       "probe_wires": ["int8"],
+                       "group_size_candidates": [256]}})
+    at.probe_rows = []
+    at.topology = {}
+    exps = at.build_comm_space()
+    z2 = {e["name"]: e["ds_config"].get("comm_optimizations", {})
+          for e in exps if e["name"].startswith("z2")}
+    assert not any(b.get("quantized_weights") and
+                   not b.get("quantized_gradients")
+                   for b in z2.values()), sorted(z2)
+    blocks = {e["name"]: e["ds_config"].get("comm_optimizations", {})
+              for e in exps if e["name"].startswith("z3")}
+    qw = [b for b in blocks.values()
+          if b.get("quantized_weights") and not b.get("quantized_gradients")]
+    assert qw, sorted(blocks)  # qwZ-only bases exist at stage 3
+    gs = [b for b in blocks.values()
+          if b.get("quantization_group_size") == 256]
+    # group size composed onto both quantized families
+    assert any(b.get("quantized_weights") for b in gs), sorted(blocks)
+    assert any(b.get("quantized_gradients") for b in gs), sorted(blocks)
+    fm = [n for n, b in blocks.items()
+          if b.get("zero_mode") == "flat_manual"]
+    assert fm and all("fm" in n for n in fm), sorted(blocks)
+    # names stay unique across the whole space (the qwZ wire is in the
+    # name, so probe wires cannot collide on one "qw" candidate)
+    all_blocks = {e["name"]: e["ds_config"].get("comm_optimizations", {})
+                  for e in exps}
+    assert len(all_blocks) == len(exps)
+    # every emitted block round-trips the runtime config validator
+    from deepspeed_tpu.runtime.config import CommOptimizationsConfig
+    for name, b in all_blocks.items():
+        if b:
+            CommOptimizationsConfig(**b)
+
+
+def test_autotuning_config_validates_zero_mode_and_group_size():
+    from deepspeed_tpu.autotuning.config import AutotuningConfig
+    with pytest.raises(Exception, match="zero_mode"):
+        AutotuningConfig(enabled=True, zero_mode_candidates=["bogus"])
+    with pytest.raises(Exception, match="group_size"):
+        AutotuningConfig(enabled=True, group_size_candidates=[64])
+    cfg = AutotuningConfig(enabled=True, group_size_candidates=[128, 512],
+                           zero_mode_candidates=["gspmd"])
+    assert cfg.group_size_candidates == [128, 512]

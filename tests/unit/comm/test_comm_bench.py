@@ -345,3 +345,71 @@ def test_fold_sweeps_aggregates_moe(tmp_path):
     assert abs(cell["drop_fraction"] - 0.2) < 1e-9
     # fastest-first within (E, cf)
     assert agg[0]["wire_dtype"] == "gspmd"
+
+
+def test_zero_mode_sweep_rows_and_schema(tmp_path):
+    """ds_bench --zero-mode (ISSUE-15 acceptance): the three-way
+    flat-manual / GSPMD / GSPMD+quantized-islands lane emits uniform
+    bench_rows tagged direction:"zero_mode" on a REAL engine micro-step,
+    archives them into --json and comm_summary, and on this 8-virtual-
+    device mesh the GSPMD path's step time is <= flat-manual."""
+    import json
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    out = tmp_path / "zm.json"
+    trace = tmp_path / "trace"
+    run(ops=(), mesh_spec=None, iters=2, warmup=1, repeat=1,
+        print_fn=lambda *a: None, json_path=str(out), trace_dir=str(trace),
+        zero_mode=True, zero_mode_stages=(2, ), zero_mode_wires=("int8", ))
+    payload = json.loads(out.read_text())
+    rows = [r for r in payload["rows"] if r.get("direction") == "zero_mode"]
+    assert len(rows) == 3  # flat_manual + gspmd + gspmd_q
+    for row in rows:
+        assert set(row) >= {"op", "bytes", "wire_bytes", "latency_us",
+                            "iqr_us", "repeat", "wire_dtype", "direction",
+                            "zero_mode", "micro_variant", "stage"}
+        assert row["op"] == "zero_micro_step" and row["stage"] == 2
+        assert row["latency_us"] > 0
+    by_mode = {r["zero_mode"]: r for r in rows}
+    assert by_mode["flat_manual"]["micro_variant"] == "qgZ_manual"
+    assert by_mode["gspmd_q"]["micro_variant"] == "qgZ_islands"
+    assert by_mode["gspmd"]["wire_dtype"] == "fp32"
+    # quantized lanes move fewer wire bytes than the flat GSPMD lane
+    assert by_mode["gspmd_q"]["wire_bytes"] < by_mode["gspmd"]["wire_bytes"]
+    # the acceptance bar: XLA-scheduled >= hand-rolled on >=8 devices
+    assert by_mode["gspmd"]["latency_us"] <= \
+        by_mode["flat_manual"]["latency_us"], by_mode
+    summary = json.loads((trace / "comm_summary.json").read_text())
+    assert len(summary["zero_mode"]) == 3
+    # the lane restores the bench mesh for whatever sweeps follow
+    assert dict(groups.get_mesh_state().mesh.shape)["dp"] == 8
+    groups.reset_mesh()
+
+
+def test_fold_sweeps_aggregates_zero_mode(tmp_path):
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "fold_sweeps", os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "..", "tools", "fold_sweeps.py"))
+    fold = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fold)
+    zm = {"op": "zero_micro_step", "direction": "zero_mode", "stage": 2,
+          "wire_dtype": "int8", "wire_bytes": 500, "mfu": None,
+          "peak_hbm_bytes": None}
+    rows = [dict(zm, zero_mode="gspmd_q", latency_us=100.0),
+            dict(zm, zero_mode="gspmd_q", latency_us=300.0),
+            dict(zm, zero_mode="flat_manual", latency_us=400.0),
+            # non-zero-mode rows must be skipped, not crash the fold
+            {"op": "overlap", "direction": "reduce", "bucket_mb": 4.0,
+             "overlap_efficiency": 0.5, "exposed_comm_frac": 0.1}]
+    p = tmp_path / "a.json"
+    p.write_text(json.dumps({"rows": rows}))
+    agg = fold.aggregate_zero_mode([str(p)])
+    assert len(agg) == 2
+    cell = next(r for r in agg if r["zero_mode"] == "gspmd_q")
+    assert cell["runs"] == 2
+    assert abs(cell["latency_us"] - 200.0) < 1e-9
+    # fastest-first within (stage, wire)
+    assert agg[0]["zero_mode"] == "gspmd_q"

@@ -65,17 +65,33 @@ def test_gather_prefetch_parity_gate(monkeypatch):
 
 
 def test_zero2_loss_parity_with_comm_optimizations(monkeypatch):
-    # prove the quantized manual micro actually engages for the comm-opts
-    # run (parity against an accidentally-flat run would be vacuous)
-    from deepspeed_tpu.runtime.zero import zeropp
-    calls = []
-    orig = zeropp.build_manual_dp_micro
+    # prove the quantized micro actually engages for the comm-opts run
+    # (parity against an accidentally-flat run would be vacuous) — and
+    # that the DEFAULT is the GSPMD-first islands micro, not the legacy
+    # full-manual one (ISSUE 15)
+    from deepspeed_tpu.runtime.zero import gspmd, zeropp
+    islands, manual = [], []
+    orig = gspmd.build_gspmd_quantized_micro
+    monkeypatch.setattr(gspmd, "build_gspmd_quantized_micro",
+                        lambda e: islands.append(1) or orig(e))
     monkeypatch.setattr(zeropp, "build_manual_dp_micro",
-                        lambda e: calls.append(1) or orig(e))
+                        lambda e: manual.append(1))
     r = comm_smoke.run_smoke(steps=6)
-    assert len(calls) == 1  # exactly the quantized run, not the flat one
+    assert len(islands) == 1  # exactly the quantized run, not the flat one
+    assert not manual, "flat-manual micro built on the GSPMD-first default"
     assert r["converged"], r["quant_losses"]
     assert r["final_delta"] <= r["tolerance"], (
         r["flat_losses"], r["quant_losses"])
     assert r["wire_reduced"], r
     assert r["pass"]
+
+
+def test_zero_mode_flat_manual_matches_islands_bitwise():
+    """The two qgZ micro architectures are the SAME numerics: zero_mode:
+    "flat_manual" (the legacy full-manual micro) and the GSPMD-first
+    islands default produce bitwise-identical loss trajectories on a pure
+    dp mesh — the ISSUE-15 island-shrink contract."""
+    flat_manual = dict(comm_smoke.COMM_OPTS, zero_mode="flat_manual")
+    manual = comm_smoke._one_run(flat_manual, 6, 0.2)
+    islands = comm_smoke._one_run(comm_smoke.COMM_OPTS, 6, 0.2)
+    assert manual == islands, (manual, islands)

@@ -280,6 +280,12 @@ def test_routed_token_accounting_in_step_records(tmp_path):
         assert 0.0 <= layer["drop_fraction"] <= 1.0
         assert layer["load_imbalance"] >= 1.0 - 1e-6
         assert "drop_fraction_mean" in moe_recs[0]["moe"]
+        # per-expert capacity utilization (ISSUE-15 satellite): one
+        # occupancy per expert, each a post-drop fraction of capacity
+        util = layer["expert_util"]
+        assert isinstance(util, list) and len(util) >= 2, util
+        assert all(0.0 <= u <= 1.0 + 1e-6 for u in util), util
+        assert sum(util) > 0.0, util
     finally:
         _teardown()
 

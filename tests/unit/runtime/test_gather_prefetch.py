@@ -322,14 +322,16 @@ def test_qwz_prefetch_pipeline(monkeypatch):
 
 def test_manual_micro_prefetch(monkeypatch):
     """qgZ manual micro + prefetch: the stage-3 gather inside the manual
-    body runs the bucket pipeline and stays at loss parity."""
+    body runs the bucket pipeline and stays at loss parity.  Since
+    ISSUE 15 the manual micro is opt-in on pure-dp meshes (the GSPMD-first
+    islands micro is the default), so the test forces it."""
     fired = []
     orig = overlap.pipelined_gather
     monkeypatch.setattr(
         overlap, "pipelined_gather",
         lambda *a, **k: fired.append(1) or orig(*a, **k))
     qgz = {"enabled": True, "quantized_gradients": True,
-           "quantization_group_size": 128}
+           "quantization_group_size": 128, "zero_mode": "flat_manual"}
     engine = _engine(qgz)
     try:
         ref = _train(engine)

@@ -92,17 +92,47 @@ def test_quantized_cache_layout():
 
 
 # ----------------------------------------------------------------- engine
-def test_engine_rejects_unknown_kv_dtype_and_tp():
+def test_engine_rejects_unknown_kv_dtype():
     model, params, _ = serve_bench.probe_model()
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         InferenceEngineV2(model, params=params,
                           config=dict(dtype="float32",
                                       kv_cache_dtype="nf4"))
-    with pytest.raises(NotImplementedError, match="kv_cache_dtype"):
-        InferenceEngineV2(model, params=params,
-                          config=dict(dtype="float32",
-                                      kv_cache_dtype="int8",
-                                      tensor_parallel=dict(tp_size=2)))
+
+
+def test_int8_kv_composes_with_tensor_parallel():
+    """kv_cache_dtype: int8 × tp_size=2 (ISSUE-15 satellite / ROADMAP
+    serving follow-on (b)): the per-token scale arrays shard WITH the
+    cache over the kv-head dim instead of the former loud rejection —
+    greedy output stays token-identical to the tp=1 int8 engine."""
+    from deepspeed_tpu.models import llama
+    cfg = llama.llama_tiny(dtype="float32", remat=False)
+    model = llama.LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+              max_ragged_sequence_count=8, max_context=128,
+              block_size=16, num_blocks=40)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 96, size=n).tolist() for n in (17, 7)]
+    outs = {}
+    for tp in (1, 2):
+        eng = InferenceEngineV2(
+            model, params=params,
+            config=dict(dtype="float32", state_manager=dict(sm),
+                        kv_cache_dtype="int8",
+                        tensor_parallel=dict(tp_size=tp)))
+        data, scales = eng._kv
+        assert data.dtype == jnp.int8
+        if tp > 1:
+            # the cache AND its scales actually live across both ranks,
+            # split on the kv-head dim (scales' trailing dim)
+            assert len(data.sharding.device_set) == 2
+            assert len(scales.sharding.device_set) == 2
+            assert scales.sharding.spec[-1] == "tp", scales.sharding.spec
+        outs[tp] = eng.generate(prompts, max_new_tokens=6)
+        eng.flush(range(len(prompts)))
+    assert outs[1] == outs[2]
 
 
 def _probe_engine(kv_dtype=None, **kw):

@@ -285,3 +285,39 @@ def test_moe_stats_without_step_are_dropped(tmp_path):
     rec.begin_step(0)
     record = rec.end_step()
     assert "moe" not in record
+
+
+def test_moe_vector_stats_mean_elementwise(tmp_path):
+    """List-valued stats (per-expert capacity utilization, ISSUE 15) mean
+    elementwise over the gas window, like the scalars."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.moe_stat("layers_0/moe", {"k": 1, "drop_fraction": 0.2,
+                                  "expert_util": [0.2, 0.6]})
+    rec.moe_stat("layers_0/moe", {"k": 1, "drop_fraction": 0.4,
+                                  "expert_util": [0.4, 1.0]})
+    record = rec.end_step()
+    l0 = record["moe"]["layers"]["layers_0/moe"]
+    assert l0["expert_util"] == pytest.approx([0.3, 0.8])
+    assert l0["drop_fraction"] == pytest.approx(0.3)
+
+
+def test_moe_vector_stats_partial_window_and_resize(tmp_path):
+    """A vector present in only SOME of the window's calls means over its
+    own call count (not diluted by _n), and a length change (resized
+    expert group) restarts the sum instead of zip-truncating."""
+    rec = _recorder(tmp_path)
+    rec.begin_step(0)
+    rec.moe_stat("m", {"k": 1, "drop_fraction": 0.2})  # no vector
+    rec.moe_stat("m", {"k": 1, "drop_fraction": 0.4,
+                       "expert_util": [0.5, 0.7]})
+    record = rec.end_step()
+    layer = record["moe"]["layers"]["m"]
+    assert layer["expert_util"] == pytest.approx([0.5, 0.7])  # ÷1, not ÷2
+    assert layer["drop_fraction"] == pytest.approx(0.3)
+    rec.begin_step(1)
+    rec.moe_stat("m", {"k": 1, "expert_util": [1.0] * 8})
+    rec.moe_stat("m", {"k": 1, "expert_util": [0.2, 0.4]})  # resized
+    record = rec.end_step()
+    assert record["moe"]["layers"]["m"]["expert_util"] == \
+        pytest.approx([0.2, 0.4])

@@ -373,3 +373,37 @@ def test_cli_json_carries_compiled_programs(tmp_path, capsys):
     assert abs(out["mfu_mean"] - 0.42) < 1e-12
     assert out["compiled_programs"][0]["name"] == "p"
     assert out["mem_planner_delta"]["ratio"] == 2.0
+
+
+def test_moe_expert_util_columns(tmp_path):
+    """Records carrying per-expert capacity utilization render the
+    util_mean/util_max columns (ISSUE-15 satellite); archives without the
+    vector keep the exact legacy table (has_util gate)."""
+    recs = [dict(r) for r in MOE_FIXTURE]
+    for r, util in zip(recs, ([0.2, 0.6], [0.4, 1.0])):
+        moe = json.loads(json.dumps(r["moe"]))  # deep copy
+        moe["layers"]["layers_0/moe"]["expert_util"] = util
+        r["moe"] = moe
+    path = tmp_path / "steps.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    steps = trace_report.load_steps(str(path))
+    summary = trace_report.summarize(steps)
+    layer = summary["moe_layers"]["layers_0/moe"]
+    assert abs(layer["expert_util_mean"] - 0.55) < 1e-9  # mean of means
+    assert abs(layer["expert_util_max"] - 1.0) < 1e-9
+    assert layer["experts"] == 2
+    lines = []
+    trace_report.render_report(steps, summary,
+                               print_fn=lambda *a: lines.append(" ".join(
+                                   str(x) for x in a)))
+    text = "\n".join(lines)
+    assert "util_mean" in text and "util_max" in text
+    assert "0.550" in text and "1.000" in text
+    # legacy archive: no util columns, table byte-stable
+    path.write_text("".join(json.dumps(r) + "\n" for r in MOE_FIXTURE))
+    steps = trace_report.load_steps(str(path))
+    legacy = []
+    trace_report.render_report(steps, trace_report.summarize(steps),
+                               print_fn=lambda *a: legacy.append(" ".join(
+                                   str(x) for x in a)))
+    assert "util_mean" not in "\n".join(legacy)

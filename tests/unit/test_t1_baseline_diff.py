@@ -1,0 +1,126 @@
+"""tools/t1_baseline_diff.py — diff a tier-1 pytest log's failure set
+against a stashed baseline so the known-flaky crash class (CHANGES.md
+PR 13 note) stops masking regressions.  Stdlib-only tool, stdlib-only
+test: loaded by file path so a broken package import can't take the
+safety net down with it."""
+
+import importlib.util
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "t1_baseline_diff",
+    os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                 "t1_baseline_diff.py"))
+t1 = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(t1)
+
+SUMMARY = "=========== 2 failed, 100 passed, 3 skipped in 60.00s ==========="
+
+BASELINE = f"""
+tests/unit/a.py::test_ok PASSED
+FAILED tests/unit/known.py::test_flaky - AssertionError: donated buffer
+ERROR tests/unit/broken.py - ImportError: no module
+{SUMMARY}
+"""
+
+CLEAN = f"""
+tests/unit/a.py::test_ok PASSED
+FAILED tests/unit/known.py::test_flaky - AssertionError: donated buffer
+ERROR tests/unit/broken.py - ImportError: no module
+{SUMMARY}
+"""
+
+REGRESSED = f"""
+FAILED tests/unit/known.py::test_flaky - AssertionError
+FAILED tests/unit/new.py::test_regression[int8] - ValueError
+{SUMMARY}
+"""
+
+# captured-log lines inside a failure report: levelname is %-8s padded, so
+# real pytest summary lines have exactly ONE space — these must never
+# parse as failure node ids (their line numbers drift between runs)
+LOG_DECOYS = """
+----------------------------- Captured log call ------------------------------
+ERROR    deepspeed_tpu.utils:engine.py:123 reduce failed
+ERROR    root:partition.py:9 giving up
+"""
+
+TRUNCATED = """
+tests/unit/a.py::test_ok PASSED
+FAILED tests/unit/known.py::test_flaky - AssertionError
+Fatal Python error: Segmentation fault
+"""
+
+# crash AFTER the warnings-summary header but BEFORE the status bar — the
+# header must not count as a terminal summary (the segfault class this
+# tool targets routinely dies right there)
+TRUNCATED_AT_WARNINGS = """
+FAILED tests/unit/known.py::test_flaky - AssertionError
+=============================== warnings summary ===============================
+tests/unit/a.py::test_ok
+  /x/site-packages/foo.py:1: DeprecationWarning: bar
+Fatal Python error: Aborted
+"""
+
+
+def test_parse_log_failures_and_completeness():
+    fails, complete = t1.parse_log(LOG_DECOYS + BASELINE)
+    assert fails == {"tests/unit/known.py::test_flaky",
+                     "tests/unit/broken.py"}
+    assert complete
+    fails, complete = t1.parse_log(TRUNCATED)
+    assert fails == {"tests/unit/known.py::test_flaky"}
+    assert not complete
+    fails, complete = t1.parse_log(TRUNCATED_AT_WARNINGS)
+    assert fails == {"tests/unit/known.py::test_flaky"}
+    assert not complete, "warnings-summary header is not a terminal bar"
+
+
+def test_diff_new_fixed_persisting():
+    d = t1.diff_logs(REGRESSED, BASELINE)
+    assert d["new"] == ["tests/unit/new.py::test_regression[int8]"]
+    assert d["fixed"] == ["tests/unit/broken.py"]
+    assert d["persisting"] == ["tests/unit/known.py::test_flaky"]
+    assert d["current_complete"] and d["baseline_complete"]
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_cli_ok_on_known_failures_only(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.log", CLEAN)
+    base = _write(tmp_path, "base.log", BASELINE)
+    assert t1.main([cur, base]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: OK" in out and "2 known persisting" in out
+
+
+def test_cli_fails_only_on_new_failures(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.log", REGRESSED)
+    base = _write(tmp_path, "base.log", BASELINE)
+    assert t1.main([cur, base]) == 1
+    out = capsys.readouterr().out
+    assert "verdict: FAIL" in out
+    assert "tests/unit/new.py::test_regression[int8]" in out
+
+
+def test_cli_truncated_current_warns_and_gates(tmp_path, capsys):
+    cur = _write(tmp_path, "cur.log", TRUNCATED)
+    base = _write(tmp_path, "base.log", BASELINE)
+    # truncation alone is a warning, not a failure…
+    assert t1.main([cur, base]) == 0
+    assert "truncated" in capsys.readouterr().err
+    # …unless the caller demands a complete run
+    assert t1.main([cur, base, "--require-complete"]) == 1
+
+
+def test_cli_unreadable_or_empty_baseline_is_a_setup_error(tmp_path):
+    cur = _write(tmp_path, "cur.log", CLEAN)
+    assert t1.main([cur, str(tmp_path / "missing.log")]) == 2
+    empty = _write(tmp_path, "empty.log", "")
+    assert t1.main([cur, empty]) == 2

@@ -179,6 +179,50 @@ def aggregate_moe(paths):
     return out
 
 
+def aggregate_zero_mode(paths):
+    """Merge zero-mode lane rows (``direction: "zero_mode"`` — ds_bench
+    --zero-mode, the flat-manual / GSPMD / GSPMD+quantized-islands
+    three-way) across runs: mean step latency per (stage, wire_dtype,
+    zero_mode) cell, fastest first within each (stage, wire).  Coexists
+    with overlap/serve/moe/op rows in mixed archives (their ``direction``
+    differs and they are skipped here)."""
+    cells = {}
+    for path in paths:
+        payload = _load_ds_bench(path)
+        if payload is None:
+            continue
+        for row in payload["rows"]:
+            if row.get("direction") != "zero_mode":
+                continue
+            key = (int(row.get("stage") or 0),
+                   row.get("wire_dtype") or "?",
+                   row.get("zero_mode") or "?")
+            c = cells.setdefault(key, {"n": 0, "lat": 0.0, "mfu": 0.0,
+                                       "mfu_n": 0, "peak_hbm": 0,
+                                       "wire_bytes": 0})
+            c["n"] += 1
+            c["lat"] += float(row.get("latency_us") or 0.0)
+            # max, not last-seen: constant across rows of one lane today,
+            # but merged archives must not pair one run's latency mean
+            # with an arbitrary other run's bytes
+            c["wire_bytes"] = max(c["wire_bytes"],
+                                  int(row.get("wire_bytes") or 0))
+            if row.get("mfu") is not None:
+                c["mfu"] += float(row["mfu"])
+                c["mfu_n"] += 1
+            if row.get("peak_hbm_bytes"):
+                c["peak_hbm"] = max(c["peak_hbm"],
+                                    int(row["peak_hbm_bytes"]))
+    out = [{"stage": s, "wire_dtype": wd, "zero_mode": zm,
+            "runs": c["n"], "latency_us": c["lat"] / c["n"],
+            "wire_bytes": c["wire_bytes"],
+            "mfu": (c["mfu"] / c["mfu_n"]) if c["mfu_n"] else None,
+            "peak_hbm_bytes": c["peak_hbm"] or None}
+           for (s, wd, zm), c in cells.items()]
+    out.sort(key=lambda r: (r["stage"], r["wire_dtype"], r["latency_us"]))
+    return out
+
+
 # keep in sync with deepspeed_tpu/autotuning/priors.py:PRIORS_SCHEMA (a
 # unit test asserts they match; duplicated so this summarizer stays
 # importable without pulling jax via the package __init__)
@@ -273,6 +317,31 @@ def main(argv=None):
                   f"\"wire_dtype\": \"{best['wire_dtype']}\"}} "
                   f"({best_speedup:.2f}x vs gspmd at E={best['experts']} "
                   f"cf={best['capacity_factor']:g})")
+        print()
+    zero_mode = aggregate_zero_mode(paths)
+    if zero_mode:
+        print("zero-mode lane (direction=zero_mode), per (stage, wire) "
+              "fastest micro first:")
+        for r in zero_mode:
+            print(f"  z{r['stage']} wire={r['wire_dtype']:<6} "
+                  f"mode={r['zero_mode']:<12}"
+                  f" step={r['latency_us']:10.1f}us"
+                  + (f" mfu={r['mfu']:.4f}" if r.get("mfu") is not None
+                     else "")
+                  + f" (n={r['runs']})")
+        # suggest flat_manual ONLY when it measurably beats the islands
+        # default for the same quantized (stage, wire) cell; the GSPMD-
+        # first default needs no enable-me block
+        by_cell = {}
+        for r in zero_mode:
+            by_cell.setdefault((r["stage"], r["wire_dtype"]),
+                               {})[r["zero_mode"]] = r["latency_us"]
+        for (stage, wd), modes in sorted(by_cell.items()):
+            fm, gq = modes.get("flat_manual"), modes.get("gspmd_q")
+            if fm and gq and fm < gq:
+                print(f"  → z{stage}/{wd}: flat_manual measured "
+                      f"{gq / fm:.2f}x faster — consider "
+                      f"comm_optimizations.zero_mode: \"flat_manual\"")
         print()
     overlap = aggregate_overlap(paths)
     if overlap:

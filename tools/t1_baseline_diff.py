@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Diff a tier-1 pytest log's failure set against a stashed baseline log.
+
+The tier-1 suite on this box carries a known-flaky segfault/abort class
+(XLA disk-cache executables mishandling donated buffers — see CHANGES.md
+PR 13 note): a run can die mid-suite, and "the suite exited nonzero" then
+masks the question that actually matters — *did this change introduce any
+NEW failure?*  This tool answers exactly that:
+
+    # stash the baseline once, at the tree you trust
+    set -o pipefail; ... pytest ... | tee /tmp/t1_baseline.log
+    # after changes
+    ... pytest ... | tee /tmp/t1_now.log
+    python tools/t1_baseline_diff.py /tmp/t1_now.log /tmp/t1_baseline.log
+
+Exit status:
+    0 — no NEW failures (pre-existing/"fixed" churn is reported, not fatal)
+    1 — at least one failure not present in the baseline
+    2 — a log could not be read / parsed at all
+
+A truncated current log (crash before the summary) is reported loudly:
+failures seen before the crash still diff normally, but absence of a
+failure in a truncated log is NOT evidence it passed — pass
+``--require-complete`` to make truncation itself exit 1.
+
+Stdlib-only on purpose: this must run on a box where the package (or even
+jax) is broken — that is precisely when you need it.
+"""
+
+import argparse
+import re
+import sys
+
+# "FAILED tests/unit/x.py::test_y[param] - AssertionError: …" and the
+# collection-error flavor "ERROR tests/unit/x.py - ImportError: …".
+# Anchored to pytest's summary shape — ONE space, then a node id rooted
+# in a file path — so captured-log lines inside failure reports
+# ("ERROR    pkg.mod:file.py:123 msg", padded by %(levelname)-8s) can't
+# inject phantom ids whose line numbers drift between runs and flip the
+# verdict to "new failure".
+_FAIL_RE = re.compile(r"^(FAILED|ERROR) (\S+?\.py(?:::\S+)?)",
+                      re.MULTILINE)
+#: the terminal summary bar pytest prints when it survives to the end.
+#: Deliberately does NOT accept "warnings" alone: pytest prints a
+#: "=== warnings summary ===" header BEFORE the status bar, and a crash
+#: between the two (the segfault class this tool exists for) must still
+#: count as truncated.  Real terminal bars always name a status word.
+_SUMMARY_RE = re.compile(
+    r"^=+ .*\b(passed|failed|error|errors|skipped|no tests ran|xfailed|"
+    r"xpassed)\b.* =+$",
+    re.MULTILINE)
+
+
+def parse_log(text):
+    """``(failures, complete)``: the set of FAILED/ERROR node ids and
+    whether the log reached a terminal summary line (a crashed run
+    truncates before it)."""
+    failures = {m.group(2).rstrip(",") for m in _FAIL_RE.finditer(text)}
+    return failures, bool(_SUMMARY_RE.search(text))
+
+
+def diff_logs(current_text, baseline_text):
+    """Structured verdict dict the CLI (and the unit test) key off."""
+    cur, cur_complete = parse_log(current_text)
+    base, base_complete = parse_log(baseline_text)
+    return {
+        "current_failures": sorted(cur),
+        "baseline_failures": sorted(base),
+        "new": sorted(cur - base),
+        "fixed": sorted(base - cur),
+        "persisting": sorted(cur & base),
+        "current_complete": cur_complete,
+        "baseline_complete": base_complete,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="t1_baseline_diff",
+        description="exit nonzero only on failures NOT in the baseline "
+        "log (the known-flaky tier-1 crash class stops masking "
+        "regressions)")
+    ap.add_argument("current", help="pytest log of the run under test")
+    ap.add_argument("baseline", help="stashed baseline pytest log")
+    ap.add_argument("--require-complete", action="store_true",
+                    help="also fail when the CURRENT log is truncated "
+                    "(crashed before pytest's terminal summary)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only the verdict line")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.current, errors="replace") as f:
+            cur_text = f.read()
+        with open(args.baseline, errors="replace") as f:
+            base_text = f.read()
+    except OSError as e:
+        print(f"t1_baseline_diff: cannot read log: {e}", file=sys.stderr)
+        return 2
+    if not base_text.strip():
+        print("t1_baseline_diff: baseline log is empty — stash one first "
+              "(see module docstring)", file=sys.stderr)
+        return 2
+    d = diff_logs(cur_text, base_text)
+
+    def emit(title, items):
+        if args.quiet or not items:
+            return
+        print(f"{title} ({len(items)}):")
+        for node in items:
+            print(f"  {node}")
+
+    emit("NEW failures (not in baseline)", d["new"])
+    emit("fixed since baseline", d["fixed"])
+    emit("persisting (known) failures", d["persisting"])
+    if not d["baseline_complete"]:
+        print("note: the BASELINE log is truncated (no pytest summary) — "
+              "its failure set is a lower bound; consider re-stashing "
+              "from a run that completed", file=sys.stderr)
+    if not d["current_complete"]:
+        print("warning: the CURRENT log is truncated (crashed before the "
+              "pytest summary — the known tier-1 segfault class does "
+              "this); failures above are real, but tests after the crash "
+              "point are UNVERIFIED", file=sys.stderr)
+        if args.require_complete:
+            print("verdict: FAIL (truncated current log, "
+                  "--require-complete)")
+            return 1
+    if d["new"]:
+        print(f"verdict: FAIL — {len(d['new'])} new failure(s) vs "
+              f"baseline ({len(d['persisting'])} known persisting)")
+        return 1
+    print(f"verdict: OK — no new failures "
+          f"({len(d['persisting'])} known persisting, "
+          f"{len(d['fixed'])} fixed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,11 +174,25 @@ def summarize(steps):
             for key in ("drop_fraction", "overflow_tokens",
                         "load_imbalance", "aux_loss"):
                 agg[key] += float(st.get(key, 0.0))
+            util = st.get("expert_util")
+            if isinstance(util, list) and util:
+                # per-expert capacity utilization (ISSUE-15 satellite):
+                # summarize as mean/max occupancy — the capacity-factor
+                # autotuner signal — keeping old archives byte-stable
+                agg["util_n"] = agg.get("util_n", 0) + 1
+                agg["expert_util_mean"] = (agg.get("expert_util_mean", 0.0)
+                                           + sum(util) / len(util))
+                agg["expert_util_max"] = max(
+                    agg.get("expert_util_max", 0.0), max(util))
+                agg["experts"] = len(util)
     for agg in moe_layers.values():
         n = max(1, agg.pop("n"))
         for key in ("drop_fraction", "overflow_tokens", "load_imbalance",
                     "aux_loss"):
             agg[key] /= n
+        un = agg.pop("util_n", 0)
+        if un:
+            agg["expert_util_mean"] /= un
     for agg in comm_ops.values():
         agg["avg_ms"] = agg["total_ms"] / max(1, agg["count"])
         comm_ms = agg["total_ms"] + agg.get("hidden_ms", 0.0)
@@ -316,14 +330,27 @@ def render_report(steps, summary, last=None, print_fn=print):
         print_fn("")
         print_fn(f"== MoE routed-token accounting "
                  f"(mean over {summary.get('moe_steps', 0)} steps) ==")
-        print_fn(f"{'layer':<28}{'k':>3}{'drop_frac':>11}{'overflow':>10}"
-                 f"{'imbalance':>11}{'aux_loss':>10}")
+        # per-expert capacity-utilization columns only when some layer
+        # recorded the vector (old archives stay byte-stable)
+        has_util = any("expert_util_mean" in st
+                       for st in moe_layers.values())
+        header = (f"{'layer':<28}{'k':>3}{'drop_frac':>11}{'overflow':>10}"
+                  f"{'imbalance':>11}{'aux_loss':>10}")
+        if has_util:
+            header += f"{'util_mean':>11}{'util_max':>10}"
+        print_fn(header)
         for name, st in sorted(moe_layers.items()):
-            print_fn(f"{name:<28}{st.get('k', 1):>3}"
-                     f"{st['drop_fraction']:>11.3f}"
-                     f"{st['overflow_tokens']:>10.1f}"
-                     f"{st['load_imbalance']:>11.2f}"
-                     f"{st['aux_loss']:>10.4f}")
+            line = (f"{name:<28}{st.get('k', 1):>3}"
+                    f"{st['drop_fraction']:>11.3f}"
+                    f"{st['overflow_tokens']:>10.1f}"
+                    f"{st['load_imbalance']:>11.2f}"
+                    f"{st['aux_loss']:>10.4f}")
+            if has_util:
+                um = st.get("expert_util_mean")
+                ux = st.get("expert_util_max")
+                line += (f"{um:>11.3f}" if um is not None else f"{'-':>11}")
+                line += (f"{ux:>10.3f}" if ux is not None else f"{'-':>10}")
+            print_fn(line)
     moe_sweep = summary.get("moe_sweep") or []
     if moe_sweep:
         print_fn("")
